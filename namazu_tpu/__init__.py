@@ -1,0 +1,25 @@
+"""namazu_tpu: a TPU-native programmable fuzzy scheduler for distributed systems.
+
+A ground-up rebuild of the capabilities of Namazu (osrg/namazu, mirrored at
+mukteshkrmishra/namazu): intercept nondeterministic events of a real
+distributed system (packets, filesystem ops, process scheduling, in-process
+function calls), defer them through a central orchestrator, and release them
+in adversarial orders — with fault injection — to amplify the reproduction
+probability of race conditions and flaky tests.
+
+Two planes:
+
+* **Control plane** (this package's ``signal``, ``orchestrator``, ``endpoint``,
+  ``inspector``, ``storage``, ``cli`` modules): host-side, pure Python +
+  C++ guest agents. Equivalent in capability to the reference's Go runtime
+  (reference layer map: SURVEY.md section 1).
+* **Search plane** (``ops``, ``models``, ``parallel`` modules): JAX/TPU.
+  Event traces become schedule genomes (delay tables + permutation
+  priorities); millions of candidate interleavings are scored in parallel
+  (vmap + Pallas), and an island-model GA over a device mesh streams the
+  best schedules back for real replay. This plane has no reference
+  counterpart — it replaces the reference's random timer races
+  (nmz/util/queue/impl.go) with a learned, massively parallel search.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,70 @@
+"""Signal model: typed Events and Actions shared by every layer.
+
+Capability-equivalent to the reference's ``nmz/signal`` package
+(/root/reference/nmz/signal/signal.go:75-191, interface.go:8-82): events flow
+from inspectors up to the orchestrator's policy; actions flow back down.
+Unlike the reference's map-backed reflection design, signals here are plain
+Python classes with a declarative ``OPTION_FIELDS`` schema and a class
+registry used by the JSON wire codec.
+"""
+
+from namazu_tpu.signal.base import (
+    Signal,
+    SignalType,
+    register_signal_class,
+    signal_class,
+    get_signal_class,
+    known_signal_classes,
+    signal_from_jsonable,
+    signal_from_json,
+)
+from namazu_tpu.signal.event import (
+    Event,
+    NopEvent,
+    PacketEvent,
+    FilesystemEvent,
+    FilesystemOp,
+    ProcSetEvent,
+    FunctionEvent,
+    FunctionType,
+    LogEvent,
+)
+from namazu_tpu.signal.action import (
+    Action,
+    NopAction,
+    EventAcceptanceAction,
+    PacketFaultAction,
+    FilesystemFaultAction,
+    ProcSetSchedAction,
+    ShellAction,
+)
+from namazu_tpu.signal.control import Control, ControlOp
+
+__all__ = [
+    "Signal",
+    "SignalType",
+    "register_signal_class",
+    "signal_class",
+    "get_signal_class",
+    "known_signal_classes",
+    "signal_from_jsonable",
+    "signal_from_json",
+    "Event",
+    "NopEvent",
+    "PacketEvent",
+    "FilesystemEvent",
+    "FilesystemOp",
+    "ProcSetEvent",
+    "FunctionEvent",
+    "FunctionType",
+    "LogEvent",
+    "Action",
+    "NopAction",
+    "EventAcceptanceAction",
+    "PacketFaultAction",
+    "FilesystemFaultAction",
+    "ProcSetSchedAction",
+    "ShellAction",
+    "Control",
+    "ControlOp",
+]

@@ -1,0 +1,183 @@
+"""Action classes — what the policy decides to do with each event.
+
+Capability parity with /root/reference/nmz/signal/action*.go. Every action
+records the uuid and class of its cause event so the inspector-side
+transceiver can correlate it back to the blocked operation
+(/root/reference/nmz/signal/action.go:50-67 reconstructs a dummy event from
+``event_uuid`` — here we carry ``event_uuid``/``event_entity`` as first-class
+fields instead).
+
+Actions are either *propagated* back to the inspector (accept, fault) or
+*orchestrator-side* (nop, shell): executed in the orchestrator process and
+recorded in the trace only (parity: OrchestratorSideAction,
+/root/reference/nmz/signal/interface.go:73-82).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import time
+from typing import Any, Dict, Optional
+
+from namazu_tpu.signal.base import Signal, SignalType, signal_class
+from namazu_tpu.signal.event import Event
+
+
+class Action(Signal):
+    """Base action. Parity: Action interface
+    (/root/reference/nmz/signal/interface.go:41-62)."""
+
+    #: True if this action executes inside the orchestrator and is never
+    #: sent back over the wire.
+    ORCHESTRATOR_SIDE_ONLY: bool = False
+
+    def __init__(
+        self,
+        entity_id: str,
+        option: Optional[Dict[str, Any]] = None,
+        uuid: Optional[str] = None,
+        event_uuid: str = "",
+        event_class: str = "",
+    ):
+        super().__init__(entity_id=entity_id, option=option, uuid=uuid)
+        self.event_uuid = event_uuid
+        self.event_class = event_class
+        self.triggered_time: Optional[float] = None
+
+    @classmethod
+    def signal_type(cls) -> SignalType:
+        return SignalType.ACTION
+
+    @classmethod
+    def for_event(cls, event: Event, option: Optional[Dict[str, Any]] = None) -> "Action":
+        """Construct an action answering ``event``."""
+        return cls(
+            entity_id=event.entity_id,
+            option=option,
+            event_uuid=event.uuid,
+            event_class=event.class_name(),
+        )
+
+    def mark_triggered(self, now: Optional[float] = None) -> None:
+        self.triggered_time = time.time() if now is None else now
+
+    @property
+    def orchestrator_side_only(self) -> bool:
+        return self.ORCHESTRATOR_SIDE_ONLY
+
+    def execute_on_orchestrator(self) -> None:
+        """Run the orchestrator-side effect. Only called when
+        ``orchestrator_side_only`` is True."""
+        raise NotImplementedError
+
+    def equals(self, other: Signal) -> bool:
+        return (
+            super().equals(other)
+            and isinstance(other, Action)
+            and self.event_class == other.event_class
+        )
+
+    # -- wire codec ------------------------------------------------------
+
+    def to_jsonable(self) -> Dict[str, Any]:
+        d = super().to_jsonable()
+        if self.event_uuid:
+            d["event_uuid"] = self.event_uuid
+        if self.event_class:
+            d["event_class"] = self.event_class
+        return d
+
+    @classmethod
+    def from_jsonable(cls, d: Dict[str, Any]) -> "Action":
+        return cls(
+            entity_id=d["entity"],
+            option=d.get("option") or {},
+            uuid=d.get("uuid"),
+            event_uuid=d.get("event_uuid", ""),
+            event_class=d.get("event_class", ""),
+        )
+
+
+@signal_class
+class NopAction(Action):
+    """Do nothing; recorded in the trace only.
+
+    Parity: action_nop.go:23-49 (orchestrator-side, not propagated).
+    """
+
+    ORCHESTRATOR_SIDE_ONLY = True
+
+    def execute_on_orchestrator(self) -> None:
+        pass
+
+
+@signal_class
+class EventAcceptanceAction(Action):
+    """Release a deferred event now — THE scheduling primitive.
+
+    Parity: action_accept_event.go:25-43. The moment this action reaches the
+    inspector determines where the deferred operation lands in the global
+    interleaving.
+    """
+
+
+@signal_class
+class PacketFaultAction(Action):
+    """Drop the intercepted packet (parity: action_fault_packet.go:29-46)."""
+
+
+@signal_class
+class FilesystemFaultAction(Action):
+    """Fail the intercepted filesystem op with EIO
+    (parity: action_fault_filesystem.go:29-46)."""
+
+
+@signal_class
+class ProcSetSchedAction(Action):
+    """Set per-PID scheduler attributes on the testee's threads.
+
+    Parity: action_sched_procset.go:9-36, carrying a map pid ->
+    sched-attr dict (policy name, nice, rt priority, deadline params)
+    applied by the proc inspector via sched_setattr(2).
+    """
+
+    OPTION_FIELDS = {"attrs": True}
+
+    @classmethod
+    def for_procset(cls, event: Event, attrs: Dict[str, Dict[str, Any]]) -> "ProcSetSchedAction":
+        return cls.for_event(event, option={"attrs": attrs})
+
+    @property
+    def attrs(self) -> Dict[str, Dict[str, Any]]:
+        return self.option["attrs"]
+
+
+@signal_class
+class ShellAction(Action):
+    """Run an arbitrary shell command in the orchestrator (crash/fault
+    injection). Blocking, parity: action_shell.go:38-67.
+    """
+
+    ORCHESTRATOR_SIDE_ONLY = True
+    OPTION_FIELDS = {"command": True}
+
+    @classmethod
+    def create(cls, command: str, comments: Optional[Dict[str, Any]] = None) -> "ShellAction":
+        opt: Dict[str, Any] = {"command": command}
+        if comments:
+            opt["comments"] = comments
+        return cls(entity_id="_shell", option=opt)
+
+    @property
+    def command(self) -> str:
+        return self.option["command"]
+
+    def execute_on_orchestrator(self) -> None:
+        # Blocking by design, like the reference: the experiment script is
+        # expected to keep injected commands short.
+        subprocess.run(
+            self.command,
+            shell=True,
+            check=False,
+            capture_output=True,
+        )

@@ -1,0 +1,171 @@
+"""Base Signal class, class registry, and JSON wire codec.
+
+Capability parity with /root/reference/nmz/signal/signal.go (BasicSignal,
+RegisterSignalClass, NewSignalFromJSONString) — redesigned: instead of a
+``map[string]interface{}`` plus reflection, each signal class declares its
+option schema via ``OPTION_FIELDS`` and the registry is populated by a class
+decorator. The wire format is JSON with the same conceptual fields as the
+reference's doc/schema/{event,action}.json: type, class, entity, uuid,
+option.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid as uuid_mod
+from enum import Enum
+from typing import Any, Dict, Iterable, Optional, Type
+
+
+class SignalType(str, Enum):
+    EVENT = "event"
+    ACTION = "action"
+
+
+class SignalError(Exception):
+    """Raised on malformed or unregistered signals."""
+
+
+_REGISTRY: Dict[str, Type["Signal"]] = {}
+
+
+def register_signal_class(cls: Type["Signal"]) -> Type["Signal"]:
+    """Register a concrete signal class under ``cls.class_name()``.
+
+    Parity: RegisterSignalClass (/root/reference/nmz/signal/signal.go:47-63),
+    which also gob-registers; JSON is our single serialization so there is
+    only one registry.
+    """
+    name = cls.class_name()
+    existing = _REGISTRY.get(name)
+    if existing is not None and existing is not cls:
+        raise SignalError(f"signal class {name!r} already registered as {existing!r}")
+    _REGISTRY[name] = cls
+    return cls
+
+
+def signal_class(cls: Type["Signal"]) -> Type["Signal"]:
+    """Class decorator alias of :func:`register_signal_class`."""
+    return register_signal_class(cls)
+
+
+def get_signal_class(name: str) -> Type["Signal"]:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise SignalError(f"unknown signal class {name!r}") from None
+
+
+def known_signal_classes() -> Iterable[str]:
+    return sorted(_REGISTRY)
+
+
+class Signal:
+    """A typed message exchanged between inspectors and the orchestrator.
+
+    Core attributes (parity with BasicSignal getters,
+    /root/reference/nmz/signal/signal.go:100-191):
+
+    * ``uuid``      — unique id; excluded from equality.
+    * ``entity_id`` — the inspector ("entity") this signal belongs to.
+    * ``option``    — class-specific payload dict (validated against
+      ``OPTION_FIELDS``).
+    * ``arrived``   — wall-clock arrival timestamp set by the receiving side;
+      excluded from equality and from the wire format.
+    """
+
+    #: mapping option-field name -> (required: bool). Subclasses override.
+    OPTION_FIELDS: Dict[str, bool] = {}
+
+    def __init__(
+        self,
+        entity_id: str,
+        option: Optional[Dict[str, Any]] = None,
+        uuid: Optional[str] = None,
+    ):
+        self.entity_id = str(entity_id)
+        self.option: Dict[str, Any] = dict(option or {})
+        self.uuid = uuid or str(uuid_mod.uuid4())
+        self.arrived: Optional[float] = None
+        self._validate_option()
+
+    # -- schema ----------------------------------------------------------
+
+    def _validate_option(self) -> None:
+        for field, required in self.OPTION_FIELDS.items():
+            if required and field not in self.option:
+                raise SignalError(
+                    f"{self.class_name()}: missing required option {field!r}"
+                )
+
+    @classmethod
+    def class_name(cls) -> str:
+        return cls.__name__
+
+    @classmethod
+    def signal_type(cls) -> SignalType:
+        raise NotImplementedError
+
+    # -- lifecycle -------------------------------------------------------
+
+    def mark_arrived(self, now: Optional[float] = None) -> None:
+        self.arrived = time.time() if now is None else now
+
+    # -- equality --------------------------------------------------------
+
+    def equals(self, other: "Signal") -> bool:
+        """Structural equality ignoring uuid and arrival time.
+
+        Parity: EqualsSignal (/root/reference/nmz/signal/signal.go:148-170).
+        """
+        return (
+            type(self) is type(other)
+            and self.entity_id == other.entity_id
+            and self.option == other.option
+        )
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"<{self.class_name()} entity={self.entity_id!r} "
+            f"uuid={self.uuid[:8]} option={self.option!r}>"
+        )
+
+    # -- wire codec ------------------------------------------------------
+
+    def to_jsonable(self) -> Dict[str, Any]:
+        return {
+            "type": self.signal_type().value,
+            "class": self.class_name(),
+            "entity": self.entity_id,
+            "uuid": self.uuid,
+            "option": self.option,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_jsonable(), sort_keys=True)
+
+
+def signal_from_jsonable(d: Dict[str, Any]) -> "Signal":
+    """Decode one wire dict into a concrete registered signal instance.
+
+    Parity: NewSignalFromJSONString
+    (/root/reference/nmz/signal/signal.go:193-243).
+    """
+    try:
+        cls = get_signal_class(d["class"])
+    except KeyError:
+        raise SignalError(f"signal dict missing 'class': {d!r}") from None
+    declared = d.get("type")
+    if declared is not None and declared != cls.signal_type().value:
+        raise SignalError(
+            f"type mismatch: wire says {declared!r}, "
+            f"{cls.class_name()} is {cls.signal_type().value!r}"
+        )
+    sig = cls.from_jsonable(d)
+    sig.mark_arrived()
+    return sig
+
+
+def signal_from_json(s: str) -> "Signal":
+    return signal_from_jsonable(json.loads(s))

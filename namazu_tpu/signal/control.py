@@ -1,0 +1,24 @@
+"""Control messages: enable/disable orchestration at runtime.
+
+Parity: Control (/root/reference/nmz/signal/interface.go:64-71) and the REST
+``POST /api/v3/control?op=...`` endpoint. When orchestration is disabled the
+orchestrator routes every event to the always-on passthrough (dumb) policy so
+the system-under-test keeps running at native speed.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+
+
+class ControlOp(str, Enum):
+    ENABLE_ORCHESTRATION = "enableOrchestration"
+    DISABLE_ORCHESTRATION = "disableOrchestration"
+
+
+class Control:
+    def __init__(self, op: ControlOp):
+        self.op = ControlOp(op)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<Control {self.op.value}>"

@@ -1,0 +1,267 @@
+"""Event classes — what inspectors observe and defer.
+
+Capability parity with /root/reference/nmz/signal/event*.go. Each event
+declares whether it is *deferred* (the inspector blocks the intercepted
+operation until the orchestrator answers) and contributes a *replay hint*:
+a stable string derived only from semantic fields (never uuid or timing,
+per the contract in /root/reference/nmz/signal/interface.go:24-31) so a
+winning schedule can be replayed deterministically by hashing hints.
+"""
+
+from __future__ import annotations
+
+import base64
+from enum import Enum
+from typing import Any, Dict, List, Optional, Sequence
+
+from namazu_tpu.signal.base import Signal, SignalType, signal_class
+
+
+class Event(Signal):
+    """Base event. Parity: Event interface
+    (/root/reference/nmz/signal/interface.go:8-39)."""
+
+    #: whether the inspector blocks the intercepted op awaiting an action.
+    DEFERRED: bool = False
+
+    @classmethod
+    def signal_type(cls) -> SignalType:
+        return SignalType.EVENT
+
+    @property
+    def deferred(self) -> bool:
+        return self.DEFERRED
+
+    def replay_hint(self) -> str:
+        """Stable semantic hash input. Empty string = no hint (events with
+        no semantic identity, e.g. Nop)."""
+        return ""
+
+    def default_action(self) -> "Action":
+        """The action a policy should emit when it has no opinion.
+
+        Parity: BasicEvent.DefaultAction
+        (/root/reference/nmz/signal/event.go:40-55): accept if deferred,
+        else no-op.
+        """
+        from namazu_tpu.signal.action import EventAcceptanceAction, NopAction
+
+        if self.deferred:
+            return EventAcceptanceAction.for_event(self)
+        return NopAction.for_event(self)
+
+    def default_fault_action(self) -> Optional["Action"]:
+        """The fault this event supports, or None."""
+        return None
+
+    @classmethod
+    def from_jsonable(cls, d: Dict[str, Any]) -> "Event":
+        return cls(
+            entity_id=d["entity"],
+            option=d.get("option") or {},
+            uuid=d.get("uuid"),
+        )
+
+
+@signal_class
+class NopEvent(Event):
+    """Placeholder / testing event (parity: event_nop.go:20-39)."""
+
+    DEFERRED = False
+
+
+@signal_class
+class PacketEvent(Event):
+    """An intercepted network message between two entities.
+
+    Parity: PacketEvent (/root/reference/nmz/signal/event_packet.go:25-46).
+    ``payload`` is carried base64-encoded in the option dict so the wire
+    format stays pure JSON.
+    """
+
+    DEFERRED = True
+    OPTION_FIELDS = {"src_entity": True, "dst_entity": True}
+
+    @classmethod
+    def create(
+        cls,
+        entity_id: str,
+        src_entity: str,
+        dst_entity: str,
+        payload: bytes = b"",
+        hint: str = "",
+    ) -> "PacketEvent":
+        opt: Dict[str, Any] = {
+            "src_entity": src_entity,
+            "dst_entity": dst_entity,
+        }
+        if payload:
+            opt["payload_b64"] = base64.b64encode(payload).decode("ascii")
+        if hint:
+            opt["replay_hint"] = hint
+        return cls(entity_id=entity_id, option=opt)
+
+    @property
+    def payload(self) -> bytes:
+        b64 = self.option.get("payload_b64", "")
+        return base64.b64decode(b64) if b64 else b""
+
+    def replay_hint(self) -> str:
+        # Semantic parsers (e.g. the ZooKeeper FLE/ZAB inspector) set an
+        # explicit protocol-level hint; otherwise fall back to the flow.
+        explicit = self.option.get("replay_hint")
+        if explicit:
+            return str(explicit)
+        return f"packet:{self.option['src_entity']}->{self.option['dst_entity']}"
+
+    def default_fault_action(self):
+        from namazu_tpu.signal.action import PacketFaultAction
+
+        return PacketFaultAction.for_event(self)
+
+
+class FilesystemOp(str, Enum):
+    """Hooked filesystem operations (parity: event_filesystem.go:21-38)."""
+
+    POST_READ = "post-read"
+    POST_OPENDIR = "post-opendir"
+    PRE_WRITE = "pre-write"
+    PRE_MKDIR = "pre-mkdir"
+    PRE_RMDIR = "pre-rmdir"
+    PRE_FSYNC = "pre-fsync"
+
+
+@signal_class
+class FilesystemEvent(Event):
+    """An intercepted filesystem operation (parity: event_filesystem.go:21-59)."""
+
+    DEFERRED = True
+    OPTION_FIELDS = {"op": True, "path": True}
+
+    @classmethod
+    def create(cls, entity_id: str, op: FilesystemOp, path: str) -> "FilesystemEvent":
+        return cls(
+            entity_id=entity_id,
+            option={"op": FilesystemOp(op).value, "path": path},
+        )
+
+    @property
+    def op(self) -> FilesystemOp:
+        return FilesystemOp(self.option["op"])
+
+    @property
+    def path(self) -> str:
+        return self.option["path"]
+
+    def replay_hint(self) -> str:
+        return f"fs:{self.option['op']}:{self.option['path']}"
+
+    def default_fault_action(self):
+        from namazu_tpu.signal.action import FilesystemFaultAction
+
+        return FilesystemFaultAction.for_event(self)
+
+
+@signal_class
+class ProcSetEvent(Event):
+    """A snapshot of the system-under-test's process/thread set.
+
+    Parity: ProcSetEvent (/root/reference/nmz/signal/event_procset.go:21-42).
+    Non-deferred: the proc inspector does not block the testee; it awaits
+    the answering ProcSetSchedAction out-of-band.
+    """
+
+    DEFERRED = False
+    OPTION_FIELDS = {"procs": True}
+
+    @classmethod
+    def create(cls, entity_id: str, pids: Sequence[int]) -> "ProcSetEvent":
+        return cls(
+            entity_id=entity_id,
+            option={"procs": [str(int(p)) for p in pids]},
+        )
+
+    @property
+    def pids(self) -> List[int]:
+        return [int(p) for p in self.option["procs"]]
+
+    def replay_hint(self) -> str:
+        # PID values are not stable across runs; only the set size is.
+        return f"procset:{self.entity_id}:{len(self.option['procs'])}"
+
+
+class FunctionType(str, Enum):
+    CALL = "call"
+    RETURN = "return"
+
+
+@signal_class
+class FunctionEvent(Event):
+    """A function call/return intercepted inside the testee process.
+
+    Unifies the reference's JavaFunctionEvent and CFunctionEvent
+    (/root/reference/nmz/signal/event_function.go:36-129) under one class
+    with a ``runtime`` discriminator ("java", "c", "python", ...). Emitted
+    by in-process guest agents over the framed TCP endpoint.
+    """
+
+    DEFERRED = True
+    OPTION_FIELDS = {"func_name": True, "func_type": True, "runtime": True}
+
+    @classmethod
+    def create(
+        cls,
+        entity_id: str,
+        func_name: str,
+        func_type: FunctionType = FunctionType.CALL,
+        runtime: str = "python",
+        thread_name: str = "",
+        params: Optional[Dict[str, str]] = None,
+        stacktrace: Optional[List[str]] = None,
+    ) -> "FunctionEvent":
+        opt: Dict[str, Any] = {
+            "func_name": func_name,
+            "func_type": FunctionType(func_type).value,
+            "runtime": runtime,
+        }
+        if thread_name:
+            opt["thread_name"] = thread_name
+        if params:
+            opt["params"] = dict(params)
+        if stacktrace:
+            opt["stacktrace"] = list(stacktrace)
+        return cls(entity_id=entity_id, option=opt)
+
+    @property
+    def func_name(self) -> str:
+        return self.option["func_name"]
+
+    @property
+    def thread_name(self) -> str:
+        return self.option.get("thread_name", "")
+
+    def replay_hint(self) -> str:
+        return (
+            f"fn:{self.option['runtime']}:{self.option['func_name']}"
+            f":{self.option['func_type']}:{self.option.get('thread_name', '')}"
+        )
+
+
+@signal_class
+class LogEvent(Event):
+    """An observed log line (observation-only, never deferred).
+
+    Parity: LogEvent (/root/reference/nmz/signal/event_log.go:17-23 and
+    misc/pynmz/signal/event.py:28-43).
+    """
+
+    DEFERRED = False
+    OPTION_FIELDS = {"line": True}
+
+    @classmethod
+    def create(cls, entity_id: str, line: str) -> "LogEvent":
+        return cls(entity_id=entity_id, option={"line": line})
+
+    @property
+    def line(self) -> str:
+        return self.option["line"]

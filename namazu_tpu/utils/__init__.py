@@ -1,0 +1,1 @@
+"""Shared utilities: scheduling queue, config, logging, traces, commands."""

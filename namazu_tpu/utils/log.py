@@ -1,0 +1,39 @@
+"""Logging setup.
+
+Parity with /root/reference/nmz/util/log/logutil.go: per-run log file plus
+stderr, debug gated on the ``NMZ_TPU_DEBUG`` environment variable.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Optional
+
+_INITIALIZED = False
+
+
+def init_log(log_file: Optional[str] = None, debug: Optional[bool] = None) -> logging.Logger:
+    global _INITIALIZED
+    root = logging.getLogger("namazu_tpu")
+    if debug is None:
+        debug = os.environ.get("NMZ_TPU_DEBUG", "") not in ("", "0", "false")
+    root.setLevel(logging.DEBUG if debug else logging.INFO)
+    fmt = logging.Formatter(
+        "%(asctime)s %(levelname).1s %(name)s: %(message)s", "%H:%M:%S"
+    )
+    if not _INITIALIZED:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(fmt)
+        root.addHandler(h)
+        _INITIALIZED = True
+    if log_file:
+        fh = logging.FileHandler(log_file)
+        fh.setFormatter(fmt)
+        root.addHandler(fh)
+    return root
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(f"namazu_tpu.{name}")

@@ -1,0 +1,67 @@
+"""Experiment traces: the ordered action sequence of one run.
+
+Parity: SingleTrace (/root/reference/nmz/util/trace/trace.go:25-31). Stored
+as JSON (not gob): each element is the action's wire dict plus its
+triggered time, so traces are directly consumable by the JAX search plane's
+featurizer.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterator, List, Optional
+
+from namazu_tpu.signal.action import Action
+from namazu_tpu.signal.base import signal_from_jsonable
+
+
+class SingleTrace:
+    def __init__(self, actions: Optional[List[Action]] = None):
+        self.actions: List[Action] = list(actions or [])
+
+    def append(self, action: Action) -> None:
+        self.actions.append(action)
+
+    def __len__(self) -> int:
+        return len(self.actions)
+
+    def __iter__(self) -> Iterator[Action]:
+        return iter(self.actions)
+
+    def to_jsonable(self) -> List[Dict[str, Any]]:
+        out = []
+        for a in self.actions:
+            d = a.to_jsonable()
+            if a.triggered_time is not None:
+                d["triggered_time"] = a.triggered_time
+            out.append(d)
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_jsonable())
+
+    @classmethod
+    def from_jsonable(cls, items: List[Dict[str, Any]]) -> "SingleTrace":
+        trace = cls()
+        for d in items:
+            a = signal_from_jsonable(d)
+            if not isinstance(a, Action):
+                raise ValueError(f"trace element is not an action: {d!r}")
+            tt = d.get("triggered_time")
+            if tt is not None:
+                a.triggered_time = float(tt)
+            trace.append(a)
+        return trace
+
+    @classmethod
+    def from_json(cls, s: str) -> "SingleTrace":
+        return cls.from_jsonable(json.loads(s))
+
+    def entity_order(self) -> Dict[str, List[str]]:
+        """Per-entity subsequence of event classes — the partial-order view
+        used for unique-trace counting (parity: the PO-reduction in
+        /root/reference/nmz/cli/tools/visualize.go:81-133)."""
+        per: Dict[str, List[str]] = {}
+        for a in self.actions:
+            per.setdefault(a.entity_id, []).append(a.event_class or a.class_name())
+        return per

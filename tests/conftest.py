@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Force JAX onto a virtual 8-device CPU mesh *before* jax is imported anywhere,
+so multi-chip sharding paths (shard_map islands, psum/ppermute migration) are
+exercised without TPU hardware. Bench and production paths do NOT set these:
+they run on the real chip.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

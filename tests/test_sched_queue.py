@@ -1,0 +1,86 @@
+"""ScheduledQueue invariants (parity: nmz/util/queue tests)."""
+
+import threading
+import time
+
+import pytest
+
+from namazu_tpu.utils.sched_queue import QueueClosed, ScheduledQueue
+
+
+def test_equal_bounds_preserve_fifo():
+    q = ScheduledQueue(seed=0)
+    for i in range(100):
+        q.put(i, 0.0, 0.0)
+    got = [q.get(timeout=1) for _ in range(100)]
+    assert got == list(range(100))
+
+
+def test_equal_nonzero_bounds_preserve_fifo():
+    q = ScheduledQueue(seed=0)
+    for i in range(20):
+        q.put(i, 0.005, 0.005)
+    got = [q.get(timeout=2) for _ in range(20)]
+    assert got == list(range(20))
+
+
+def test_unequal_bounds_reorder():
+    q = ScheduledQueue(seed=42, time_scale=0.01)
+    for i in range(30):
+        q.put(i, 0.0, 1.0)
+    got = [q.get(timeout=5) for _ in range(30)]
+    assert sorted(got) == list(range(30))
+    assert got != list(range(30))  # actually reorders
+
+
+def test_put_at_distinct_delays_is_deterministic():
+    # deterministic replay path: ms-granular explicit delays => exact order
+    def run():
+        q = ScheduledQueue(time_scale=0.1)
+        delays = [(i * 7919) % 30 for i in range(30)]  # distinct mod-30 perm
+        for i, d in enumerate(delays):
+            q.put_at(i, d * 0.010)
+        return [q.get(timeout=30) for _ in range(30)]
+
+    a, b = run(), run()
+    assert a == b
+    assert a != list(range(30))
+
+
+def test_delay_is_respected():
+    q = ScheduledQueue(seed=0)
+    t0 = time.monotonic()
+    q.put("x", 0.05, 0.05)
+    assert q.get(timeout=1) == "x"
+    assert time.monotonic() - t0 >= 0.045
+
+
+def test_get_timeout():
+    q = ScheduledQueue()
+    with pytest.raises(TimeoutError):
+        q.get(timeout=0.05)
+
+
+def test_close_unblocks_getters():
+    q = ScheduledQueue()
+    errs = []
+
+    def getter():
+        try:
+            q.get(timeout=5)
+        except QueueClosed:
+            errs.append("closed")
+
+    t = threading.Thread(target=getter)
+    t.start()
+    time.sleep(0.05)
+    q.close()
+    t.join(timeout=2)
+    assert errs == ["closed"]
+
+
+def test_put_after_close_raises():
+    q = ScheduledQueue()
+    q.close()
+    with pytest.raises(QueueClosed):
+        q.put(1)

@@ -1,0 +1,178 @@
+"""Signal-layer tests: JSON round-trips, equality, replay hints, defaults.
+
+Mirrors the reference's test strategy for nmz/signal
+(/root/reference/nmz/signal/*_test.go): every event/action class must
+round-trip through the wire codec, compare equal ignoring uuid/arrival,
+and produce sane default actions.
+"""
+
+import json
+
+import pytest
+
+from namazu_tpu.signal import (
+    Action,
+    EventAcceptanceAction,
+    FilesystemEvent,
+    FilesystemFaultAction,
+    FilesystemOp,
+    FunctionEvent,
+    FunctionType,
+    LogEvent,
+    NopAction,
+    NopEvent,
+    PacketEvent,
+    PacketFaultAction,
+    ProcSetEvent,
+    ProcSetSchedAction,
+    ShellAction,
+    SignalType,
+    known_signal_classes,
+    signal_from_json,
+)
+from namazu_tpu.signal.base import SignalError
+
+
+def roundtrip(sig):
+    wire = sig.to_json()
+    back = signal_from_json(wire)
+    assert back.equals(sig), f"{sig!r} != {back!r}"
+    assert back.arrived is not None  # stamped on decode
+    return back
+
+
+def test_registry_has_all_known_classes():
+    names = set(known_signal_classes())
+    assert {
+        "NopEvent",
+        "PacketEvent",
+        "FilesystemEvent",
+        "ProcSetEvent",
+        "FunctionEvent",
+        "LogEvent",
+        "NopAction",
+        "EventAcceptanceAction",
+        "PacketFaultAction",
+        "FilesystemFaultAction",
+        "ProcSetSchedAction",
+        "ShellAction",
+    } <= names
+
+
+def test_packet_event_roundtrip_and_hint():
+    ev = PacketEvent.create(
+        "zk1", src_entity="zk1", dst_entity="zk2", payload=b"\x00\x01vote"
+    )
+    assert ev.deferred
+    back = roundtrip(ev)
+    assert back.payload == b"\x00\x01vote"
+    assert back.replay_hint() == "packet:zk1->zk2"
+    # explicit semantic hint wins
+    ev2 = PacketEvent.create("zk1", "zk1", "zk2", hint="fle:vote:3:epoch1")
+    assert ev2.replay_hint() == "fle:vote:3:epoch1"
+
+
+def test_packet_event_uuid_excluded_from_equality():
+    a = PacketEvent.create("e", "s", "d")
+    b = PacketEvent.create("e", "s", "d")
+    assert a.uuid != b.uuid
+    assert a.equals(b)
+
+
+def test_filesystem_event_roundtrip():
+    ev = FilesystemEvent.create("yarn1", FilesystemOp.PRE_FSYNC, "/data/edits.log")
+    back = roundtrip(ev)
+    assert back.op is FilesystemOp.PRE_FSYNC
+    assert back.path == "/data/edits.log"
+    assert back.replay_hint() == "fs:pre-fsync:/data/edits.log"
+    fault = back.default_fault_action()
+    assert isinstance(fault, FilesystemFaultAction)
+    assert fault.event_uuid == back.uuid
+
+
+def test_procset_event_roundtrip_not_deferred():
+    ev = ProcSetEvent.create("yarn", [1, 2, 42])
+    assert not ev.deferred
+    back = roundtrip(ev)
+    assert back.pids == [1, 2, 42]
+    # non-deferred default is a Nop (orchestrator-side)
+    assert isinstance(back.default_action(), NopAction)
+
+
+def test_function_event_roundtrip():
+    ev = FunctionEvent.create(
+        "zksrv",
+        func_name="FastLeaderElection.lookForLeader",
+        func_type=FunctionType.CALL,
+        runtime="java",
+        thread_name="QuorumPeer-1",
+        params={"round": "3"},
+        stacktrace=["a", "b"],
+    )
+    back = roundtrip(ev)
+    assert back.func_name == "FastLeaderElection.lookForLeader"
+    assert "QuorumPeer-1" in back.replay_hint()
+
+
+def test_log_event():
+    ev = LogEvent.create("syslog", "leader elected")
+    back = roundtrip(ev)
+    assert back.line == "leader elected"
+    assert not back.deferred
+
+
+def test_deferred_default_action_is_acceptance():
+    ev = PacketEvent.create("e", "s", "d")
+    act = ev.default_action()
+    assert isinstance(act, EventAcceptanceAction)
+    assert act.event_uuid == ev.uuid
+    assert act.event_class == "PacketEvent"
+    assert not act.orchestrator_side_only
+    roundtrip(act)
+
+
+def test_fault_actions_roundtrip():
+    ev = PacketEvent.create("e", "s", "d")
+    fault = ev.default_fault_action()
+    assert isinstance(fault, PacketFaultAction)
+    back = roundtrip(fault)
+    assert back.event_uuid == ev.uuid
+
+
+def test_procset_sched_action():
+    ev = ProcSetEvent.create("e", [10, 11])
+    act = ProcSetSchedAction.for_procset(
+        ev, {"10": {"policy": "SCHED_BATCH", "nice": 5}, "11": {"policy": "SCHED_RR", "rt_priority": 3}}
+    )
+    back = roundtrip(act)
+    assert back.attrs["10"]["policy"] == "SCHED_BATCH"
+
+
+def test_shell_action_executes():
+    act = ShellAction.create("true")
+    assert act.orchestrator_side_only
+    act.execute_on_orchestrator()  # must not raise
+    roundtrip(act)
+
+
+def test_replay_hints_exclude_uuid_and_timing():
+    a = PacketEvent.create("e", "s", "d")
+    b = PacketEvent.create("e", "s", "d")
+    assert a.replay_hint() == b.replay_hint()
+
+
+def test_missing_required_option_raises():
+    with pytest.raises(SignalError):
+        FilesystemEvent(entity_id="x", option={"op": "post-read"})  # no path
+
+
+def test_unknown_class_raises():
+    with pytest.raises(SignalError):
+        signal_from_json(json.dumps({"type": "event", "class": "NoSuch", "entity": "x"}))
+
+
+def test_type_mismatch_raises():
+    wire = json.loads(PacketEvent.create("e", "s", "d").to_json())
+    wire["type"] = "action"
+    with pytest.raises(SignalError):
+        signal_from_json(json.dumps(wire))
