@@ -1,0 +1,45 @@
+"""Exploration policies: the pluggable scheduler brains.
+
+Capability parity with /root/reference/nmz/explorepolicy (interface.go:24-40,
+explorepolicy.go:25-37): a policy receives every intercepted event via
+``queue_event`` (which must never block) and emits actions on its
+``action_out`` queue whenever it decides an event should be released,
+faulted, or a process set re-scheduled.
+
+Built-ins:
+
+* ``dumb``       — passthrough with a fixed interval.
+* ``random``     — delay each event uniformly in [min,max]; probabilistic
+                   faults; proc sub-policies mild/extreme/dirichlet;
+                   periodic shell injection.
+* ``replayable`` — semi-deterministic delays hashed from (seed, replay hint).
+* ``tpu_search`` — the JAX/TPU schedule-search policy (namazu_tpu.policy.tpu);
+                   registered lazily on first use to keep jax out of the
+                   control plane's import path.
+
+Out-of-tree policies register themselves with :func:`register_policy` —
+the plugin boundary user experiments rely on (parity:
+/root/reference/example/template/mypolicy.go).
+"""
+
+from namazu_tpu.policy.base import (
+    ExplorePolicy,
+    PolicyError,
+    register_policy,
+    create_policy,
+    known_policies,
+)
+from namazu_tpu.policy.dumb import DumbPolicy
+from namazu_tpu.policy.random_policy import RandomPolicy
+from namazu_tpu.policy.replayable import ReplayablePolicy
+
+__all__ = [
+    "ExplorePolicy",
+    "PolicyError",
+    "register_policy",
+    "create_policy",
+    "known_policies",
+    "DumbPolicy",
+    "RandomPolicy",
+    "ReplayablePolicy",
+]

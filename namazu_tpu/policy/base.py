@@ -1,0 +1,158 @@
+"""Policy interface and registry."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Iterable, Optional, TYPE_CHECKING
+
+from namazu_tpu.signal.action import Action
+from namazu_tpu.signal.event import Event
+from namazu_tpu.utils.sched_queue import QueueClosed, ScheduledQueue
+
+if TYPE_CHECKING:  # pragma: no cover
+    from namazu_tpu.storage.base import HistoryStorage
+    from namazu_tpu.utils.config import Config
+
+
+class PolicyError(Exception):
+    pass
+
+
+class _PolicyDone:
+    """Sentinel a policy emits on ``action_out`` after shutdown has flushed
+    every remaining action — lets the orchestrator drain without racing."""
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return "<POLICY_DONE>"
+
+
+POLICY_DONE = _PolicyDone()
+
+
+class ExplorePolicy:
+    """Base class for exploration policies.
+
+    Contract (parity with the reference's ExplorePolicy interface,
+    /root/reference/nmz/explorepolicy/interface.go:24-40, and the
+    non-blocking warning in its README.md:304):
+
+    * ``queue_event`` MUST return quickly — never block on I/O or sleep.
+    * actions appear on ``action_out`` (a thread-safe queue) in the order
+      the policy decides to release them; that order IS the fuzz.
+    * ``load_config`` may be called again at runtime for dynamic reload.
+    """
+
+    NAME = "abstract"
+
+    def __init__(self) -> None:
+        self.action_out: "queue.Queue[Action]" = queue.Queue()
+        self._storage: Optional["HistoryStorage"] = None
+
+    @property
+    def name(self) -> str:
+        return self.NAME
+
+    def load_config(self, config: "Config") -> None:
+        """Read ``explore_policy_param.*`` keys. Unknown keys are ignored
+        (parity: the reference tolerates unknown params,
+        randompolicy_test.go:49-91)."""
+
+    def set_history_storage(self, storage: "HistoryStorage") -> None:
+        self._storage = storage
+
+    def queue_event(self, event: Event) -> None:
+        raise NotImplementedError
+
+    def start(self) -> None:
+        """Start worker threads (idempotent)."""
+
+    def shutdown(self) -> None:
+        """Stop worker threads, flush pending actions, then emit
+        :data:`POLICY_DONE` on ``action_out``."""
+        self.action_out.put(POLICY_DONE)  # type: ignore[arg-type]
+
+    # -- helpers for subclasses -----------------------------------------
+
+    def _emit(self, action: Action) -> None:
+        self.action_out.put(action)
+
+    def _spawn(self, target: Callable[[], None], name: str) -> threading.Thread:
+        t = threading.Thread(target=target, name=f"{self.name}-{name}", daemon=True)
+        t.start()
+        return t
+
+
+class QueueBackedPolicy(ExplorePolicy):
+    """Shared machinery for policies built around one ScheduledQueue: an
+    idempotent start, a dequeue worker mapping each released event to an
+    action via :meth:`_action_for`, and a flushing shutdown."""
+
+    def __init__(self, seed: Optional[int] = None) -> None:
+        super().__init__()
+        self._queue = ScheduledQueue(seed=seed)
+        self._started = False
+        self._start_lock = threading.Lock()
+        self._dequeue_thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        with self._start_lock:
+            if self._started:
+                return
+            self._started = True
+            self._dequeue_thread = self._spawn(self._dequeue_loop, "dequeue")
+
+    def _dequeue_loop(self) -> None:
+        while True:
+            try:
+                event = self._queue.get()
+            except QueueClosed:
+                return
+            self._emit(self._action_for(event))
+
+    def _action_for(self, event: Event) -> Action:
+        return event.default_action()
+
+    def shutdown(self) -> None:
+        """Release all still-delayed events immediately, wait for the
+        dequeue worker to flush their actions, then signal POLICY_DONE."""
+        self._queue.close(immediate=True)
+        t = self._dequeue_thread
+        if t is not None:
+            t.join(timeout=10)
+        super().shutdown()
+
+
+PolicyFactory = Callable[[], ExplorePolicy]
+
+_POLICIES: Dict[str, PolicyFactory] = {}
+
+
+def register_policy(name: str, factory: PolicyFactory) -> None:
+    """Register a policy factory (parity: RegisterPolicy,
+    /root/reference/nmz/explorepolicy/explorepolicy.go:25-31)."""
+    if name in _POLICIES:
+        raise PolicyError(f"policy {name!r} already registered")
+    _POLICIES[name] = factory
+
+
+def create_policy(name: str) -> ExplorePolicy:
+    """Instantiate a registered policy (parity: CreatePolicy,
+    explorepolicy.go:33-37). The TPU search policy is registered lazily so
+    that control-plane-only deployments never import jax."""
+    if name == "tpu_search" and name not in _POLICIES:
+        try:
+            from namazu_tpu.policy import tpu as _tpu  # noqa: F401  (self-registers)
+        except ImportError as e:
+            raise PolicyError(f"tpu_search policy unavailable: {e}") from e
+    try:
+        factory = _POLICIES[name]
+    except KeyError:
+        raise PolicyError(
+            f"unknown policy {name!r}; known: {sorted(_POLICIES)}"
+        ) from None
+    return factory()
+
+
+def known_policies() -> Iterable[str]:
+    return sorted(_POLICIES)

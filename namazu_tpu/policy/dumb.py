@@ -1,0 +1,34 @@
+"""Dumb policy: passthrough with a fixed interval.
+
+Parity: /root/reference/nmz/explorepolicy/dumb/dumbpolicy.go:41-103. Every
+event's default action is emitted after a fixed ``interval`` (default 0).
+With interval 0 this is a pure passthrough that still serializes events
+through one queue — exactly what the orchestrator uses when orchestration
+is disabled.
+"""
+
+from __future__ import annotations
+
+from namazu_tpu.policy.base import QueueBackedPolicy, register_policy
+from namazu_tpu.signal.event import Event
+from namazu_tpu.utils.config import parse_duration
+
+
+class DumbPolicy(QueueBackedPolicy):
+    NAME = "dumb"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.interval = 0.0
+
+    def load_config(self, config) -> None:
+        iv = config.policy_param("interval", None)
+        if iv is not None:
+            self.interval = parse_duration(iv)
+
+    def queue_event(self, event: Event) -> None:
+        self.start()
+        self._queue.put(event, self.interval, self.interval)
+
+
+register_policy(DumbPolicy.NAME, DumbPolicy)

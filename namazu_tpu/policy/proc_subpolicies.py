@@ -1,0 +1,142 @@
+"""Process-scheduling sub-policies used by the random policy.
+
+Given the PID set of a ProcSetEvent, produce per-PID scheduler attributes.
+Parity with /root/reference/nmz/explorepolicy/random/{mild,extreme,
+dirichlet}.go. Attribute dicts are consumed by the proc inspector's
+``sched_setattr(2)`` shim (namazu_tpu.inspector.proc).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Any, Dict, List, Sequence
+
+
+AttrMap = Dict[str, Dict[str, Any]]
+
+
+class ProcSubPolicy:
+    NAME = "abstract"
+
+    def __init__(self, rng: random.Random):
+        self.rng = rng
+
+    def load_params(self, params: Dict[str, Any]) -> None:
+        pass
+
+    def attrs_for(self, pids: Sequence[int]) -> AttrMap:
+        raise NotImplementedError
+
+
+class MildProcPolicy(ProcSubPolicy):
+    """SCHED_NORMAL or SCHED_BATCH with a random nice value.
+
+    Parity: mild.go:29-55.
+    """
+
+    NAME = "mild"
+
+    def attrs_for(self, pids: Sequence[int]) -> AttrMap:
+        out: AttrMap = {}
+        for pid in pids:
+            policy = self.rng.choice(["SCHED_NORMAL", "SCHED_BATCH"])
+            out[str(pid)] = {"policy": policy, "nice": self.rng.randrange(-20, 20)}
+        return out
+
+
+class ExtremeProcPolicy(ProcSubPolicy):
+    """A few prioritized threads get real-time SCHED_RR; the rest are
+    demoted to SCHED_BATCH — the harshest legal starvation.
+
+    Parity: extreme.go:29-61 (``prioritized`` default 3).
+    """
+
+    NAME = "extreme"
+
+    def __init__(self, rng: random.Random):
+        super().__init__(rng)
+        self.prioritized = 3
+
+    def load_params(self, params: Dict[str, Any]) -> None:
+        self.prioritized = int(params.get("prioritized", self.prioritized))
+
+    def attrs_for(self, pids: Sequence[int]) -> AttrMap:
+        pids = list(pids)
+        k = min(self.prioritized, len(pids))
+        chosen = set(self.rng.sample(pids, k)) if k else set()
+        out: AttrMap = {}
+        for pid in pids:
+            if pid in chosen:
+                out[str(pid)] = {
+                    "policy": "SCHED_RR",
+                    "rt_priority": 1 + self.rng.randrange(0, 10),
+                }
+            else:
+                out[str(pid)] = {"policy": "SCHED_BATCH", "nice": 0}
+        return out
+
+
+class DirichletProcPolicy(ProcSubPolicy):
+    """SCHED_DEADLINE runtimes drawn from a Dirichlet distribution, so the
+    CPU shares of the testee's threads are randomly but fairly skewed.
+
+    Parity: dirichlet.go:38-86 — runtime_i = base * r_i * efficiency *
+    n_cpu with r ~ Dirichlet(1); with ``reset_probability`` everything is
+    reset to SCHED_NORMAL to let the system recover.
+    """
+
+    NAME = "dirichlet"
+
+    def __init__(self, rng: random.Random):
+        super().__init__(rng)
+        self.base_ns = 10_000_000  # 10ms period base
+        self.efficiency = 0.8
+        self.reset_probability = 0.1
+
+    def load_params(self, params: Dict[str, Any]) -> None:
+        self.base_ns = int(params.get("base_ns", self.base_ns))
+        self.efficiency = float(params.get("efficiency", self.efficiency))
+        self.reset_probability = float(
+            params.get("reset_probability", self.reset_probability)
+        )
+
+    def _dirichlet(self, n: int) -> List[float]:
+        # Dirichlet(1,...,1) via normalized exponentials; no numpy needed
+        xs = [self.rng.expovariate(1.0) for _ in range(n)]
+        s = sum(xs) or 1.0
+        return [x / s for x in xs]
+
+    def attrs_for(self, pids: Sequence[int]) -> AttrMap:
+        pids = list(pids)
+        if not pids:
+            return {}
+        if self.rng.random() < self.reset_probability:
+            return {str(p): {"policy": "SCHED_NORMAL", "nice": 0} for p in pids}
+        ncpu = os.cpu_count() or 1
+        shares = self._dirichlet(len(pids))
+        out: AttrMap = {}
+        for pid, r in zip(pids, shares):
+            runtime = max(1024, int(self.base_ns * r * self.efficiency * ncpu))
+            runtime = min(runtime, self.base_ns)
+            out[str(pid)] = {
+                "policy": "SCHED_DEADLINE",
+                "runtime_ns": runtime,
+                "deadline_ns": self.base_ns,
+                "period_ns": self.base_ns,
+            }
+        return out
+
+
+PROC_SUBPOLICIES = {
+    cls.NAME: cls for cls in (MildProcPolicy, ExtremeProcPolicy, DirichletProcPolicy)
+}
+
+
+def create_proc_subpolicy(name: str, rng: random.Random) -> ProcSubPolicy:
+    try:
+        return PROC_SUBPOLICIES[name](rng)
+    except KeyError:
+        raise ValueError(
+            f"unknown proc sub-policy {name!r}; known: {sorted(PROC_SUBPOLICIES)}"
+        ) from None

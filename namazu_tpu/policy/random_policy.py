@@ -1,0 +1,116 @@
+"""Random policy: the reference's default fuzzer.
+
+Parity: /root/reference/nmz/explorepolicy/random/randompolicy.go:93-346.
+
+* every event is delayed uniformly in ``[min_interval, max_interval]``
+  (entities listed in ``prioritized_entities`` get 0.8x the delay);
+* on release, with probability ``fault_action_probability`` the event's
+  fault action (drop packet / EIO) is chosen instead of its default;
+* ``ProcSetEvent``s bypass the delay queue and are answered immediately by
+  a proc sub-policy (mild / extreme / dirichlet);
+* optionally a shell command is injected every ``shell_action_interval``
+  (crash injection, parity randompolicy.go:281-298).
+
+Unlike the reference, a ``seed`` parameter makes the policy's random
+choices reproducible: delay sampling, fault coin-flips and proc attrs are
+all derived from it (the delay queue's RNG is reseeded with seed+1 by
+``load_config``).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Optional
+
+from namazu_tpu.policy.base import QueueBackedPolicy, register_policy
+from namazu_tpu.policy.proc_subpolicies import create_proc_subpolicy
+from namazu_tpu.signal.action import ProcSetSchedAction, ShellAction
+from namazu_tpu.signal.event import Event, ProcSetEvent
+from namazu_tpu.utils.config import parse_duration
+
+
+class RandomPolicy(QueueBackedPolicy):
+    NAME = "random"
+
+    PRIORITIZED_SPEEDUP = 0.8  # parity: randompolicy.go:332-346
+
+    def __init__(self, seed: Optional[int] = None) -> None:
+        super().__init__(seed=None if seed is None else seed + 1)
+        self.rng = random.Random(seed)
+        self.min_interval = 0.0
+        self.max_interval = 0.0
+        self.prioritized_entities: set[str] = set()
+        self.fault_action_probability = 0.0
+        self.shell_action_interval = 0.0
+        self.shell_action_command = ""
+        self.proc_policy_name = "mild"
+        self._proc_policy = create_proc_subpolicy("mild", self.rng)
+        self._stop = threading.Event()
+        self._shell_thread: Optional[threading.Thread] = None
+
+    def load_config(self, config) -> None:
+        p = config.policy_param
+        seed = p("seed", None)
+        if seed is not None:
+            self.rng.seed(int(seed))
+            self._queue.reseed(int(seed) + 1)
+        self.min_interval = parse_duration(p("min_interval", 0))
+        self.max_interval = parse_duration(p("max_interval", 0))
+        if self.max_interval < self.min_interval:
+            self.max_interval = self.min_interval
+        self.prioritized_entities = set(p("prioritized_entities", []) or [])
+        self.fault_action_probability = float(p("fault_action_probability", 0.0))
+        self.shell_action_interval = parse_duration(p("shell_action_interval", 0))
+        self.shell_action_command = str(p("shell_action_command", "") or "")
+        name = str(p("proc_policy", self.proc_policy_name))
+        self.proc_policy_name = name
+        self._proc_policy = create_proc_subpolicy(name, self.rng)
+        self._proc_policy.load_params(p("proc_policy_param", {}) or {})
+
+    # -- event intake ----------------------------------------------------
+
+    def queue_event(self, event: Event) -> None:
+        self.start()
+        if isinstance(event, ProcSetEvent):
+            # answered immediately; the *content* is the fuzz, not the delay
+            attrs = self._proc_policy.attrs_for(event.pids)
+            self._emit(ProcSetSchedAction.for_procset(event, attrs))
+            return
+        lo, hi = self.min_interval, self.max_interval
+        if event.entity_id in self.prioritized_entities:
+            lo *= self.PRIORITIZED_SPEEDUP
+            hi *= self.PRIORITIZED_SPEEDUP
+        self._queue.put(event, lo, hi)
+
+    # -- workers ---------------------------------------------------------
+
+    def start(self) -> None:
+        super().start()
+        if (
+            self._shell_thread is None
+            and self.shell_action_interval > 0
+            and self.shell_action_command
+        ):
+            self._shell_thread = self._spawn(self._shell_loop, "shell")
+
+    def _action_for(self, event: Event):
+        # parity: makeActionForEvent, randompolicy.go:300-317
+        if self.fault_action_probability > 0 and (
+            self.rng.random() < self.fault_action_probability
+        ):
+            fault = event.default_fault_action()
+            if fault is not None:
+                return fault
+        return event.default_action()
+
+    def _shell_loop(self) -> None:
+        while not self._stop.wait(self.shell_action_interval):
+            self._emit(ShellAction.create(self.shell_action_command))
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        super().shutdown()
+
+
+register_policy(RandomPolicy.NAME, RandomPolicy)

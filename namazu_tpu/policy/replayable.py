@@ -1,0 +1,69 @@
+"""Replayable policy: semi-deterministic delays hashed from replay hints.
+
+Parity: /root/reference/nmz/explorepolicy/replayable/replayablepolicy.go:
+100-126 — delay = fnv64a(seed || event.replay_hint()) % max_interval, so a
+run can be replayed without recording anything: same seed + same semantic
+event stream => same relative delays => (approximately) the same
+interleaving. The seed is overridable via the NMZ_TPU_REPLAY_SEED
+environment variable (reference: NMZ_REPLAY_SEED).
+
+This hint->delay table is exactly the representation the TPU search plane
+optimizes: the tpu_search policy generalizes this policy by *learning* the
+per-hint delays instead of hashing them.
+"""
+
+from __future__ import annotations
+
+import os
+
+from namazu_tpu.policy.base import QueueBackedPolicy, register_policy
+from namazu_tpu.signal.event import Event
+from namazu_tpu.utils.config import parse_duration
+
+FNV64_OFFSET = 0xCBF29CE484222325
+FNV64_PRIME = 0x100000001B3
+
+
+def fnv64a(data: bytes) -> int:
+    h = FNV64_OFFSET
+    for b in data:
+        h ^= b
+        h = (h * FNV64_PRIME) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def hint_delay(seed: str, hint: str, max_interval: float) -> float:
+    """Deterministic delay in [0, max_interval) for a replay hint."""
+    if max_interval <= 0:
+        return 0.0
+    h = fnv64a((seed + "\x00" + hint).encode())
+    # quantize to ms like the reference (delays are ms-granular)
+    max_ms = max(1, int(max_interval * 1000))
+    return (h % max_ms) / 1000.0
+
+
+class ReplayablePolicy(QueueBackedPolicy):
+    NAME = "replayable"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.seed = os.environ.get("NMZ_TPU_REPLAY_SEED", "0")
+        self.max_interval = 0.1
+
+    def load_config(self, config) -> None:
+        p = config.policy_param
+        self.max_interval = parse_duration(p("max_interval", 100))
+        seed = p("seed", None)
+        env_seed = os.environ.get("NMZ_TPU_REPLAY_SEED")
+        if env_seed is not None:
+            self.seed = env_seed
+        elif seed is not None:
+            self.seed = str(seed)
+
+    def queue_event(self, event: Event) -> None:
+        self.start()
+        delay = hint_delay(self.seed, event.replay_hint(), self.max_interval)
+        self._queue.put_at(event, delay)
+
+
+register_policy(ReplayablePolicy.NAME, ReplayablePolicy)

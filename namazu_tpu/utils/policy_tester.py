@@ -1,0 +1,63 @@
+"""Reusable policy test harness.
+
+Parity: /root/reference/nmz/util/explorepolicytester/explorepolicytester.go:
+32-68 — pump N packet events across K entities through any policy, both
+sequentially and concurrently (deadlock-freedom), and collect the answering
+actions.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List
+
+from namazu_tpu.policy.base import ExplorePolicy
+from namazu_tpu.signal.action import Action
+from namazu_tpu.signal.event import PacketEvent
+
+
+def make_packet_events(n: int, entities: int) -> List[PacketEvent]:
+    return [
+        PacketEvent.create(
+            f"entity-{i % entities}",
+            src_entity=f"entity-{i % entities}",
+            dst_entity=f"entity-{(i + 1) % entities}",
+            hint=f"test:{i}",
+        )
+        for i in range(n)
+    ]
+
+
+def drain_actions(policy: ExplorePolicy, n: int, timeout: float = 30.0) -> List[Action]:
+    out: List[Action] = []
+    for _ in range(n):
+        out.append(policy.action_out.get(timeout=timeout))
+    return out
+
+
+def pump_sequential(policy: ExplorePolicy, n: int, entities: int = 3) -> List[Action]:
+    """Send one event, await its action, repeat."""
+    actions: List[Action] = []
+    for ev in make_packet_events(n, entities):
+        policy.queue_event(ev)
+        actions.extend(drain_actions(policy, 1))
+    return actions
+
+
+def pump_concurrent(policy: ExplorePolicy, n: int, entities: int = 3) -> List[Action]:
+    """Send all events before receiving any action (ShouldNotBlock)."""
+    events = make_packet_events(n, entities)
+    collected: "queue.Queue[Action]" = queue.Queue()
+
+    def collector() -> None:
+        for _ in range(n):
+            collected.put(policy.action_out.get(timeout=30.0))
+
+    t = threading.Thread(target=collector, daemon=True)
+    t.start()
+    for ev in events:
+        policy.queue_event(ev)
+    t.join(timeout=60.0)
+    assert not t.is_alive(), "policy deadlocked: actions not delivered"
+    return [collected.get_nowait() for _ in range(n)]
