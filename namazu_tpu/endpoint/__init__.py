@@ -1,0 +1,13 @@
+"""Orchestrator-side endpoints: where inspector events arrive and actions
+are dispatched back.
+
+Capability parity with /root/reference/nmz/endpoint (endpoint.go:63-144):
+a hub merges event streams from all transports (local in-process, REST
+HTTP, framed-TCP guest agent) into one queue, remembers which transport
+each entity spoke on, and routes actions back over the right one.
+"""
+
+from namazu_tpu.endpoint.hub import EndpointHub, Endpoint
+from namazu_tpu.endpoint.local import LocalEndpoint
+
+__all__ = ["EndpointHub", "Endpoint", "LocalEndpoint"]

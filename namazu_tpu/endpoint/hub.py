@@ -1,0 +1,92 @@
+"""EndpointHub: merge inbound events, route outbound actions.
+
+Parity: the endpoint mux (/root/reference/nmz/endpoint/endpoint.go:63-144) —
+``registerEntityEndpointType`` + ``dispatchAction``. Transports register
+themselves; the hub learns entity->transport on each inbound event and uses
+that table to dispatch actions. Unroutable actions are dropped with a log
+line (the reference panics; dropping is friendlier for long experiments).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Optional
+
+from namazu_tpu.signal.action import Action
+from namazu_tpu.signal.control import Control
+from namazu_tpu.signal.event import Event
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("endpoint")
+
+
+class Endpoint:
+    """Interface for a transport endpoint."""
+
+    NAME = "abstract"
+
+    def attach(self, hub: "EndpointHub") -> None:
+        self.hub = hub
+
+    def start(self) -> None:
+        pass
+
+    def send_action(self, action: Action) -> None:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class EndpointHub:
+    def __init__(self) -> None:
+        self.event_queue: "queue.Queue[Event]" = queue.Queue()
+        self.control_queue: "queue.Queue[Control]" = queue.Queue()
+        self._endpoints: Dict[str, Endpoint] = {}
+        self._entity_route: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    # -- endpoint registration ------------------------------------------
+
+    def add_endpoint(self, ep: Endpoint) -> None:
+        ep.attach(self)
+        self._endpoints[ep.NAME] = ep
+
+    def endpoint(self, name: str) -> Optional[Endpoint]:
+        return self._endpoints.get(name)
+
+    def start(self) -> None:
+        for ep in self._endpoints.values():
+            ep.start()
+
+    def shutdown(self) -> None:
+        for ep in self._endpoints.values():
+            ep.shutdown()
+
+    # -- inbound (transports call these) --------------------------------
+
+    def post_event(self, event: Event, endpoint_name: str) -> None:
+        with self._lock:
+            prev = self._entity_route.get(event.entity_id)
+            if prev is not None and prev != endpoint_name:
+                log.warning(
+                    "entity %s moved endpoint %s -> %s",
+                    event.entity_id, prev, endpoint_name,
+                )
+            self._entity_route[event.entity_id] = endpoint_name
+        event.mark_arrived()
+        self.event_queue.put(event)
+
+    def post_control(self, control: Control) -> None:
+        self.control_queue.put(control)
+
+    # -- outbound (orchestrator calls this) -----------------------------
+
+    def send_action(self, action: Action) -> None:
+        with self._lock:
+            name = self._entity_route.get(action.entity_id)
+        if name is None:
+            log.warning("no endpoint for entity %s; dropping %r", action.entity_id, action)
+            return
+        self._endpoints[name].send_action(action)

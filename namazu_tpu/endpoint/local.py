@@ -1,0 +1,52 @@
+"""Local (in-process) endpoint.
+
+Parity: /root/reference/nmz/endpoint/local/localendpoint.go — the
+pure-channel bridge used by autopilot mode and every in-process test.
+Inspector-side local transceivers register an action sink per entity;
+events are posted straight into the hub.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict
+
+from namazu_tpu.endpoint.hub import Endpoint
+from namazu_tpu.signal.action import Action
+from namazu_tpu.signal.event import Event
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("endpoint.local")
+
+ActionSink = Callable[[Action], None]
+
+
+class LocalEndpoint(Endpoint):
+    NAME = "local"
+
+    def __init__(self) -> None:
+        self._sinks: Dict[str, ActionSink] = {}
+        self._lock = threading.Lock()
+
+    # inspector side ----------------------------------------------------
+
+    def connect(self, entity_id: str, sink: ActionSink) -> None:
+        with self._lock:
+            self._sinks[entity_id] = sink
+
+    def disconnect(self, entity_id: str) -> None:
+        with self._lock:
+            self._sinks.pop(entity_id, None)
+
+    def post_event(self, event: Event) -> None:
+        self.hub.post_event(event, self.NAME)
+
+    # orchestrator side -------------------------------------------------
+
+    def send_action(self, action: Action) -> None:
+        with self._lock:
+            sink = self._sinks.get(action.entity_id)
+        if sink is None:
+            log.warning("local: no sink for entity %s", action.entity_id)
+            return
+        sink(action)

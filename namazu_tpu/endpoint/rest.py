@@ -1,0 +1,222 @@
+"""REST endpoint: the HTTP wire for out-of-process inspectors.
+
+Capability parity with /root/reference/nmz/endpoint/rest
+(restendpoint.go:71-223, queue/restqueue.go:20-135), API root ``/api/v3``
+(util/rest/restutil.go:16):
+
+* ``POST /api/v3/events/{entity}/{uuid}``   — submit an event (non-blocking)
+* ``GET /api/v3/actions/{entity}``          — long-poll the next action;
+  idempotent (RFC 7231): repeated GETs return the same head until deleted;
+  a newer concurrent poll supersedes an older one (the older returns 204)
+* ``DELETE /api/v3/actions/{entity}/{uuid}``— acknowledge/remove an action
+* ``POST /api/v3/control?op=enableOrchestration|disableOrchestration``
+
+Implementation: stdlib ThreadingHTTPServer — one thread per in-flight
+request, which long-polling requires anyway; no third-party HTTP stack.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib.parse import urlparse, parse_qs
+
+from namazu_tpu.endpoint.hub import Endpoint
+from namazu_tpu.signal.action import Action
+from namazu_tpu.signal.base import SignalError, signal_from_jsonable
+from namazu_tpu.signal.control import Control, ControlOp
+from namazu_tpu.signal.event import Event
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("endpoint.rest")
+
+API_ROOT = "/api/v3"
+
+_EVENTS_RE = re.compile(rf"^{API_ROOT}/events/([^/]+)/([^/]+)$")
+_ACTIONS_RE = re.compile(rf"^{API_ROOT}/actions/([^/]+)(?:/([^/]+))?$")
+_CONTROL_RE = re.compile(rf"^{API_ROOT}/control$")
+
+
+class ActionQueue:
+    """Per-entity deletable action queue with blocking peek.
+
+    Parity: /root/reference/nmz/endpoint/rest/queue/restqueue.go:20-135 —
+    ``peek`` blocks until non-empty; a newer concurrent peek supersedes the
+    older one; ``delete`` acknowledges by uuid.
+    """
+
+    def __init__(self) -> None:
+        self._items: List[Action] = []
+        self._cond = threading.Condition()
+        self._peek_gen = 0
+
+    def put(self, action: Action) -> None:
+        with self._cond:
+            self._items.append(action)
+            self._cond.notify_all()
+
+    def peek(self, timeout: float = 30.0) -> Optional[Action]:
+        """Return (without removing) the head action, blocking up to
+        ``timeout``. Returns None on timeout or when superseded by a newer
+        peek."""
+        with self._cond:
+            self._peek_gen += 1
+            my_gen = self._peek_gen
+            self._cond.notify_all()  # wake any older poller so it can yield
+            end = threading.TIMEOUT_MAX if timeout is None else None
+            import time as _time
+
+            deadline = None if end else _time.monotonic() + timeout
+            while True:
+                if self._items:
+                    return self._items[0]
+                if my_gen != self._peek_gen:
+                    return None  # superseded
+                remaining = None if deadline is None else deadline - _time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(remaining)
+
+    def delete(self, uuid: str) -> bool:
+        with self._cond:
+            for i, a in enumerate(self._items):
+                if a.uuid == uuid:
+                    del self._items[i]
+                    self._cond.notify_all()
+                    return True
+            return False
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._items)
+
+
+class RestEndpoint(Endpoint):
+    NAME = "rest"
+
+    def __init__(self, port: int = 10080, host: str = "127.0.0.1",
+                 poll_timeout: float = 30.0):
+        self._host = host
+        self._port = port
+        self.poll_timeout = poll_timeout
+        self._queues: Dict[str, ActionQueue] = {}
+        self._queues_lock = threading.Lock()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        if self._server is not None:
+            return self._server.server_address[1]
+        return self._port
+
+    def start(self) -> None:
+        endpoint = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route to our logger
+                log.debug("http: " + fmt, *args)
+
+            def _reply(self, code: int, body: Optional[dict] = None) -> None:
+                data = json.dumps(body).encode() if body is not None else b""
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                if data:
+                    self.wfile.write(data)
+
+            def _read_body(self) -> bytes:
+                length = int(self.headers.get("Content-Length") or 0)
+                return self.rfile.read(length) if length else b""
+
+            def do_POST(self) -> None:
+                url = urlparse(self.path)
+                m = _EVENTS_RE.match(url.path)
+                if m:
+                    return self._post_event(m.group(1), m.group(2))
+                if _CONTROL_RE.match(url.path):
+                    return self._post_control(parse_qs(url.query))
+                self._reply(404, {"error": f"no route {url.path}"})
+
+            def _post_event(self, entity: str, uuid: str) -> None:
+                try:
+                    sig = signal_from_jsonable(json.loads(self._read_body()))
+                except (SignalError, ValueError) as e:
+                    return self._reply(400, {"error": str(e)})
+                if not isinstance(sig, Event):
+                    return self._reply(400, {"error": "signal is not an event"})
+                if sig.entity_id != entity or sig.uuid != uuid:
+                    return self._reply(
+                        400,
+                        {"error": "url entity/uuid do not match event body"},
+                    )
+                endpoint.hub.post_event(sig, endpoint.NAME)
+                self._reply(200, {})
+
+            def _post_control(self, query: Dict[str, list]) -> None:
+                ops = query.get("op") or []
+                try:
+                    op = ControlOp(ops[0] if ops else "")
+                except ValueError:
+                    return self._reply(
+                        400, {"error": f"bad op {ops!r}; known: "
+                              f"{[o.value for o in ControlOp]}"}
+                    )
+                endpoint.hub.post_control(Control(op))
+                self._reply(200, {})
+
+            def do_GET(self) -> None:
+                url = urlparse(self.path)
+                m = _ACTIONS_RE.match(url.path)
+                if not (m and m.group(2) is None):
+                    return self._reply(404, {"error": f"no route {url.path}"})
+                entity = m.group(1)
+                action = endpoint._queue_for(entity).peek(endpoint.poll_timeout)
+                if action is None:
+                    return self._reply(204)
+                self._reply(200, action.to_jsonable())
+
+            def do_DELETE(self) -> None:
+                url = urlparse(self.path)
+                m = _ACTIONS_RE.match(url.path)
+                if not (m and m.group(2)):
+                    return self._reply(404, {"error": f"no route {url.path}"})
+                entity, uuid = m.group(1), m.group(2)
+                if endpoint._queue_for(entity).delete(uuid):
+                    self._reply(200, {})
+                else:
+                    self._reply(404, {"error": f"no action {uuid} for {entity}"})
+
+        self._server = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="rest-endpoint", daemon=True
+        )
+        self._thread.start()
+        log.info("REST endpoint on %s:%d%s", self._host, self.port, API_ROOT)
+
+    def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    # -- action dispatch -------------------------------------------------
+
+    def _queue_for(self, entity: str) -> ActionQueue:
+        with self._queues_lock:
+            q = self._queues.get(entity)
+            if q is None:
+                q = self._queues[entity] = ActionQueue()
+            return q
+
+    def send_action(self, action: Action) -> None:
+        self._queue_for(action.entity_id).put(action)

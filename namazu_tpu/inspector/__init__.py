@@ -1,0 +1,2 @@
+"""Inspector plane: transceivers plus the concrete event interceptors
+(proc, fs, ethernet)."""

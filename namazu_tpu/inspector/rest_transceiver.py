@@ -1,0 +1,97 @@
+"""REST transceiver: the HTTP client side.
+
+Parity: /root/reference/nmz/inspector/transceiver/resttransceiver.go —
+``POST`` events non-blockingly; one receive thread long-polls
+``GET /actions/{entity}``, acknowledges with ``DELETE``, and dispatches the
+action to the per-event waiter queue; linear backoff on transport errors
+(resttransceiver.go:158-188).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from namazu_tpu.endpoint.rest import API_ROOT
+from namazu_tpu.inspector.transceiver import Transceiver
+from namazu_tpu.signal.action import Action
+from namazu_tpu.signal.base import signal_from_jsonable
+from namazu_tpu.signal.event import Event
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("transceiver.rest")
+
+
+class RestTransceiver(Transceiver):
+    def __init__(self, entity_id: str, orchestrator_url: str,
+                 backoff_step: float = 0.5, backoff_max: float = 5.0):
+        super().__init__(entity_id)
+        self.base = orchestrator_url.rstrip("/") + API_ROOT
+        self.backoff_step = backoff_step
+        self.backoff_max = backoff_max
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- outbound --------------------------------------------------------
+
+    def _post(self, event: Event) -> None:
+        url = f"{self.base}/events/{event.entity_id}/{event.uuid}"
+        req = urllib.request.Request(
+            url,
+            data=event.to_json().encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            if resp.status != 200:
+                raise RuntimeError(f"POST {url} -> {resp.status}")
+
+    # -- inbound ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._receive_loop,
+                name=f"rest-recv-{self.entity_id}",
+                daemon=True,
+            )
+            self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+
+    def _receive_loop(self) -> None:
+        backoff = 0.0
+        while not self._stop.is_set():
+            try:
+                action = self._poll_once()
+                backoff = 0.0
+            except (urllib.error.URLError, OSError, RuntimeError) as e:
+                backoff = min(backoff + self.backoff_step, self.backoff_max)
+                log.debug("poll error (%s); backing off %.1fs", e, backoff)
+                self._stop.wait(backoff)
+                continue
+            if action is not None:
+                self.dispatch_action(action)
+
+    def _poll_once(self) -> Action | None:
+        url = f"{self.base}/actions/{self.entity_id}"
+        req = urllib.request.Request(url, method="GET")
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            if resp.status == 204:
+                return None
+            body = resp.read()
+        d = json.loads(body)
+        action = signal_from_jsonable(d)
+        if not isinstance(action, Action):
+            raise RuntimeError(f"GET {url} returned non-action {d!r}")
+        # acknowledge (parity: GET then DELETE, resttransceiver.go:139-156)
+        del_req = urllib.request.Request(
+            f"{url}/{action.uuid}", method="DELETE"
+        )
+        with urllib.request.urlopen(del_req, timeout=30):
+            pass
+        return action

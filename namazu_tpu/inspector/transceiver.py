@@ -1,0 +1,121 @@
+"""Transceivers: the inspector-side RPC clients.
+
+Parity: /root/reference/nmz/inspector/transceiver (transceiver.go:15-31):
+``send_event`` registers a per-event action queue *before* the event leaves
+the process (closing the race noted in localtransceiver.go:40-44), returns
+that queue, and a receive loop correlates incoming actions back by their
+``event_uuid``.
+
+``new_transceiver(url, entity_id)`` dispatches on scheme: ``local://`` for
+the in-process endpoint (autopilot/tests), ``http(s)://`` for REST.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Optional
+
+from namazu_tpu.endpoint.local import LocalEndpoint
+from namazu_tpu.signal.action import Action
+from namazu_tpu.signal.event import Event
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("transceiver")
+
+
+class Transceiver:
+    def __init__(self, entity_id: str):
+        self.entity_id = entity_id
+        self._waiters: Dict[str, "queue.Queue[Action]"] = {}
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        pass
+
+    def shutdown(self) -> None:
+        pass
+
+    def send_event(self, event: Event) -> "queue.Queue[Action]":
+        """Send ``event``; returns a queue that will receive the answering
+        action(s). The queue is registered before sending.
+
+        Only use for events whose answer is *propagated* back (deferred
+        events, and ProcSetEvent which is answered out-of-band). For
+        observation-only events (LogEvent, NopEvent) use
+        :meth:`send_notification` — their default NopAction is
+        orchestrator-side-only and never comes back, so a registered
+        waiter would leak.
+        """
+        ch: "queue.Queue[Action]" = queue.Queue()
+        with self._lock:
+            self._waiters[event.uuid] = ch
+        try:
+            self._post(event)
+        except Exception:
+            with self._lock:
+                self._waiters.pop(event.uuid, None)
+            raise
+        return ch
+
+    def send_notification(self, event: Event) -> None:
+        """Send an observation-only event without awaiting any action."""
+        self._post(event)
+
+    def forget(self, event: Event) -> None:
+        """Drop the waiter for ``event`` (e.g. after a local timeout)."""
+        with self._lock:
+            self._waiters.pop(event.uuid, None)
+
+    def _post(self, event: Event) -> None:
+        raise NotImplementedError
+
+    # called by the receive path
+    def dispatch_action(self, action: Action) -> None:
+        with self._lock:
+            ch = self._waiters.pop(action.event_uuid, None)
+        if ch is None:
+            log.warning(
+                "%s: action for unknown event %s (%r)",
+                self.entity_id, action.event_uuid[:8], action,
+            )
+            return
+        ch.put(action)
+
+
+class LocalTransceiver(Transceiver):
+    """In-process transceiver over a LocalEndpoint."""
+
+    def __init__(self, entity_id: str, endpoint: LocalEndpoint):
+        super().__init__(entity_id)
+        self._endpoint = endpoint
+
+    def start(self) -> None:
+        self._endpoint.connect(self.entity_id, self.dispatch_action)
+
+    def shutdown(self) -> None:
+        self._endpoint.disconnect(self.entity_id)
+
+    def _post(self, event: Event) -> None:
+        if event.entity_id != self.entity_id:
+            raise ValueError(
+                f"event entity {event.entity_id!r} != transceiver {self.entity_id!r}"
+            )
+        self._endpoint.post_event(event)
+
+
+def new_transceiver(
+    url: str,
+    entity_id: str,
+    local_endpoint: Optional[LocalEndpoint] = None,
+) -> Transceiver:
+    """Factory, parity transceiver.go:21-31."""
+    if url.startswith("local://"):
+        if local_endpoint is None:
+            raise ValueError("local:// requires a LocalEndpoint instance")
+        return LocalTransceiver(entity_id, local_endpoint)
+    if url.startswith(("http://", "https://")):
+        from namazu_tpu.inspector.rest_transceiver import RestTransceiver
+
+        return RestTransceiver(entity_id, url)
+    raise ValueError(f"unsupported transceiver url {url!r}")

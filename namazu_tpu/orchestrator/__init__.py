@@ -1,0 +1,5 @@
+"""Orchestrator: the core runtime tying endpoints to the policy."""
+
+from namazu_tpu.orchestrator.core import Orchestrator, AutopilotOrchestrator
+
+__all__ = ["Orchestrator", "AutopilotOrchestrator"]

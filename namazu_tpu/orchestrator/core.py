@@ -1,0 +1,211 @@
+"""The orchestrator runtime.
+
+Capability parity with /root/reference/nmz/orchestrator/orchestrator.go:
+three worker threads around queues —
+
+* **event thread**: pulls merged inbound events from the EndpointHub and
+  feeds the active policy (the configured one while orchestration is
+  enabled, an always-instantiated passthrough ``dumb`` policy while
+  disabled — parity orchestrator.go:43-45, 84-94);
+* **action thread**: drains policy actions, stamps ``triggered_time``,
+  executes orchestrator-side actions in-process, forwards the rest to the
+  hub for dispatch, and appends everything to the trace when
+  ``collect_trace`` (parity orchestrator.go:96-179);
+* **control thread**: toggles enable/disable from REST ``/control``
+  (parity orchestrator.go:181-199; config key ``skip_init_orchestration``).
+
+``shutdown()`` stops the loops and returns the accumulated
+:class:`SingleTrace` (parity orchestrator.go:207-220).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+from namazu_tpu.endpoint.hub import EndpointHub
+from namazu_tpu.endpoint.local import LocalEndpoint
+from namazu_tpu.policy.base import POLICY_DONE, ExplorePolicy, create_policy
+from namazu_tpu.signal.action import Action
+from namazu_tpu.signal.control import ControlOp
+from namazu_tpu.utils.config import Config
+from namazu_tpu.utils.log import get_logger
+from namazu_tpu.utils.trace import SingleTrace
+
+log = get_logger("orchestrator")
+
+_STOP = object()
+_FWD_DONE = object()
+
+
+class Orchestrator:
+    def __init__(
+        self,
+        config: Config,
+        policy: ExplorePolicy,
+        collect_trace: bool = False,
+        hub: Optional[EndpointHub] = None,
+    ):
+        self.config = config
+        self.policy = policy
+        self.collect_trace = collect_trace
+        self.trace = SingleTrace()
+        # the passthrough policy used while orchestration is disabled
+        self.dumb = create_policy("dumb")
+        self.enabled = not bool(config.get("skip_init_orchestration"))
+        self.hub = hub or self._default_hub(config)
+        self.local_endpoint: Optional[LocalEndpoint] = None
+        ep = self.hub.endpoint("local")
+        if isinstance(ep, LocalEndpoint):
+            self.local_endpoint = ep
+        self._threads: dict[str, threading.Thread] = {}
+        self._merged_actions: "queue.Queue[object]" = queue.Queue()
+        self._n_policies = 2  # policy + dumb; the action loop exits after
+        # receiving this many _FWD_DONE markers
+        self._started = False
+        self._shut_down = False
+
+    @staticmethod
+    def _default_hub(config: Config) -> EndpointHub:
+        """Local endpoint always; REST / guest-agent endpoints when their
+        ports are enabled (parity: endpoint.StartAll, endpoint.go:63-97)."""
+        hub = EndpointHub()
+        hub.add_endpoint(LocalEndpoint())
+        rest_port = int(config.get("rest_port", -1))
+        if rest_port >= 0:
+            from namazu_tpu.endpoint.rest import RestEndpoint
+
+            hub.add_endpoint(RestEndpoint(port=rest_port))
+        agent_port = int(config.get("agent_port", -1))
+        if agent_port >= 0:
+            try:
+                from namazu_tpu.endpoint.agent import AgentEndpoint
+            except ImportError as e:
+                raise NotImplementedError(
+                    "guest-agent endpoint not available in this build"
+                ) from e
+            hub.add_endpoint(AgentEndpoint(port=agent_port))
+        return hub
+
+    def _add_thread(self, target, name: str) -> None:
+        t = threading.Thread(target=target, name=f"orc-{name}", daemon=True)
+        t.start()
+        self._threads[name] = t
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self.hub.start()
+        self.policy.start()
+        self.dumb.start()
+        self._add_thread(self._event_loop, "events")
+        self._add_thread(self._action_loop, "actions")
+        self._add_thread(self._control_loop, "control")
+        self._add_thread(self._forward_loop_factory(self.policy), "fwd-policy")
+        self._add_thread(self._forward_loop_factory(self.dumb), "fwd-dumb")
+        log.debug("orchestrator started (enabled=%s)", self.enabled)
+
+    def shutdown(self) -> SingleTrace:
+        """Stop all loops, flushing in dependency order so no action is
+        lost: event intake first, then policies (which release their still-
+        delayed events immediately and emit POLICY_DONE), then the forward
+        and action loops drain everything before exiting."""
+        if self._shut_down:
+            return self.trace
+        self._shut_down = True
+        if not self._started:
+            return self.trace
+        # 1. stop event intake (events already inbound are forwarded first)
+        self.hub.event_queue.put(_STOP)  # type: ignore[arg-type]
+        self._threads["events"].join(timeout=10)
+        # 2. flush the policies; their dequeue workers emit remaining
+        #    actions and then POLICY_DONE
+        self.policy.shutdown()
+        self.dumb.shutdown()
+        # 3. forward loops exit on POLICY_DONE after draining; the action
+        #    loop exits after both _FWD_DONE markers
+        self._threads["fwd-policy"].join(timeout=10)
+        self._threads["fwd-dumb"].join(timeout=10)
+        self._threads["actions"].join(timeout=10)
+        # 4. control loop + transports
+        self.hub.control_queue.put(_STOP)  # type: ignore[arg-type]
+        self._threads["control"].join(timeout=10)
+        self.hub.shutdown()
+        log.debug("orchestrator shut down; trace length %d", len(self.trace))
+        return self.trace
+
+    # -- loops -----------------------------------------------------------
+
+    def _event_loop(self) -> None:
+        while True:
+            ev = self.hub.event_queue.get()
+            if ev is _STOP:
+                return
+            target = self.policy if self.enabled else self.dumb
+            try:
+                target.queue_event(ev)
+            except Exception:
+                log.exception("policy %s rejected event %r", target.name, ev)
+
+    def _forward_loop_factory(self, policy: ExplorePolicy):
+        def loop() -> None:
+            while True:
+                action = policy.action_out.get()
+                if action is POLICY_DONE:
+                    self._merged_actions.put(_FWD_DONE)
+                    return
+                self._merged_actions.put(action)
+
+        return loop
+
+    def _action_loop(self) -> None:
+        done = 0
+        while True:
+            item = self._merged_actions.get()
+            if item is _FWD_DONE:
+                done += 1
+                if done == self._n_policies:
+                    return
+                continue
+            action: Action = item  # type: ignore[assignment]
+            action.mark_triggered()
+            if self.collect_trace:
+                self.trace.append(action)
+            if action.orchestrator_side_only:
+                try:
+                    action.execute_on_orchestrator()
+                except Exception:
+                    log.exception("orchestrator-side action failed: %r", action)
+            else:
+                self.hub.send_action(action)
+
+    def _control_loop(self) -> None:
+        while True:
+            ctrl = self.hub.control_queue.get()
+            if ctrl is _STOP:
+                return
+            if ctrl.op is ControlOp.ENABLE_ORCHESTRATION:
+                self.enabled = True
+            elif ctrl.op is ControlOp.DISABLE_ORCHESTRATION:
+                self.enabled = False
+            log.info("orchestration enabled=%s", self.enabled)
+
+
+class AutopilotOrchestrator(Orchestrator):
+    """Embedded orchestrator for `local://` inspectors.
+
+    Parity: NewAutopilotOrchestrator
+    (/root/reference/nmz/util/orchestrator/orchestratorutil.go:26-38):
+    builds policy from config, local endpoint only, no trace collection.
+    """
+
+    def __init__(self, config: Config):
+        policy = create_policy(config.get("explore_policy"))
+        policy.load_config(config)
+        hub = EndpointHub()
+        hub.add_endpoint(LocalEndpoint())
+        super().__init__(config, policy, collect_trace=False, hub=hub)

@@ -1,0 +1,43 @@
+"""MockOrchestrator: echoes every event's default action, no policy.
+
+Parity: /root/reference/nmz/util/mockorchestrator/mockorchestrator.go:20-105.
+Used to test inspectors and endpoints in isolation.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from namazu_tpu.endpoint.hub import EndpointHub
+from namazu_tpu.signal.event import Event
+
+_STOP = object()
+
+
+class MockOrchestrator:
+    def __init__(self, hub: EndpointHub):
+        self.hub = hub
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self.hub.start()
+        self._thread = threading.Thread(target=self._loop, name="mock-orc", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            ev = self.hub.event_queue.get()
+            if ev is _STOP:
+                return
+            assert isinstance(ev, Event)
+            action = ev.default_action()
+            action.mark_triggered()
+            if not action.orchestrator_side_only:
+                self.hub.send_action(action)
+
+    def shutdown(self) -> None:
+        self.hub.event_queue.put(_STOP)  # type: ignore[arg-type]
+        if self._thread:
+            self._thread.join(timeout=5)
+        self.hub.shutdown()

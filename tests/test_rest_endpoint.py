@@ -1,0 +1,223 @@
+"""REST endpoint + transceiver integration over loopback HTTP.
+
+Parity: /root/reference/nmz/endpoint/endpoint_test.go:36-160 and
+rest/restendpoint_test.go — real HTTP on an auto-assigned port, a
+MockOrchestrator echoing default actions, mixed local+REST entities,
+idempotent GET, DELETE acks, and control ops.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from namazu_tpu.endpoint.hub import EndpointHub
+from namazu_tpu.endpoint.local import LocalEndpoint
+from namazu_tpu.endpoint.rest import ActionQueue, RestEndpoint
+from namazu_tpu.inspector.transceiver import new_transceiver
+from namazu_tpu.orchestrator import Orchestrator
+from namazu_tpu.policy import create_policy
+from namazu_tpu.signal import EventAcceptanceAction, NopAction, PacketEvent
+from namazu_tpu.utils.config import Config
+from namazu_tpu.utils.mock_orchestrator import MockOrchestrator
+
+
+@pytest.fixture
+def rest_hub():
+    hub = EndpointHub()
+    hub.add_endpoint(LocalEndpoint())
+    rest = RestEndpoint(port=0, poll_timeout=2.0)
+    hub.add_endpoint(rest)
+    mock = MockOrchestrator(hub)
+    mock.start()
+    yield hub, rest
+    mock.shutdown()
+
+
+def _url(rest, path):
+    return f"http://127.0.0.1:{rest.port}/api/v3{path}"
+
+
+def test_event_action_roundtrip_over_http(rest_hub):
+    hub, rest = rest_hub
+    trans = new_transceiver(f"http://127.0.0.1:{rest.port}", "r0")
+    trans.start()
+    try:
+        ev = PacketEvent.create("r0", "r0", "peer")
+        ch = trans.send_event(ev)
+        act = ch.get(timeout=10)
+        assert isinstance(act, EventAcceptanceAction)
+        assert act.event_uuid == ev.uuid
+    finally:
+        trans.shutdown()
+
+
+def test_many_events_multiple_rest_entities(rest_hub):
+    hub, rest = rest_hub
+    n = 20
+    results = {}
+
+    def client(entity):
+        trans = new_transceiver(f"http://127.0.0.1:{rest.port}", entity)
+        trans.start()
+        try:
+            chans = []
+            for i in range(n):
+                chans.append(trans.send_event(PacketEvent.create(entity, entity, "p")))
+            results[entity] = [ch.get(timeout=15) for ch in chans]
+        finally:
+            trans.shutdown()
+
+    entities = [f"rest-{k}" for k in range(3)]
+    threads = [threading.Thread(target=client, args=(e,)) for e in entities]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for e in entities:
+        assert len(results[e]) == n
+
+
+def test_mixed_local_and_rest_entities(rest_hub):
+    hub, rest = rest_hub
+    lep = hub.endpoint("local")
+    local_trans = new_transceiver("local://", "loc0", lep)
+    local_trans.start()
+    rest_trans = new_transceiver(f"http://127.0.0.1:{rest.port}", "rst0")
+    rest_trans.start()
+    try:
+        ch_l = local_trans.send_event(PacketEvent.create("loc0", "a", "b"))
+        ch_r = rest_trans.send_event(PacketEvent.create("rst0", "a", "b"))
+        assert isinstance(ch_l.get(timeout=10), EventAcceptanceAction)
+        assert isinstance(ch_r.get(timeout=10), EventAcceptanceAction)
+    finally:
+        rest_trans.shutdown()
+
+
+def test_get_is_idempotent_until_delete(rest_hub):
+    hub, rest = rest_hub
+    # post an event via raw HTTP, then GET twice without DELETE
+    ev = PacketEvent.create("raw0", "raw0", "peer")
+    req = urllib.request.Request(
+        _url(rest, f"/events/raw0/{ev.uuid}"),
+        data=ev.to_json().encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 200
+
+    def get_action():
+        with urllib.request.urlopen(_url(rest, "/actions/raw0"), timeout=10) as r:
+            assert r.status == 200
+            return json.loads(r.read())
+
+    a1 = get_action()
+    a2 = get_action()
+    assert a1["uuid"] == a2["uuid"]
+    # DELETE acks; second DELETE 404s
+    del_req = urllib.request.Request(
+        _url(rest, f"/actions/raw0/{a1['uuid']}"), method="DELETE"
+    )
+    with urllib.request.urlopen(del_req) as r:
+        assert r.status == 200
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            urllib.request.Request(
+                _url(rest, f"/actions/raw0/{a1['uuid']}"), method="DELETE"
+            )
+        )
+    assert ei.value.code == 404
+
+
+def test_malformed_event_rejected(rest_hub):
+    hub, rest = rest_hub
+    req = urllib.request.Request(
+        _url(rest, "/events/x/y"),
+        data=b'{"class": "NoSuchEvent", "entity": "x"}',
+        method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 400
+
+
+def test_entity_uuid_mismatch_rejected(rest_hub):
+    hub, rest = rest_hub
+    ev = PacketEvent.create("correct", "a", "b")
+    req = urllib.request.Request(
+        _url(rest, "/events/wrong-entity/" + ev.uuid),
+        data=ev.to_json().encode(),
+        method="POST",
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 400
+
+
+def test_control_endpoint_toggles_orchestration():
+    cfg = Config({"rest_port": 0, "skip_init_orchestration": True})
+    policy = create_policy("dumb")
+    orc = Orchestrator(cfg, policy, collect_trace=False)
+    orc.start()
+    rest = orc.hub.endpoint("rest")
+    try:
+        assert not orc.enabled
+        req = urllib.request.Request(
+            _url(rest, "/control?op=enableOrchestration"), method="POST"
+        )
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+        import time
+
+        for _ in range(100):
+            if orc.enabled:
+                break
+            time.sleep(0.01)
+        assert orc.enabled
+        # bad op -> 400
+        bad = urllib.request.Request(_url(rest, "/control?op=bogus"), method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad)
+        assert ei.value.code == 400
+    finally:
+        orc.shutdown()
+
+
+def test_action_queue_newer_peek_supersedes_older():
+    q = ActionQueue()
+    results = []
+
+    def old_peek():
+        results.append(q.peek(timeout=10))
+
+    t = threading.Thread(target=old_peek)
+    t.start()
+    import time
+
+    time.sleep(0.1)
+    # newer peek with short timeout supersedes the old poller
+    assert q.peek(timeout=0.05) is None
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert results == [None]
+
+
+def test_nop_actions_not_propagated_to_rest(rest_hub):
+    """Non-deferred events answered orchestrator-side must not show up in
+    the REST action queue."""
+    hub, rest = rest_hub
+    from namazu_tpu.signal import LogEvent
+
+    ev = LogEvent.create("log0", "something happened")
+    req = urllib.request.Request(
+        _url(rest, f"/events/log0/{ev.uuid}"),
+        data=ev.to_json().encode(),
+        method="POST",
+    )
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 200
+    with urllib.request.urlopen(_url(rest, "/actions/log0"), timeout=10) as r:
+        assert r.status == 204
