@@ -1,0 +1,45 @@
+"""Command-line interface and experiment driver.
+
+Parity: /root/reference/nmz/cli (main.go:35-52) — subcommands ``init``,
+``run``, ``orchestrator``, ``inspectors``, ``tools``. Invoke as
+``python -m namazu_tpu.cli <subcommand> ...`` (or the ``nmz-tpu`` console
+script when installed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from namazu_tpu.cli import (
+        init_cmd,
+        inspectors_cmd,
+        orchestrator_cmd,
+        run_cmd,
+        tools_cmd,
+    )
+
+    parser = argparse.ArgumentParser(
+        prog="nmz-tpu",
+        description="TPU-native programmable fuzzy scheduler for distributed systems",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    init_cmd.register(sub)
+    run_cmd.register(sub)
+    orchestrator_cmd.register(sub)
+    inspectors_cmd.register(sub)
+    tools_cmd.register(sub)
+    return parser
+
+
+def cli_main(argv: Optional[List[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    return args.func(args) or 0
+
+
+def main() -> None:  # console-script entry point
+    sys.exit(cli_main())

@@ -1,0 +1,3 @@
+from namazu_tpu.cli import main
+
+main()

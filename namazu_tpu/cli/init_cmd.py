@@ -1,0 +1,62 @@
+"""``nmz-tpu init [--force] <config> <materials_dir> <storage_dir>``
+
+Parity: /root/reference/nmz/cli/init.go:108-227 — validate the config,
+copy config + materials into the storage dir, create the history storage,
+and run the experiment's ``init`` script once.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+
+from namazu_tpu.policy import create_policy
+from namazu_tpu.storage import new_storage
+from namazu_tpu.utils.cmd import CmdFactory
+from namazu_tpu.utils.config import Config
+
+
+def register(sub) -> None:
+    p = sub.add_parser("init", help="set up an experiment storage directory")
+    p.add_argument("--force", action="store_true",
+                   help="remove an existing storage dir first")
+    p.add_argument("config", help="experiment config (.toml/.json/.yaml)")
+    p.add_argument("materials", help="directory with run/validate/clean scripts")
+    p.add_argument("storage", help="storage directory to create")
+    p.set_defaults(func=run)
+
+
+def run(args) -> int:
+    cfg = Config.from_file(args.config)
+    # fail early on a bad policy name (validation parity: init.go checks
+    # the config before touching the filesystem)
+    policy = create_policy(cfg.get("explore_policy"))
+    policy.load_config(cfg)
+    policy.shutdown()
+
+    if os.path.exists(args.storage):
+        if not args.force:
+            print(f"error: {args.storage} exists (use --force)", file=sys.stderr)
+            return 1
+        shutil.rmtree(args.storage)
+    os.makedirs(args.storage)
+
+    cfg.dump_json(os.path.join(args.storage, "config.json"))
+    shutil.copy2(args.config,
+                 os.path.join(args.storage, os.path.basename(args.config)))
+    materials_dst = os.path.join(args.storage, "materials")
+    shutil.copytree(args.materials, materials_dst)
+
+    storage = new_storage(cfg.get("storage_type"), args.storage)
+    storage.create()
+
+    init_script = cfg.get("init")
+    if init_script:
+        factory = CmdFactory(materials_dir=materials_dst)
+        res = factory.run(init_script, cwd=materials_dst)
+        if res.returncode != 0:
+            print(f"error: init script failed ({res.returncode})", file=sys.stderr)
+            return 1
+    print(f"initialized {args.storage}")
+    return 0

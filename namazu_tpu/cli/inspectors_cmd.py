@@ -1,0 +1,124 @@
+"""``nmz-tpu inspectors proc|fs|ethernet`` — run an inspector process.
+
+Parity: /root/reference/nmz/cli/inspectors (inspectorsutil.go:14-69) —
+common flags ``--orchestrator-url``, ``--entity-id``, ``--autopilot``;
+with ``local://`` as the URL an embedded autopilot orchestrator is started
+in-process (no separate orchestrator needed).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from namazu_tpu.inspector.transceiver import new_transceiver
+from namazu_tpu.utils.config import Config
+from namazu_tpu.utils.log import init_log
+
+
+def register(sub) -> None:
+    p = sub.add_parser("inspectors", help="run an inspector")
+    isub = p.add_subparsers(dest="inspector", required=True)
+
+    pp = isub.add_parser("proc", help="process-scheduling inspector")
+    _common_flags(pp)
+    pp.add_argument("--pid", type=int, default=None, help="root PID to watch")
+    pp.add_argument("--cmd", default=None,
+                    help="spawn this shell command and watch it instead")
+    pp.add_argument("--watch-interval", type=float, default=1.0,
+                    help="seconds between procfs snapshots")
+    pp.set_defaults(func=run_proc)
+
+    pf = isub.add_parser("fs", help="filesystem inspector")
+    _common_flags(pf)
+    pf.add_argument("--mount-point", default=None)
+    pf.add_argument("--original-dir", default=None)
+    pf.set_defaults(func=run_fs)
+
+    pe = isub.add_parser("ethernet", help="ethernet (packet) inspector")
+    _common_flags(pe)
+    pe.add_argument("--listen", default=None,
+                    help="proxy listen address host:port")
+    pe.add_argument("--upstream", default=None,
+                    help="upstream address host:port")
+    pe.set_defaults(func=run_ethernet)
+
+
+def _common_flags(p) -> None:
+    p.add_argument("--orchestrator-url", default="local://",
+                   help="local:// (autopilot) or http://host:port")
+    p.add_argument("--entity-id", default=None)
+    p.add_argument("--autopilot", default=None,
+                   help="config file for the embedded autopilot orchestrator")
+
+
+def _make_transceiver(args, default_entity: str):
+    """Build transceiver (+ autopilot orchestrator for local://)."""
+    entity = args.entity_id or default_entity
+    url = args.orchestrator_url
+    if url.startswith("local://"):
+        from namazu_tpu.orchestrator import AutopilotOrchestrator
+
+        cfg = Config.from_file(args.autopilot) if args.autopilot else Config()
+        orc = AutopilotOrchestrator(cfg)
+        orc.start()
+        trans = new_transceiver(url, entity, orc.local_endpoint)
+        return trans, orc
+    return new_transceiver(url, entity), None
+
+
+def run_proc(args) -> int:
+    init_log()
+    from namazu_tpu.inspector.proc import ProcInspector, serve_with_command
+
+    if (args.pid is None) == (args.cmd is None):
+        print("error: exactly one of --pid / --cmd is required", file=sys.stderr)
+        return 1
+    trans, orc = _make_transceiver(args, "_nmz_proc_inspector")
+    try:
+        if args.cmd is not None:
+            return serve_with_command(
+                trans, ["sh", "-c", args.cmd],
+                entity_id=trans.entity_id,
+                watch_interval=args.watch_interval,
+            )
+        inspector = ProcInspector(
+            trans, args.pid,
+            entity_id=trans.entity_id,
+            watch_interval=args.watch_interval,
+        )
+        inspector.serve()
+        return 0
+    finally:
+        if orc is not None:
+            orc.shutdown()
+
+
+def run_fs(args) -> int:
+    init_log()
+    from namazu_tpu.inspector.fs import serve_fs_inspector
+
+    if not (args.mount_point and args.original_dir):
+        print("error: --mount-point and --original-dir are required",
+              file=sys.stderr)
+        return 1
+    trans, orc = _make_transceiver(args, "_nmz_fs_inspector")
+    try:
+        return serve_fs_inspector(trans, args.mount_point, args.original_dir)
+    finally:
+        if orc is not None:
+            orc.shutdown()
+
+
+def run_ethernet(args) -> int:
+    init_log()
+    from namazu_tpu.inspector.ethernet import serve_proxy_inspector
+
+    if not (args.listen and args.upstream):
+        print("error: --listen and --upstream are required", file=sys.stderr)
+        return 1
+    trans, orc = _make_transceiver(args, "_nmz_ethernet_inspector")
+    try:
+        return serve_proxy_inspector(trans, args.listen, args.upstream)
+    finally:
+        if orc is not None:
+            orc.shutdown()

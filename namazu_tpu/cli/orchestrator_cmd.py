@@ -1,0 +1,49 @@
+"""``nmz-tpu orchestrator [--config FILE]`` — standalone orchestrator.
+
+Parity: /root/reference/nmz/cli/orchestrator.go:21-66 — REST on port 10080
+by default; runs until interrupted. Used when inspectors live in other
+processes/hosts and there is no experiment loop (no trace recording).
+"""
+
+from __future__ import annotations
+
+import signal as _signal
+import threading
+
+from namazu_tpu.orchestrator import Orchestrator
+from namazu_tpu.policy import create_policy
+from namazu_tpu.utils.config import Config
+from namazu_tpu.utils.log import init_log
+
+DEFAULT_REST_PORT = 10080
+
+
+def register(sub) -> None:
+    p = sub.add_parser("orchestrator", help="run a standalone orchestrator")
+    p.add_argument("--config", default=None, help="config file")
+    p.add_argument("--rest-port", type=int, default=None,
+                   help=f"REST port (default {DEFAULT_REST_PORT}; 0 = auto)")
+    p.set_defaults(func=run)
+
+
+def run(args) -> int:
+    init_log()
+    cfg = Config.from_file(args.config) if args.config else Config()
+    if args.rest_port is not None:
+        cfg.set("rest_port", args.rest_port)
+    elif int(cfg.get("rest_port", -1)) < 0:
+        cfg.set("rest_port", DEFAULT_REST_PORT)
+
+    policy = create_policy(cfg.get("explore_policy"))
+    policy.load_config(cfg)
+    orchestrator = Orchestrator(cfg, policy, collect_trace=False)
+    orchestrator.start()
+    rest = orchestrator.hub.endpoint("rest")
+    print(f"orchestrator ready (REST port {rest.port}); Ctrl-C to stop")
+
+    stop = threading.Event()
+    _signal.signal(_signal.SIGINT, lambda *a: stop.set())
+    _signal.signal(_signal.SIGTERM, lambda *a: stop.set())
+    stop.wait()
+    orchestrator.shutdown()
+    return 0

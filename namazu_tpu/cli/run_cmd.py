@@ -1,0 +1,83 @@
+"""``nmz-tpu run <storage_dir>`` — run one experiment.
+
+Parity: /root/reference/nmz/cli/run.go:171-248 (call stack SURVEY.md 3.1):
+allocate a run dir, start the orchestrator, run the experiment's ``run``
+script (which boots the testee + inspectors), shut down, judge with the
+``validate`` script (exit status = oracle), record trace + result, clean.
+
+Driven N times by the user (``for i in $(seq 1 100); do nmz-tpu run d; done``)
+— this loop is the repro-rate metric loop of BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+from namazu_tpu.orchestrator import Orchestrator
+from namazu_tpu.policy import create_policy
+from namazu_tpu.storage import load_storage
+from namazu_tpu.utils.cmd import CmdFactory
+from namazu_tpu.utils.config import Config
+from namazu_tpu.utils.log import init_log
+
+
+def register(sub) -> None:
+    p = sub.add_parser("run", help="run one experiment from a storage dir")
+    p.add_argument("storage", help="storage directory created by init")
+    p.set_defaults(func=run)
+
+
+def run(args) -> int:
+    storage_dir = args.storage
+    cfg_path = os.path.join(storage_dir, "config.json")
+    if not os.path.exists(cfg_path):
+        print(f"error: {storage_dir} is not initialized (no config.json)",
+              file=sys.stderr)
+        return 1
+    cfg = Config.from_file(cfg_path)
+
+    storage = load_storage(storage_dir)
+    working_dir = storage.create_new_working_dir()
+    materials_dir = os.path.join(storage_dir, "materials")
+    init_log(os.path.join(working_dir, "nmz.log"))
+    factory = CmdFactory(working_dir=working_dir, materials_dir=materials_dir)
+
+    policy = create_policy(cfg.get("explore_policy"))
+    policy.load_config(cfg)
+    policy.set_history_storage(storage)
+
+    orchestrator = Orchestrator(cfg, policy, collect_trace=True)
+    orchestrator.start()
+
+    successful = False
+    start = time.monotonic()
+    try:
+        run_script = cfg.get("run")
+        if not run_script:
+            print("error: config has no 'run' script", file=sys.stderr)
+            return 1
+        res = factory.run(run_script)
+        if res.returncode != 0:
+            print(f"run script exited {res.returncode}", file=sys.stderr)
+    finally:
+        trace = orchestrator.shutdown()
+
+    validate_script = cfg.get("validate")
+    if validate_script:
+        successful = factory.run(validate_script).returncode == 0
+    required_time = time.monotonic() - start
+
+    storage.record_new_trace(trace)
+    storage.record_result(successful, required_time)
+    storage.close()
+
+    clean_script = cfg.get("clean")
+    if clean_script:
+        factory.run(clean_script)
+
+    print(f"run finished: successful={successful} "
+          f"time={required_time:.2f}s trace={len(trace)} actions "
+          f"workdir={working_dir}")
+    return 0

@@ -1,0 +1,108 @@
+"""``nmz-tpu tools summary|dump-trace|visualize`` — experiment analysis.
+
+Parity: /root/reference/nmz/cli/tools — ``summary`` (per-run pass/fail and
+over-average times, summary.go:40-77), ``dump-trace`` (pretty-print one
+run's trace, dump_trace.go:60-135), ``visualize`` (unique-trace growth
+curve with optional partial-order reduction, visualize.go:81-168).
+"""
+
+from __future__ import annotations
+
+import json
+
+from namazu_tpu.storage import load_storage
+
+
+def register(sub) -> None:
+    p = sub.add_parser("tools", help="experiment analysis tools")
+    tsub = p.add_subparsers(dest="tool", required=True)
+
+    ps = tsub.add_parser("summary", help="per-run results summary")
+    ps.add_argument("storage")
+    ps.set_defaults(func=summary)
+
+    pd = tsub.add_parser("dump-trace", help="pretty-print one run's trace")
+    pd.add_argument("storage")
+    pd.add_argument("run_index", type=int)
+    pd.set_defaults(func=dump_trace)
+
+    pv = tsub.add_parser("visualize", help="unique-trace growth curve")
+    pv.add_argument("storage")
+    pv.add_argument("--reduction", action="store_true",
+                    help="apply partial-order reduction (compare per-entity "
+                         "event subsequences instead of total orders)")
+    pv.add_argument("--gnuplot", action="store_true",
+                    help="emit gnuplot-ready two-column data only")
+    pv.set_defaults(func=visualize)
+
+
+def summary(args) -> int:
+    st = load_storage(args.storage)
+    n = st.nr_stored_histories()
+    times, succ = [], 0
+    rows = []
+    for i in range(n):
+        try:
+            ok = st.is_successful(i)
+            t = st.get_required_time(i)
+        except Exception:
+            continue
+        rows.append((i, ok, t))
+        succ += ok
+        times.append(t)
+    avg = sum(times) / len(times) if times else 0.0
+    for i, ok, t in rows:
+        flag = " (over average)" if t > avg else ""
+        print(f"{i:08x}: {'SUCCESS' if ok else 'FAILURE'} {t:.2f}s{flag}")
+    if rows:
+        rate = 100.0 * (len(rows) - succ) / len(rows)
+        print(f"total: {len(rows)} runs, {succ} successful, "
+              f"{len(rows) - succ} failed (repro rate {rate:.1f}%), "
+              f"avg {avg:.2f}s")
+    else:
+        print("no completed runs")
+    return 0
+
+
+def dump_trace(args) -> int:
+    st = load_storage(args.storage)
+    trace = st.get_stored_history(args.run_index)
+    for i, action in enumerate(trace):
+        d = action.to_jsonable()
+        tt = action.triggered_time
+        stamp = f"{tt:.6f}" if tt else "-"
+        print(f"{i:6d} {stamp} {json.dumps(d, sort_keys=True)}")
+    return 0
+
+
+def _trace_key(trace, reduction: bool) -> str:
+    if reduction:
+        # partial-order reduction: two traces are equivalent if every
+        # entity observed the same subsequence (parity visualize.go:81-133)
+        per = trace.entity_order()
+        return json.dumps({k: per[k] for k in sorted(per)})
+    return json.dumps([(a.entity_id, a.event_class or a.class_name())
+                       for a in trace])
+
+
+def visualize(args) -> int:
+    st = load_storage(args.storage)
+    n = st.nr_stored_histories()
+    seen = set()
+    curve = []
+    for i in range(n):
+        try:
+            trace = st.get_stored_history(i)
+        except Exception:
+            continue
+        seen.add(_trace_key(trace, args.reduction))
+        curve.append((i + 1, len(seen)))
+    if args.gnuplot:
+        for x, y in curve:
+            print(f"{x} {y}")
+    else:
+        for x, y in curve:
+            print(f"runs={x} unique_traces={y}")
+        if curve:
+            print(f"exploration saturation: {curve[-1][1]}/{curve[-1][0]} unique")
+    return 0

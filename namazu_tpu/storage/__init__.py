@@ -1,0 +1,19 @@
+"""History storage: per-experiment persistence of traces and results.
+
+Capability parity with /root/reference/nmz/historystorage
+(historystorage.go:22-61). The ``naive`` backend stores everything as JSON
+under a storage directory; a ``mongodb``-style backend can decorate it when
+a MongoDB client is available (reference: mongodb/mongodb.go) — gated, as
+pymongo is not part of this image.
+"""
+
+from namazu_tpu.storage.base import HistoryStorage, StorageError, new_storage, load_storage
+from namazu_tpu.storage.naive import NaiveStorage
+
+__all__ = [
+    "HistoryStorage",
+    "StorageError",
+    "new_storage",
+    "load_storage",
+    "NaiveStorage",
+]

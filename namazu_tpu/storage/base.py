@@ -1,0 +1,107 @@
+"""HistoryStorage interface and factory.
+
+Parity: /root/reference/nmz/historystorage/historystorage.go:22-61
+(interface + New/LoadStorage).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional
+
+from namazu_tpu.utils.trace import SingleTrace
+
+
+class StorageError(Exception):
+    pass
+
+
+class HistoryStorage:
+    """One experiment's history: N runs, each with a trace and a result."""
+
+    NAME = "abstract"
+
+    # -- lifecycle -------------------------------------------------------
+
+    def create(self) -> None:
+        """Create the on-disk layout (once, at `init` time)."""
+        raise NotImplementedError
+
+    def init(self) -> None:
+        """Open an existing storage (every `run`)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    # -- per-run ---------------------------------------------------------
+
+    def create_new_working_dir(self) -> str:
+        """Allocate the next run directory; returns its path."""
+        raise NotImplementedError
+
+    def record_new_trace(self, trace: SingleTrace) -> None:
+        raise NotImplementedError
+
+    def record_result(
+        self,
+        successful: bool,
+        required_time: float,
+        metadata: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        raise NotImplementedError
+
+    # -- queries ---------------------------------------------------------
+
+    def nr_stored_histories(self) -> int:
+        raise NotImplementedError
+
+    def get_stored_history(self, i: int) -> SingleTrace:
+        raise NotImplementedError
+
+    def is_successful(self, i: int) -> bool:
+        raise NotImplementedError
+
+    def get_required_time(self, i: int) -> float:
+        raise NotImplementedError
+
+    def get_metadata(self, i: int) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def search(self, prefix: List[str]) -> Iterable[int]:
+        """Indices of runs whose trace's action-class sequence starts with
+        ``prefix`` (parity: naive.go:232-257 linear scan)."""
+        raise NotImplementedError
+
+
+_BACKENDS: Dict[str, type] = {}
+
+
+def register_storage(cls: type) -> type:
+    _BACKENDS[cls.NAME] = cls
+    return cls
+
+
+def new_storage(name: str, dir_path: str) -> HistoryStorage:
+    """Parity: historystorage.New (historystorage.go:42-53)."""
+    try:
+        cls = _BACKENDS[name]
+    except KeyError:
+        raise StorageError(
+            f"unknown storage type {name!r}; known: {sorted(_BACKENDS)}"
+        ) from None
+    return cls(dir_path)
+
+
+def load_storage(dir_path: str) -> HistoryStorage:
+    """Open an existing storage dir, reading its recorded backend type
+    (parity: LoadStorage, historystorage.go:55-61)."""
+    meta_path = os.path.join(dir_path, "storage.json")
+    if not os.path.exists(meta_path):
+        raise StorageError(f"not a storage dir (no storage.json): {dir_path}")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    st = new_storage(meta["type"], dir_path)
+    st.init()
+    return st

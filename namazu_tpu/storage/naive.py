@@ -1,0 +1,144 @@
+"""Naive (filesystem JSON) history storage.
+
+Parity: /root/reference/nmz/historystorage/naive — layout per storage dir:
+
+::
+
+    storage.json          {"type": "naive", "next_run": N}
+    config.json           copy of the experiment config
+    materials/            copy of the user's experiment scripts
+    00000000/             one dir per run (%08x, parity naive.go:143-158)
+        trace.json        the action sequence (JSON, not gob)
+        result.json       {"successful": bool, "required_time": s, "metadata": {}}
+
+The reference also writes per-action ``actions/<i>.{action,event}.json``
+files; here the whole trace is one JSON array — same information, one file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Optional
+
+from namazu_tpu.storage.base import HistoryStorage, StorageError, register_storage
+from namazu_tpu.utils.trace import SingleTrace
+
+
+@register_storage
+class NaiveStorage(HistoryStorage):
+    NAME = "naive"
+
+    def __init__(self, dir_path: str):
+        self.dir = os.path.abspath(dir_path)
+        self._next_run = 0
+        self._current_run_dir: Optional[str] = None
+
+    # -- layout helpers --------------------------------------------------
+
+    def _meta_path(self) -> str:
+        return os.path.join(self.dir, "storage.json")
+
+    def _run_dir(self, i: int) -> str:
+        return os.path.join(self.dir, f"{i:08x}")
+
+    def _load_meta(self) -> Dict[str, Any]:
+        with open(self._meta_path()) as f:
+            return json.load(f)
+
+    def _save_meta(self) -> None:
+        with open(self._meta_path(), "w") as f:
+            json.dump({"type": self.NAME, "next_run": self._next_run}, f)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def create(self) -> None:
+        os.makedirs(self.dir, exist_ok=True)
+        if os.path.exists(self._meta_path()):
+            raise StorageError(f"storage already exists: {self.dir}")
+        self._next_run = 0
+        self._save_meta()
+
+    def init(self) -> None:
+        if not os.path.exists(self._meta_path()):
+            raise StorageError(f"not a storage dir: {self.dir}")
+        self._next_run = int(self._load_meta()["next_run"])
+
+    # -- per-run ---------------------------------------------------------
+
+    def create_new_working_dir(self) -> str:
+        run_dir = self._run_dir(self._next_run)
+        os.makedirs(run_dir, exist_ok=False)
+        self._next_run += 1
+        self._save_meta()
+        self._current_run_dir = run_dir
+        return run_dir
+
+    def record_new_trace(self, trace: SingleTrace) -> None:
+        if self._current_run_dir is None:
+            raise StorageError("no working dir; call create_new_working_dir first")
+        with open(os.path.join(self._current_run_dir, "trace.json"), "w") as f:
+            f.write(trace.to_json())
+
+    def record_result(
+        self,
+        successful: bool,
+        required_time: float,
+        metadata: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        if self._current_run_dir is None:
+            raise StorageError("no working dir; call create_new_working_dir first")
+        with open(os.path.join(self._current_run_dir, "result.json"), "w") as f:
+            json.dump(
+                {
+                    "successful": successful,
+                    "required_time": required_time,
+                    "metadata": metadata or {},
+                },
+                f,
+            )
+
+    # -- queries ---------------------------------------------------------
+
+    def nr_stored_histories(self) -> int:
+        # count only runs that completed (have a result)
+        n = 0
+        for i in range(self._next_run):
+            if os.path.exists(os.path.join(self._run_dir(i), "result.json")):
+                n = i + 1
+        return n
+
+    def _result(self, i: int) -> Dict[str, Any]:
+        path = os.path.join(self._run_dir(i), "result.json")
+        if not os.path.exists(path):
+            raise StorageError(f"run {i:08x} has no result")
+        with open(path) as f:
+            return json.load(f)
+
+    def get_stored_history(self, i: int) -> SingleTrace:
+        path = os.path.join(self._run_dir(i), "trace.json")
+        if not os.path.exists(path):
+            raise StorageError(f"run {i:08x} has no trace")
+        with open(path) as f:
+            return SingleTrace.from_json(f.read())
+
+    def is_successful(self, i: int) -> bool:
+        return bool(self._result(i)["successful"])
+
+    def get_required_time(self, i: int) -> float:
+        return float(self._result(i)["required_time"])
+
+    def get_metadata(self, i: int) -> Dict[str, Any]:
+        return dict(self._result(i).get("metadata") or {})
+
+    def search(self, prefix: List[str]) -> Iterable[int]:
+        out = []
+        for i in range(self.nr_stored_histories()):
+            try:
+                trace = self.get_stored_history(i)
+            except StorageError:
+                continue
+            classes = [a.class_name() for a in trace]
+            if classes[: len(prefix)] == list(prefix):
+                out.append(i)
+        return out

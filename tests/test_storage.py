@@ -1,0 +1,77 @@
+"""History storage tests (parity: nmz/historystorage/naive tests)."""
+
+import pytest
+
+from namazu_tpu.signal import NopAction, PacketEvent
+from namazu_tpu.storage import StorageError, load_storage, new_storage
+from namazu_tpu.utils.trace import SingleTrace
+
+
+def make_trace(entities):
+    t = SingleTrace()
+    for e in entities:
+        ev = PacketEvent.create(e, e, "peer")
+        a = ev.default_action()
+        a.mark_triggered()
+        t.append(a)
+    return t
+
+
+def test_create_init_roundtrip(tmp_path):
+    d = str(tmp_path / "st")
+    st = new_storage("naive", d)
+    st.create()
+    wd = st.create_new_working_dir()
+    assert wd.endswith("00000000")
+    st.record_new_trace(make_trace(["a", "b"]))
+    st.record_result(False, 1.5, {"note": "repro"})
+    st.close()
+
+    st2 = load_storage(d)
+    assert st2.nr_stored_histories() == 1
+    assert not st2.is_successful(0)
+    assert st2.get_required_time(0) == pytest.approx(1.5)
+    assert st2.get_metadata(0) == {"note": "repro"}
+    trace = st2.get_stored_history(0)
+    assert len(trace) == 2
+    assert trace.actions[0].entity_id == "a"
+
+
+def test_multiple_runs_and_search(tmp_path):
+    d = str(tmp_path / "st")
+    st = new_storage("naive", d)
+    st.create()
+    for ents in (["a", "b"], ["a", "c"], ["b", "a"]):
+        st.create_new_working_dir()
+        st.record_new_trace(make_trace(ents))
+        st.record_result(True, 0.1)
+    assert st.nr_stored_histories() == 3
+    # all traces start with EventAcceptanceAction
+    assert list(st.search(["EventAcceptanceAction"])) == [0, 1, 2]
+    assert list(st.search(["NopAction"])) == []
+
+
+def test_create_twice_fails(tmp_path):
+    d = str(tmp_path / "st")
+    st = new_storage("naive", d)
+    st.create()
+    with pytest.raises(StorageError):
+        new_storage("naive", d).create()
+
+
+def test_load_non_storage_fails(tmp_path):
+    with pytest.raises(StorageError):
+        load_storage(str(tmp_path))
+
+
+def test_unknown_backend(tmp_path):
+    with pytest.raises(StorageError):
+        new_storage("mongodb-atlas", str(tmp_path))
+
+
+def test_incomplete_run_not_counted(tmp_path):
+    d = str(tmp_path / "st")
+    st = new_storage("naive", d)
+    st.create()
+    st.create_new_working_dir()  # crashed run: no trace/result
+    assert st.nr_stored_histories() == 0
