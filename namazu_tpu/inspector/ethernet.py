@@ -1,0 +1,211 @@
+"""Ethernet (message) inspector: defer, reorder, and drop network traffic.
+
+Capability parity with /root/reference/nmz/inspector/ethernet (NFQUEUE and
+hookswitch backends). TPU-era redesign: the primary backend is a
+**userspace TCP proxy** — the system-under-test's nodes are pointed at
+proxy ports (one per peer link, e.g. via its own config, DNS, or iptables
+REDIRECT), and every chunk that flows through a link becomes a deferred
+``PacketEvent`` the policy can delay or drop.
+
+Why a proxy instead of NFQUEUE: it needs no root, no kernel modules and no
+external switch, works in any container, and — because interception happens
+above TCP — retransmissions never reach the inspector, which removes the
+reference's whole TCP-retransmit-suppression problem (its tcpwatcher
+exists only because delaying raw segments triggers duplicate delivery,
+ethernet_nfq.go:53-56). The cost is per-link (not per-interface)
+interception, which matches how the reference's examples are actually
+wired (one inspected port per ZooKeeper election/quorum link).
+
+A ``parser`` callback turns raw chunks into semantic replay hints (the
+role of the reference's zktraffic-based inspectors, misc/pynmz/inspector/
+zookeeper.py) so schedules can be replayed deterministically.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import socket
+import threading
+from typing import Callable, Optional
+
+from namazu_tpu.inspector.transceiver import Transceiver
+from namazu_tpu.signal.action import PacketFaultAction
+from namazu_tpu.signal.event import PacketEvent
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("inspector.ethernet")
+
+# chunk -> replay hint (or "" for no semantic identity)
+PacketParser = Callable[[bytes, str, str], str]
+
+
+def _addr(host_port: str) -> tuple[str, int]:
+    host, _, port = host_port.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class ProxyLink:
+    """One inspected TCP link: listen address -> upstream address."""
+
+    def __init__(
+        self,
+        inspector: "EthernetProxyInspector",
+        listen: str,
+        upstream: str,
+        src_entity: str,
+        dst_entity: str,
+    ):
+        self.inspector = inspector
+        self.listen = _addr(listen)
+        self.upstream = _addr(upstream)
+        self.src_entity = src_entity
+        self.dst_entity = dst_entity
+        self._server: Optional[socket.socket] = None
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.getsockname()[1]
+
+    def start(self) -> None:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(self.listen)
+        srv.listen(16)
+        self._server = srv
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name=f"proxy-accept-{self.listen[1]}")
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._server.accept()
+            except OSError:
+                return
+            try:
+                up = socket.create_connection(self.upstream, timeout=10)
+            except OSError as e:
+                log.warning("upstream %s unreachable: %s", self.upstream, e)
+                client.close()
+                continue
+            for src, dst, se, de in (
+                (client, up, self.src_entity, self.dst_entity),
+                (up, client, self.dst_entity, self.src_entity),
+            ):
+                t = threading.Thread(
+                    target=self._pump, args=(src, dst, se, de),
+                    daemon=True, name=f"proxy-pump-{se}->{de}",
+                )
+                t.start()
+                self._threads.append(t)
+
+    def _pump(self, src: socket.socket, dst: socket.socket,
+              src_entity: str, dst_entity: str) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    chunk = src.recv(65536)
+                except OSError:
+                    break
+                if not chunk:
+                    break
+                if self.inspector.allow(chunk, src_entity, dst_entity):
+                    try:
+                        dst.sendall(chunk)
+                    except OSError:
+                        break
+                # dropped chunks are simply not forwarded (the fault)
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+
+class EthernetProxyInspector:
+    def __init__(
+        self,
+        transceiver: Transceiver,
+        entity_id: str = "_nmz_ethernet_inspector",
+        parser: Optional[PacketParser] = None,
+        action_timeout: Optional[float] = 30.0,
+    ):
+        self.trans = transceiver
+        self.entity_id = entity_id
+        self.parser = parser
+        self.action_timeout = action_timeout
+        self.links: list[ProxyLink] = []
+        self.packet_count = 0
+        self.drop_count = 0
+
+    def add_link(self, listen: str, upstream: str,
+                 src_entity: str, dst_entity: str) -> ProxyLink:
+        link = ProxyLink(self, listen, upstream, src_entity, dst_entity)
+        self.links.append(link)
+        return link
+
+    def start(self) -> None:
+        self.trans.start()
+        for link in self.links:
+            link.start()
+
+    def stop(self) -> None:
+        for link in self.links:
+            link.stop()
+
+    # -- the per-chunk hook (parity: onPacket, ethernet_nfq.go:95-109) ---
+
+    def allow(self, chunk: bytes, src_entity: str, dst_entity: str) -> bool:
+        """Defer ``chunk``; returns False when the policy drops it."""
+        self.packet_count += 1
+        hint = self.parser(chunk, src_entity, dst_entity) if self.parser else ""
+        event = PacketEvent.create(
+            self.entity_id, src_entity, dst_entity,
+            payload=chunk[:128], hint=hint,
+        )
+        ch = self.trans.send_event(event)
+        try:
+            action = ch.get(timeout=self.action_timeout)
+        except _queue.Empty:
+            self.trans.forget(event)
+            log.warning("packet %s->%s: no action in %ss; releasing",
+                        src_entity, dst_entity, self.action_timeout)
+            return True
+        if isinstance(action, PacketFaultAction):
+            self.drop_count += 1
+            return False
+        return True
+
+
+def serve_proxy_inspector(
+    transceiver: Transceiver, listen: str, upstream: str
+) -> int:
+    """CLI entry: proxy one link until interrupted."""
+    inspector = EthernetProxyInspector(transceiver)
+    inspector.add_link(listen, upstream, src_entity="client",
+                       dst_entity="server")
+    inspector.start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        inspector.stop()
+    return 0

@@ -1,0 +1,146 @@
+"""Filesystem inspector: defer and fault-inject filesystem operations.
+
+Capability parity with /root/reference/nmz/inspector/fs/fs.go:22-183 (a
+hookfs/FUSE passthrough with pre/post hooks). TPU-era redesign: the hook
+protocol is transport-agnostic —
+
+* :class:`FsInspector` — the hook core: builds a ``FilesystemEvent`` per
+  intercepted op, blocks until the policy answers, translates a
+  ``FilesystemFaultAction`` into EIO (parity: commonHook, fs.go:56-74);
+* :class:`InterposedFs` — library-level interposition for testees that can
+  route file I/O through a Python object (also the in-proc test fake the
+  reference keeps for every layer);
+* the C++ LD_PRELOAD interposer under ``native/`` speaks the guest-agent
+  protocol and reuses the same event classes for testees that cannot
+  (no FUSE mount or root required);
+* a FUSE mount backend is gated: this image ships no libfuse headers or
+  Python FUSE binding, so ``serve_fs_inspector`` reports the gap cleanly.
+
+Hooked ops (parity fs.go:77-183): post-read, post-opendir, pre-write,
+pre-mkdir, pre-rmdir, pre-fsync.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import queue as _queue
+from typing import Optional
+
+from namazu_tpu.inspector.transceiver import Transceiver
+from namazu_tpu.signal.action import FilesystemFaultAction
+from namazu_tpu.signal.event import FilesystemEvent, FilesystemOp
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("inspector.fs")
+
+
+class FsInspector:
+    """The hook core shared by every interposition backend."""
+
+    def __init__(
+        self,
+        transceiver: Transceiver,
+        entity_id: str = "_nmz_fs_inspector",
+        action_timeout: Optional[float] = 30.0,
+    ):
+        self.trans = transceiver
+        self.entity_id = entity_id
+        self.action_timeout = action_timeout
+        self.hook_count = 0
+        self.fault_count = 0
+
+    def start(self) -> None:
+        self.trans.start()
+
+    def hook(self, op: FilesystemOp, path: str) -> None:
+        """Block the calling operation until the policy releases it.
+
+        Raises ``OSError(EIO)`` when the policy injects a filesystem fault
+        (parity: FilesystemFaultAction => -EIO, fs.go:62-71).
+        """
+        self.hook_count += 1
+        event = FilesystemEvent.create(self.entity_id, op, path)
+        ch = self.trans.send_event(event)
+        try:
+            action = ch.get(timeout=self.action_timeout)
+        except _queue.Empty:
+            self.trans.forget(event)
+            log.warning("fs hook %s %s: no action within %ss; releasing",
+                        op.value, path, self.action_timeout)
+            return
+        if isinstance(action, FilesystemFaultAction):
+            self.fault_count += 1
+            raise OSError(errno.EIO, os.strerror(errno.EIO), path)
+
+
+class InterposedFs:
+    """Library-level interposition over a root directory.
+
+    Each method mirrors one hooked operation of the reference's FUSE layer
+    (fs.go:77-183): reads/opendirs hook *after* the real op, writes/mkdirs/
+    rmdirs/fsyncs hook *before* it — same pre/post split, so fault
+    injection cannot corrupt reads but can prevent persistence.
+    """
+
+    def __init__(self, root: str, inspector: FsInspector):
+        self.root = os.path.abspath(root)
+        self.inspector = inspector
+
+    def _real(self, path: str) -> str:
+        p = os.path.normpath(os.path.join(self.root, path.lstrip("/")))
+        if not p.startswith(self.root):
+            raise ValueError(f"path escapes root: {path}")
+        return p
+
+    # -- post-hooked ops -------------------------------------------------
+
+    def read(self, path: str) -> bytes:
+        with open(self._real(path), "rb") as f:
+            data = f.read()
+        self.inspector.hook(FilesystemOp.POST_READ, path)
+        return data
+
+    def listdir(self, path: str) -> list[str]:
+        entries = os.listdir(self._real(path))
+        self.inspector.hook(FilesystemOp.POST_OPENDIR, path)
+        return entries
+
+    # -- pre-hooked ops --------------------------------------------------
+
+    def write(self, path: str, data: bytes) -> None:
+        self.inspector.hook(FilesystemOp.PRE_WRITE, path)
+        with open(self._real(path), "wb") as f:
+            f.write(data)
+
+    def mkdir(self, path: str) -> None:
+        self.inspector.hook(FilesystemOp.PRE_MKDIR, path)
+        os.mkdir(self._real(path))
+
+    def rmdir(self, path: str) -> None:
+        self.inspector.hook(FilesystemOp.PRE_RMDIR, path)
+        os.rmdir(self._real(path))
+
+    def fsync(self, path: str) -> None:
+        self.inspector.hook(FilesystemOp.PRE_FSYNC, path)
+        fd = os.open(self._real(path), os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+
+def serve_fs_inspector(
+    transceiver: Transceiver, mount_point: str, original_dir: str
+) -> int:
+    """FUSE-mount backend — gated.
+
+    This image has no libfuse development headers and no Python FUSE
+    binding, so the mount backend cannot be built here. Use the
+    LD_PRELOAD interposer (native/fs_interpose) or :class:`InterposedFs`.
+    """
+    raise NotImplementedError(
+        "FUSE mount backend unavailable: no libfuse headers/binding in this "
+        "environment. Use the native LD_PRELOAD interposer "
+        "(native/fs_interpose) or InterposedFs for library-level hooks."
+    )

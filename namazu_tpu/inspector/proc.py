@@ -1,0 +1,120 @@
+"""Process inspector: watch a process tree, let the policy re-schedule it.
+
+Parity: /root/reference/nmz/inspector/proc/proc.go:53-172 — every
+``watch_interval`` the inspector snapshots the target's descendant LWP set,
+sends a ``ProcSetEvent``, awaits the policy's ``ProcSetSchedAction``, and
+applies the per-thread scheduler attributes via sched_setattr(2) (EPERM and
+vanished threads are logged and skipped).
+
+This is the highest-leverage inspector for flaky-test reproduction
+(YARN-4548 et al., BASELINE.md) because it needs no packet/filesystem
+interception — just procfs and one syscall.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from typing import Optional
+
+from namazu_tpu.inspector.transceiver import Transceiver
+from namazu_tpu.signal.action import ProcSetSchedAction
+from namazu_tpu.signal.event import ProcSetEvent
+from namazu_tpu.utils import linuxsched, procfs
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("inspector.proc")
+
+
+class ProcInspector:
+    def __init__(
+        self,
+        transceiver: Transceiver,
+        root_pid: int,
+        entity_id: str = "_nmz_proc_inspector",
+        watch_interval: float = 1.0,
+        action_timeout: float = 10.0,
+        apply_sched: bool = True,
+    ):
+        self.trans = transceiver
+        self.root_pid = root_pid
+        self.entity_id = entity_id
+        self.watch_interval = watch_interval
+        self.action_timeout = action_timeout
+        self.apply_sched = apply_sched
+        self._stop = threading.Event()
+        self.watch_count = 0
+        self.apply_errors = 0
+
+    # -- main loop -------------------------------------------------------
+
+    def serve(self) -> None:
+        """Blocking watch loop; returns when stop() is called or the
+        target disappears (parity: Serve, proc.go:53-91)."""
+        self.trans.start()
+        while not self._stop.wait(self.watch_interval):
+            pids = [self.root_pid, *procfs.descendant_lwps(self.root_pid)]
+            pids = sorted(set(pids))
+            if not procfs.lwps(self.root_pid):
+                log.info("target pid %d is gone; stopping", self.root_pid)
+                return
+            self.on_watch(pids)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- one tick --------------------------------------------------------
+
+    def on_watch(self, pids: list[int]) -> None:
+        self.watch_count += 1
+        event = ProcSetEvent.create(self.entity_id, pids)
+        ch = self.trans.send_event(event)
+        try:
+            action = ch.get(timeout=self.action_timeout)
+        except _queue.Empty:
+            # policy chose not to answer (e.g. passthrough); nothing to do
+            self.trans.forget(event)
+            log.debug("no sched action within %.1fs", self.action_timeout)
+            return
+        if isinstance(action, ProcSetSchedAction):
+            self.on_action(action)
+        else:
+            log.debug("ignoring non-sched action %r", action)
+
+    def on_action(self, action: ProcSetSchedAction) -> None:
+        """Apply per-thread attrs (parity: onAction, proc.go:148-172)."""
+        if not self.apply_sched:
+            return
+        for pid_str, attrs in action.attrs.items():
+            try:
+                linuxsched.set_attr(int(pid_str), attrs)
+            except (linuxsched.SchedError, ValueError) as e:
+                self.apply_errors += 1
+                log.debug("sched_setattr pid %s: %s", pid_str, e)
+
+
+def serve_with_command(
+    transceiver: Transceiver,
+    cmd: list[str],
+    entity_id: str = "_nmz_proc_inspector",
+    watch_interval: float = 1.0,
+    stdout=None,
+    stderr=None,
+) -> int:
+    """Spawn ``cmd``, fuzz its process tree until it exits, return its exit
+    status (parity: the ``-cmd`` mode of cli/inspectors/proc.go:58-137)."""
+    import subprocess
+
+    child = subprocess.Popen(cmd, stdout=stdout, stderr=stderr)
+    inspector = ProcInspector(
+        transceiver, child.pid, entity_id=entity_id,
+        watch_interval=watch_interval,
+    )
+    t = threading.Thread(target=inspector.serve, daemon=True)
+    t.start()
+    try:
+        rc = child.wait()
+    finally:
+        inspector.stop()
+        t.join(timeout=5)
+    return rc

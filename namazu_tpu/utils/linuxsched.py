@@ -1,0 +1,116 @@
+"""sched_setattr(2) via ctypes — no compiled extension needed.
+
+Parity with the reference's go-linuxsched dependency (used by
+/root/reference/nmz/inspector/proc/proc.go:148-172): apply per-thread
+scheduler attributes (policy, nice, RT priority, DEADLINE runtime/period)
+produced by the proc sub-policies.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import errno
+import os
+import platform
+from typing import Any, Dict
+
+# scheduling policies, linux/sched.h
+SCHED_NORMAL = 0
+SCHED_FIFO = 1
+SCHED_RR = 2
+SCHED_BATCH = 3
+SCHED_IDLE = 5
+SCHED_DEADLINE = 6
+
+POLICY_BY_NAME = {
+    "SCHED_NORMAL": SCHED_NORMAL,
+    "SCHED_OTHER": SCHED_NORMAL,
+    "SCHED_FIFO": SCHED_FIFO,
+    "SCHED_RR": SCHED_RR,
+    "SCHED_BATCH": SCHED_BATCH,
+    "SCHED_IDLE": SCHED_IDLE,
+    "SCHED_DEADLINE": SCHED_DEADLINE,
+}
+
+# __NR_sched_setattr per architecture (asm/unistd.h)
+_SYSCALL_NR = {
+    "x86_64": 314,
+    "aarch64": 274,
+    "arm": 380,
+    "ppc64le": 355,
+    "s390x": 345,
+    "riscv64": 274,
+}
+
+
+class SchedAttr(ctypes.Structure):
+    _fields_ = [
+        ("size", ctypes.c_uint32),
+        ("sched_policy", ctypes.c_uint32),
+        ("sched_flags", ctypes.c_uint64),
+        ("sched_nice", ctypes.c_int32),
+        ("sched_priority", ctypes.c_uint32),
+        ("sched_runtime", ctypes.c_uint64),
+        ("sched_deadline", ctypes.c_uint64),
+        ("sched_period", ctypes.c_uint64),
+    ]
+
+
+class SchedError(OSError):
+    pass
+
+
+_libc = None
+
+
+def _get_libc():
+    global _libc
+    if _libc is None:
+        _libc = ctypes.CDLL(ctypes.util.find_library("c") or "libc.so.6",
+                            use_errno=True)
+    return _libc
+
+
+def _syscall_nr() -> int:
+    arch = platform.machine()
+    try:
+        return _SYSCALL_NR[arch]
+    except KeyError:
+        raise SchedError(0, f"sched_setattr syscall number unknown for {arch}")
+
+
+def set_attr(tid: int, attr_dict: Dict[str, Any]) -> None:
+    """Apply one attrs dict (as produced by the proc sub-policies,
+    namazu_tpu/policy/proc_subpolicies.py) to thread ``tid``.
+
+    Raises SchedError (an OSError) on failure; callers log EPERM and
+    continue (parity: proc.go:162-170).
+    """
+    policy_name = attr_dict.get("policy", "SCHED_NORMAL")
+    try:
+        policy = POLICY_BY_NAME[policy_name]
+    except KeyError:
+        raise SchedError(errno.EINVAL, f"unknown policy {policy_name!r}")
+
+    attr = SchedAttr()
+    attr.size = ctypes.sizeof(SchedAttr)
+    attr.sched_policy = policy
+    attr.sched_flags = 0
+    attr.sched_nice = int(attr_dict.get("nice", 0))
+    attr.sched_priority = int(attr_dict.get("rt_priority", 0))
+    if policy == SCHED_DEADLINE:
+        attr.sched_runtime = int(attr_dict.get("runtime_ns", 0))
+        attr.sched_deadline = int(attr_dict.get("deadline_ns", 0))
+        attr.sched_period = int(attr_dict.get("period_ns", 0))
+
+    libc = _get_libc()
+    res = libc.syscall(_syscall_nr(), tid, ctypes.byref(attr), 0)
+    if res != 0:
+        e = ctypes.get_errno()
+        raise SchedError(e, f"sched_setattr(tid={tid}, {policy_name}): "
+                            f"{os.strerror(e)}")
+
+
+def reset_to_normal(tid: int) -> None:
+    set_attr(tid, {"policy": "SCHED_NORMAL", "nice": 0})

@@ -1,0 +1,61 @@
+"""procfs walking helpers.
+
+Parity: /root/reference/nmz/util/proc/procutil.go:28-111 — enumerate a
+process's light-weight processes (threads), children, and the transitive
+descendant LWP set, straight from /proc.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Set
+
+
+def lwps(pid: int) -> List[int]:
+    """Thread ids of ``pid`` (parity: LWPs, procutil.go:28-43)."""
+    task_dir = f"/proc/{pid}/task"
+    try:
+        return sorted(int(t) for t in os.listdir(task_dir) if t.isdigit())
+    except (FileNotFoundError, PermissionError):
+        return []
+
+
+def children(pid: int) -> List[int]:
+    """Direct children (parity: Children, procutil.go:45-65)."""
+    out: Set[int] = set()
+    for tid in lwps(pid):
+        path = f"/proc/{pid}/task/{tid}/children"
+        try:
+            with open(path) as f:
+                out.update(int(c) for c in f.read().split())
+        except (FileNotFoundError, PermissionError, ProcessLookupError):
+            continue
+    return sorted(out)
+
+
+def descendants(pid: int, max_depth: int = 64) -> List[int]:
+    """Transitive children, excluding ``pid`` itself
+    (parity: Descendants, procutil.go:67-87)."""
+    seen: Set[int] = set()
+    frontier = [pid]
+    for _ in range(max_depth):
+        nxt: List[int] = []
+        for p in frontier:
+            for c in children(p):
+                if c not in seen:
+                    seen.add(c)
+                    nxt.append(c)
+        if not nxt:
+            break
+        frontier = nxt
+    return sorted(seen)
+
+
+def descendant_lwps(pid: int) -> List[int]:
+    """All LWPs of ``pid`` and of every descendant — the full thread set
+    the scheduler fuzzer perturbs (parity: DescendantLWPs,
+    procutil.go:89-111)."""
+    out: Set[int] = set(lwps(pid))
+    for d in descendants(pid):
+        out.update(lwps(d))
+    return sorted(out)

@@ -1,0 +1,167 @@
+"""fs + ethernet inspector tests.
+
+Parity: the reference tests its fs inspector through a real (FUSE) mount
+doing mkdir/rmdir (fs_test.go:49-103) and the ethernet inspector with a
+fake switch. Here: InterposedFs over a tempdir, and a real TCP echo server
+behind the proxy inspector.
+"""
+
+import socket
+import threading
+
+import pytest
+
+from namazu_tpu.endpoint.hub import EndpointHub
+from namazu_tpu.endpoint.local import LocalEndpoint
+from namazu_tpu.inspector.ethernet import EthernetProxyInspector
+from namazu_tpu.inspector.fs import FsInspector, InterposedFs
+from namazu_tpu.inspector.transceiver import new_transceiver
+from namazu_tpu.orchestrator import AutopilotOrchestrator
+from namazu_tpu.utils.config import Config
+from namazu_tpu.utils.mock_orchestrator import MockOrchestrator
+
+
+@pytest.fixture
+def autopilot():
+    cfg = Config({"explore_policy": "random",
+                  "explore_policy_param": {"max_interval": 5}})
+    orc = AutopilotOrchestrator(cfg)
+    orc.start()
+    yield orc
+    orc.shutdown()
+
+
+def make_fs(tmp_path, orc, fault_probability=0.0, seed=0):
+    orc.policy.fault_action_probability = fault_probability
+    orc.policy.rng.seed(seed)
+    trans = new_transceiver("local://", "fs0", orc.local_endpoint)
+    insp = FsInspector(trans, entity_id="fs0", action_timeout=10)
+    insp.start()
+    return InterposedFs(str(tmp_path), insp), insp
+
+
+def test_interposed_fs_ops(tmp_path, autopilot):
+    fs, insp = make_fs(tmp_path, autopilot)
+    fs.mkdir("d")
+    fs.write("d/f.txt", b"hello")
+    assert fs.read("d/f.txt") == b"hello"
+    assert fs.listdir("d") == ["f.txt"]
+    fs.fsync("d/f.txt")
+    assert insp.hook_count == 5
+    assert (tmp_path / "d" / "f.txt").read_bytes() == b"hello"
+    (tmp_path / "d" / "f.txt").unlink()
+    fs.rmdir("d")
+    assert not (tmp_path / "d").exists()
+
+
+def test_fs_fault_injection_is_eio(tmp_path, autopilot):
+    fs, insp = make_fs(tmp_path, autopilot, fault_probability=1.0)
+    with pytest.raises(OSError) as ei:
+        fs.mkdir("d2")
+    assert ei.value.errno == 5  # EIO
+    assert not (tmp_path / "d2").exists()  # pre-hook fault prevents the op
+    assert insp.fault_count == 1
+
+
+def test_fs_path_escape_rejected(tmp_path, autopilot):
+    fs, _ = make_fs(tmp_path, autopilot)
+    with pytest.raises(ValueError):
+        fs.read("../../etc/passwd")
+
+
+@pytest.fixture
+def echo_server():
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    stop = threading.Event()
+
+    def serve():
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            def echo(c):
+                while True:
+                    try:
+                        data = c.recv(65536)
+                    except OSError:
+                        return
+                    if not data:
+                        return
+                    c.sendall(data)
+            threading.Thread(target=echo, args=(conn,), daemon=True).start()
+
+    threading.Thread(target=serve, daemon=True).start()
+    yield srv.getsockname()
+    stop.set()
+    srv.close()
+
+
+def test_proxy_inspector_passes_traffic(echo_server, autopilot):
+    host, port = echo_server
+    trans = new_transceiver("local://", "eth0", autopilot.local_endpoint)
+    insp = EthernetProxyInspector(trans, entity_id="eth0", action_timeout=10)
+    link = insp.add_link("127.0.0.1:0", f"{host}:{port}", "client", "server")
+    insp.start()
+    try:
+        c = socket.create_connection(("127.0.0.1", link.port), timeout=5)
+        c.sendall(b"ping-1")
+        assert c.recv(1024) == b"ping-1"
+        c.sendall(b"ping-2")
+        assert c.recv(1024) == b"ping-2"
+        c.close()
+        assert insp.packet_count >= 4  # 2 requests + 2 responses
+    finally:
+        insp.stop()
+
+
+def test_proxy_inspector_drop_fault(echo_server, autopilot):
+    host, port = echo_server
+    autopilot.policy.fault_action_probability = 1.0
+    trans = new_transceiver("local://", "eth1", autopilot.local_endpoint)
+    insp = EthernetProxyInspector(trans, entity_id="eth1", action_timeout=10)
+    link = insp.add_link("127.0.0.1:0", f"{host}:{port}", "client", "server")
+    insp.start()
+    try:
+        c = socket.create_connection(("127.0.0.1", link.port), timeout=5)
+        c.sendall(b"will-be-dropped")
+        c.settimeout(0.5)
+        with pytest.raises(socket.timeout):
+            c.recv(1024)  # the chunk was dropped; echo never arrives
+        assert insp.drop_count >= 1
+        c.close()
+    finally:
+        insp.stop()
+
+
+def test_proxy_parser_sets_replay_hint(echo_server):
+    hub = EndpointHub()
+    lep = LocalEndpoint()
+    hub.add_endpoint(lep)
+    mock = MockOrchestrator(hub)
+    mock.start()
+    seen_hints = []
+
+    def parser(chunk, src, dst):
+        hint = f"msg:{chunk[:4].decode(errors='replace')}"
+        seen_hints.append(hint)
+        return hint
+
+    host, port = echo_server
+    trans = new_transceiver("local://", "eth2", lep)
+    insp = EthernetProxyInspector(trans, entity_id="eth2", parser=parser,
+                                  action_timeout=10)
+    link = insp.add_link("127.0.0.1:0", f"{host}:{port}", "a", "b")
+    insp.start()
+    try:
+        c = socket.create_connection(("127.0.0.1", link.port), timeout=5)
+        c.sendall(b"VOTE:n1")
+        assert c.recv(1024) == b"VOTE:n1"
+        c.close()
+        assert "msg:VOTE" in seen_hints
+    finally:
+        insp.stop()
+        mock.shutdown()

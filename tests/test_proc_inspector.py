@@ -1,0 +1,139 @@
+"""Proc inspector tests against this test's own process tree.
+
+Parity: the reference exercises the proc inspector via procfs on the test's
+own processes (SURVEY.md section 4). sched_setattr is applied to our own
+spawned children, which needs no privileges for SCHED_NORMAL/SCHED_BATCH.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from namazu_tpu.inspector.proc import ProcInspector, serve_with_command
+from namazu_tpu.inspector.transceiver import new_transceiver
+from namazu_tpu.orchestrator import AutopilotOrchestrator
+from namazu_tpu.utils import linuxsched, procfs
+from namazu_tpu.utils.config import Config
+
+CHILD_SRC = """
+import threading, time
+def spin():
+    time.sleep(30)
+ts = [threading.Thread(target=spin) for _ in range(3)]
+for t in ts: t.start()
+print("ready", flush=True)
+for t in ts: t.join()
+"""
+
+
+@pytest.fixture
+def child():
+    proc = subprocess.Popen(
+        [sys.executable, "-c", CHILD_SRC], stdout=subprocess.PIPE
+    )
+    proc.stdout.readline()  # wait for threads to exist
+    yield proc
+    proc.kill()
+    proc.wait()
+
+
+def test_procfs_walk_finds_threads(child):
+    tids = procfs.lwps(child.pid)
+    assert child.pid in tids
+    assert len(tids) >= 4  # main + 3 spinners
+
+
+def test_procfs_descendants_of_shell():
+    sh = subprocess.Popen(["sh", "-c", "sleep 5 & sleep 5 & wait"])
+    try:
+        time.sleep(0.3)
+        desc = procfs.descendants(sh.pid)
+        assert len(desc) >= 2
+        all_lwps = procfs.descendant_lwps(sh.pid)
+        assert set(desc) <= set(all_lwps)
+    finally:
+        sh.kill()
+        sh.wait()
+
+
+def test_sched_setattr_on_own_child(child):
+    linuxsched.set_attr(child.pid, {"policy": "SCHED_BATCH", "nice": 5})
+    with open(f"/proc/{child.pid}/stat") as f:
+        fields = f.read().rsplit(")", 1)[1].split()
+    # policy is field 41 (1-indexed), i.e. index 38 after the comm field
+    assert int(fields[38]) == linuxsched.SCHED_BATCH
+    linuxsched.reset_to_normal(child.pid)
+
+
+def test_sched_setattr_bad_policy_raises(child):
+    with pytest.raises(linuxsched.SchedError):
+        linuxsched.set_attr(child.pid, {"policy": "SCHED_WARP"})
+
+
+def test_inspector_end_to_end_with_random_policy(child):
+    cfg = Config({
+        "explore_policy": "random",
+        "explore_policy_param": {"proc_policy": "mild", "seed": 3},
+    })
+    orc = AutopilotOrchestrator(cfg)
+    orc.start()
+    trans = new_transceiver("local://", "proc0", orc.local_endpoint)
+    inspector = ProcInspector(
+        trans, child.pid, entity_id="proc0",
+        watch_interval=0.05, action_timeout=5.0,
+    )
+    t = threading.Thread(target=inspector.serve, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 10
+        while inspector.watch_count < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert inspector.watch_count >= 3
+        # the child's threads now carry a fuzzed policy (NORMAL or BATCH)
+        with open(f"/proc/{child.pid}/stat") as f:
+            fields = f.read().rsplit(")", 1)[1].split()
+        assert int(fields[38]) in (linuxsched.SCHED_NORMAL, linuxsched.SCHED_BATCH)
+    finally:
+        inspector.stop()
+        t.join(timeout=5)
+        orc.shutdown()
+    for tid in procfs.lwps(child.pid):
+        try:
+            linuxsched.reset_to_normal(tid)
+        except linuxsched.SchedError:
+            pass
+
+
+def test_serve_with_command_returns_exit_status():
+    cfg = Config({"explore_policy": "random"})
+    orc = AutopilotOrchestrator(cfg)
+    orc.start()
+    trans = new_transceiver("local://", "proc1", orc.local_endpoint)
+    try:
+        rc = serve_with_command(
+            trans, ["sh", "-c", "sleep 0.3; exit 7"],
+            entity_id="proc1", watch_interval=0.05,
+        )
+        assert rc == 7
+    finally:
+        orc.shutdown()
+
+
+def test_inspector_stops_when_target_dies():
+    proc = subprocess.Popen(["sleep", "0.2"])
+    cfg = Config({"explore_policy": "random"})
+    orc = AutopilotOrchestrator(cfg)
+    orc.start()
+    trans = new_transceiver("local://", "proc2", orc.local_endpoint)
+    inspector = ProcInspector(trans, proc.pid, entity_id="proc2",
+                              watch_interval=0.05)
+    t = threading.Thread(target=inspector.serve, daemon=True)
+    t.start()
+    proc.wait()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    orc.shutdown()
