@@ -1,0 +1,2 @@
+"""Search-plane models: the genetic algorithm over schedule genomes and the
+learned reward surrogate."""

@@ -1,0 +1,184 @@
+"""ScheduleSearch: the host-side driver around the sharded island GA.
+
+Owns the novelty/failure archives (host ring buffers mirrored to device),
+runs generations on the mesh, and extracts the best delay/fault tables for
+the control plane to replay. Checkpointing is plain ``.npz`` (population,
+archives, RNG state) — search state survives across experiment runs, which
+the reference has no equivalent for (SURVEY.md section 5.4).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from namazu_tpu.models.ga import GAConfig
+from namazu_tpu.ops import trace_encoding as te
+from namazu_tpu.ops.schedule import ScoreWeights
+
+
+class SearchConfig(NamedTuple):
+    H: int = te.DEFAULT_H  # hint buckets (genome length)
+    L: int = te.DEFAULT_L  # max trace length
+    K: int = te.DEFAULT_K  # feature pairs
+    archive_size: int = 512  # novelty archive capacity
+    failure_size: int = 64  # failure archive capacity
+    population: int = 4096  # total genomes across all islands
+    migrate_k: int = 8
+    seed: int = 0
+    ga: GAConfig = GAConfig()
+    weights: ScoreWeights = ScoreWeights()
+
+
+class BestSchedule(NamedTuple):
+    delays: np.ndarray  # f32[H] seconds per hint bucket
+    faults: np.ndarray  # f32[H] fault probability per hint bucket
+    fitness: float
+
+
+class ScheduleSearch:
+    def __init__(self, cfg: SearchConfig = SearchConfig(),
+                 mesh=None, n_devices: Optional[int] = None):
+        import jax
+
+        from namazu_tpu.parallel.islands import (
+            init_island_state,
+            make_island_step,
+        )
+        from namazu_tpu.parallel.mesh import make_mesh
+
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        n_islands = self.mesh.shape["i"]
+        # population must divide evenly across islands
+        per_island = max(1, cfg.population // n_islands)
+        self.population = per_island * n_islands
+
+        self.pairs = te.sample_pairs(cfg.K, cfg.H, cfg.seed)
+        # neutral (0.5) features = "no information"; rings overwrite oldest
+        self.archive = np.full((cfg.archive_size, cfg.K), 0.5, np.float32)
+        self._archive_n = 0
+        self.failures = np.full((cfg.failure_size, cfg.K), 0.5, np.float32)
+        self._failure_n = 0
+
+        self._key = jax.random.PRNGKey(cfg.seed)
+        self._step = make_island_step(
+            self.mesh, cfg.ga, cfg.weights, migrate_k=cfg.migrate_k
+        )
+        self._state = init_island_state(
+            jax.random.PRNGKey(cfg.seed + 1), self.population, cfg.H, cfg.ga
+        )
+        self.generations_run = 0
+
+    # -- archives --------------------------------------------------------
+
+    def _feats_of(self, encoded: te.EncodedTrace) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from namazu_tpu.ops.schedule import TraceArrays, trace_features
+
+        trace = TraceArrays(
+            jnp.asarray(encoded.hint_ids),
+            jnp.asarray(encoded.arrival),
+            jnp.asarray(encoded.mask),
+        )
+        f = trace_features(trace, jnp.asarray(self.pairs),
+                           self.cfg.weights.tau, self.cfg.H)
+        return np.asarray(f)
+
+    def add_executed_trace(self, encoded: te.EncodedTrace) -> None:
+        """Record an executed run's interleaving into the novelty archive."""
+        self.archive[self._archive_n % self.cfg.archive_size] = (
+            self._feats_of(encoded)
+        )
+        self._archive_n += 1
+
+    def add_failure_trace(self, encoded: te.EncodedTrace) -> None:
+        """Record a bug-reproducing run — the bug-affinity target."""
+        self.failures[self._failure_n % self.cfg.failure_size] = (
+            self._feats_of(encoded)
+        )
+        self._failure_n += 1
+
+    # -- search ----------------------------------------------------------
+
+    def run(self, encoded: te.EncodedTrace, generations: int = 50) -> BestSchedule:
+        """Evolve against one reference trace for N generations; returns
+        the best schedule seen so far (monotonic across calls)."""
+        import jax.numpy as jnp
+
+        from namazu_tpu.ops.schedule import TraceArrays
+
+        trace = TraceArrays(
+            jnp.asarray(encoded.hint_ids),
+            jnp.asarray(encoded.arrival),
+            jnp.asarray(encoded.mask),
+        )
+        pairs = jnp.asarray(self.pairs)
+        archive = jnp.asarray(self.archive)
+        failures = jnp.asarray(self.failures)
+        state = self._state
+        for _ in range(generations):
+            state = self._step(state, self._key, trace, pairs, archive,
+                               failures)
+        state.best_fitness.block_until_ready()
+        self._state = state
+        self.generations_run += generations
+        return self.best()
+
+    def best(self) -> BestSchedule:
+        return BestSchedule(
+            delays=np.asarray(self._state.best_delays),
+            faults=np.asarray(self._state.best_faults),
+            fitness=float(self._state.best_fitness),
+        )
+
+    # -- persistence -----------------------------------------------------
+
+    def save(self, path: str) -> None:
+        import jax
+
+        flat = {
+            "pop_delays": np.asarray(self._state.pop.delays),
+            "pop_faults": np.asarray(self._state.pop.faults),
+            "gen": np.asarray(self._state.gen),
+            "best_fitness": np.asarray(self._state.best_fitness),
+            "best_delays": np.asarray(self._state.best_delays),
+            "best_faults": np.asarray(self._state.best_faults),
+            "archive": self.archive,
+            "archive_n": np.asarray(self._archive_n),
+            "failures": self.failures,
+            "failure_n": np.asarray(self._failure_n),
+            "key": np.asarray(jax.random.key_data(self._key)),
+            "generations_run": np.asarray(self.generations_run),
+        }
+        tmp = path + ".tmp"
+        np.savez(tmp, **flat)
+        os.replace(tmp + ".npz" if not tmp.endswith(".npz") else tmp, path)
+
+    def load(self, path: str) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from namazu_tpu.parallel.islands import IslandState
+        from namazu_tpu.models.ga import Population
+
+        with np.load(path) as z:
+            self._state = IslandState(
+                pop=Population(
+                    delays=jnp.asarray(z["pop_delays"]),
+                    faults=jnp.asarray(z["pop_faults"]),
+                ),
+                gen=jnp.asarray(z["gen"]),
+                best_fitness=jnp.asarray(z["best_fitness"]),
+                best_delays=jnp.asarray(z["best_delays"]),
+                best_faults=jnp.asarray(z["best_faults"]),
+            )
+            self.archive = z["archive"]
+            self._archive_n = int(z["archive_n"])
+            self.failures = z["failures"]
+            self._failure_n = int(z["failure_n"])
+            self._key = jax.random.wrap_key_data(jnp.asarray(z["key"]))
+            self.generations_run = int(z["generations_run"])

@@ -1,0 +1,106 @@
+"""Learned reward surrogate: predict bug-reproduction probability from
+schedule features.
+
+The experiment oracle (validate script) is binary and costs a whole
+wall-clock run (SURVEY.md section 7, "reward sparsity"). This small flax
+MLP is trained online on (features, reproduced?) pairs from executed runs
+and provides a dense score used to re-rank GA elites before paying for
+real replays — the "learned surrogate" of BASELINE.json config 5.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+
+class SurrogateMLP(nn.Module):
+    hidden: int = 128
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.hidden)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.hidden // 2)(x)
+        x = nn.relu(x)
+        return nn.Dense(1)(x)[..., 0]  # logits
+
+
+class SurrogateState(NamedTuple):
+    params: dict
+    opt_state: optax.OptState
+    steps: jax.Array
+
+
+class RewardSurrogate:
+    def __init__(self, K: int, hidden: int = 128, lr: float = 1e-3,
+                 seed: int = 0):
+        self.model = SurrogateMLP(hidden=hidden)
+        self.tx = optax.adam(lr)
+        params = self.model.init(
+            jax.random.PRNGKey(seed), jnp.zeros((1, K), jnp.float32)
+        )
+        self.state = SurrogateState(
+            params=params,
+            opt_state=self.tx.init(params),
+            steps=jnp.zeros((), jnp.int32),
+        )
+
+        def loss_fn(params, feats, labels):
+            logits = self.model.apply(params, feats)
+            return optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+
+        @jax.jit
+        def train_step(state: SurrogateState, feats, labels):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                state.params, feats, labels
+            )
+            updates, opt_state = self.tx.update(grads, state.opt_state,
+                                                state.params)
+            params = optax.apply_updates(state.params, updates)
+            return SurrogateState(params, opt_state, state.steps + 1), loss
+
+        @jax.jit
+        def predict_fn(state: SurrogateState, feats):
+            return jax.nn.sigmoid(self.model.apply(state.params, feats))
+
+        self._train_step = train_step
+        self._predict = predict_fn
+
+    def train(self, feats: np.ndarray, labels: np.ndarray,
+              epochs: int = 1, batch: int = 256,
+              seed: int = 0) -> float:
+        """Train on (feats [N,K], labels [N] in {0,1}); returns last loss."""
+        n = len(feats)
+        rng = np.random.RandomState(seed)
+        loss = 0.0
+        for _ in range(epochs):
+            order = rng.permutation(n)
+            for i in range(0, n, batch):
+                idx = order[i : i + batch]
+                self.state, l = self._train_step(
+                    self.state,
+                    jnp.asarray(feats[idx]),
+                    jnp.asarray(labels[idx], jnp.float32),
+                )
+                loss = float(l)
+        return loss
+
+    def predict(self, feats: np.ndarray) -> np.ndarray:
+        """P(reproduce bug) per feature vector."""
+        return np.asarray(self._predict(self.state, jnp.asarray(feats)))
+
+    def rerank(self, feats: np.ndarray,
+               top: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+        """Indices (desc) + probabilities; used to pick which GA elites get
+        real wall-clock replays."""
+        p = self.predict(feats)
+        order = np.argsort(-p)
+        if top is not None:
+            order = order[:top]
+        return order, p[order]

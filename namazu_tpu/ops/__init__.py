@@ -1,0 +1,6 @@
+"""TPU search-plane ops: trace encoding, schedule scoring, Pallas kernels.
+
+No reference counterpart — this plane replaces the reference's random timer
+races (nmz/util/queue/impl.go) with a massively parallel, learned search
+over schedule genomes (BASELINE.json north star).
+"""

@@ -1,0 +1,152 @@
+"""Pure-JAX schedule scoring: the search plane's inner loop.
+
+A *schedule genome* is a per-hint-bucket delay table ``delays f32[H]``
+(seconds) plus a per-hint fault-probability table ``faults f32[H]``. Given
+a recorded trace, the counterfactual interleaving under a genome is defined
+by release times ``t[e] = arrival[e] + delays[hint_ids[e]]`` — exactly what
+the control plane's ScheduledQueue realizes when the policy replays the
+genome (namazu_tpu/policy/tpu.py), so scored schedules and executed
+schedules agree by construction.
+
+Scoring (vmapped over a population [P, H]):
+
+1. first-occurrence time per hint bucket, ``first f32[H]`` (scatter-min);
+2. precedence features over K sampled bucket pairs:
+   ``feat[k] = sigmoid((first[v_k] - first[u_k]) / tau)`` — a smooth
+   "does u happen before v" indicator in (0,1); buckets absent from the
+   trace get BIG times, making their pairs a neutral 0.5;
+3. novelty = min squared L2 distance to an archive of previously executed
+   schedules' features (one [P,K]x[K,A] matmul — MXU work);
+4. bug affinity = -min squared distance to the features of traces that
+   actually reproduced the bug (failure archive);
+5. fitness = w_novelty * novelty + w_bug * bug_affinity
+   - w_delay_cost * mean(delays)  (prefer fast schedules, tie-break).
+
+This plane generalizes the reference's whole exploration stack: the random
+policy samples ONE schedule per wall-clock run (~minutes); here millions
+are scored per second between runs, and only the argmax is paid for with
+wall-clock (SURVEY.md section 6, BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+BIG = 1e9  # "never happens" release time
+
+
+class TraceArrays(NamedTuple):
+    """Static-shape view of one encoded trace on device."""
+
+    hint_ids: jax.Array  # int32[L]
+    arrival: jax.Array  # float32[L]
+    mask: jax.Array  # bool[L]
+
+
+class ScoreWeights(NamedTuple):
+    novelty: float = 1.0
+    bug: float = 1.0
+    delay_cost: float = 0.01
+    tau: float = 0.005  # precedence smoothing, seconds
+
+
+def release_times(delays: jax.Array, trace: TraceArrays) -> jax.Array:
+    """t[e] = arrival[e] + delays[hint_ids[e]] (masked -> BIG)."""
+    t = trace.arrival + delays[trace.hint_ids]
+    return jnp.where(trace.mask, t, BIG)
+
+
+def first_occurrence(t: jax.Array, trace: TraceArrays, H: int) -> jax.Array:
+    """Earliest release time per hint bucket, BIG where absent."""
+    return jnp.full((H,), BIG, t.dtype).at[trace.hint_ids].min(
+        jnp.where(trace.mask, t, BIG)
+    )
+
+
+def precedence_features(
+    first: jax.Array, pairs: jax.Array, tau: float
+) -> jax.Array:
+    """feat[k] = sigmoid((first[v_k] - first[u_k]) / tau) in (0,1)."""
+    du = first[pairs[:, 0]]
+    dv = first[pairs[:, 1]]
+    # clip the argument so BIG-vs-finite saturates instead of overflowing
+    z = jnp.clip((dv - du) / tau, -30.0, 30.0)
+    return jax.nn.sigmoid(z)
+
+
+def schedule_features(
+    delays: jax.Array, trace: TraceArrays, pairs: jax.Array, tau: float
+) -> jax.Array:
+    """One genome -> feature vector f32[K]."""
+    H = delays.shape[0]
+    t = release_times(delays, trace)
+    first = first_occurrence(t, trace, H)
+    return precedence_features(first, pairs, tau)
+
+
+def trace_features(
+    trace: TraceArrays, pairs: jax.Array, tau: float, H: int
+) -> jax.Array:
+    """Feature vector of a trace *as recorded* (zero extra delay) — used to
+    embed executed runs (including failures) into the same space."""
+    zero = jnp.zeros((H,), jnp.float32)
+    return schedule_features(zero, trace, pairs, tau)
+
+
+def _matmul_dtype():
+    """bf16 on TPU (MXU-native), f32 elsewhere (the CPU backend has no
+    bf16xbf16->f32 dot)."""
+    return jnp.bfloat16 if jax.default_backend() in ("tpu", "axon") else jnp.float32
+
+
+def min_sq_distance(feats: jax.Array, archive: jax.Array) -> jax.Array:
+    """min_a ||f_p - a||^2 via the matmul expansion (MXU-friendly).
+
+    feats [P,K], archive [A,K] -> [P]. bf16 inputs on TPU, f32 accumulation.
+    """
+    dt = _matmul_dtype()
+    f16 = feats.astype(dt)
+    a16 = archive.astype(dt)
+    cross = jax.lax.dot_general(
+        f16, a16,
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [P, A]
+    f2 = jnp.sum(feats * feats, axis=-1, keepdims=True)  # [P,1]
+    a2 = jnp.sum(archive * archive, axis=-1)  # [A]
+    d2 = f2 + a2[None, :] - 2.0 * cross
+    return jnp.maximum(jnp.min(d2, axis=-1), 0.0)
+
+
+def score_population(
+    delays: jax.Array,  # [P, H]
+    trace: TraceArrays,
+    pairs: jax.Array,  # [K, 2]
+    archive: jax.Array,  # [A, K] features of executed schedules
+    failure_feats: jax.Array,  # [F, K] features of bug-reproducing runs
+    weights: ScoreWeights = ScoreWeights(),
+) -> tuple[jax.Array, jax.Array]:
+    """Fitness f32[P] and features f32[P,K] for a whole population."""
+    feats = jax.vmap(
+        lambda d: schedule_features(d, trace, pairs, weights.tau)
+    )(delays)
+    novelty = min_sq_distance(feats, archive)
+    bug = -min_sq_distance(feats, failure_feats)
+    delay_cost = jnp.mean(delays, axis=-1)
+    fitness = (
+        weights.novelty * novelty
+        + weights.bug * bug
+        - weights.delay_cost * delay_cost
+    )
+    return fitness, feats
+
+
+@functools.partial(jax.jit, static_argnames=("weights",))
+def score_population_jit(delays, trace, pairs, archive, failure_feats,
+                         weights: ScoreWeights = ScoreWeights()):
+    return score_population(delays, trace, pairs, archive, failure_feats,
+                            weights)

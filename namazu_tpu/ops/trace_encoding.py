@@ -1,0 +1,136 @@
+"""Host-side trace featurization: recorded runs -> fixed-shape arrays.
+
+The control plane records variable-length action traces (JSON). The search
+plane needs static shapes for XLA, so each trace is encoded as:
+
+* ``hint_ids``  int32[L] — replay hint hashed (fnv64a) into H buckets; the
+  hint bucket is the unit the genome's delay table indexes, generalizing
+  the replayable policy's ``hash(seed, hint) % max`` delays;
+* ``entity_ids`` int32[L] — entity index (stable per experiment);
+* ``arrival``   float32[L] — event arrival offset in seconds from run start
+  (triggered/arrival times when recorded; index spacing otherwise);
+* ``mask``      bool[L] — valid positions (traces are padded/truncated).
+
+Precedence *pairs* are sampled over hint buckets (not positions) so the
+feature space is comparable across runs — a failed run's trace and a
+candidate schedule's counterfactual interleaving land in the same space.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from namazu_tpu.policy.replayable import fnv64a
+from namazu_tpu.utils.trace import SingleTrace
+
+DEFAULT_L = 256  # max events per encoded trace
+DEFAULT_H = 256  # hint buckets (genome length)
+DEFAULT_K = 256  # precedence pairs (feature dimension)
+
+
+def hint_bucket(hint: str, n_buckets: int = DEFAULT_H) -> int:
+    return fnv64a(hint.encode()) % n_buckets
+
+
+class EncodedTrace:
+    """One trace in array form (plain numpy; converted to jnp at the device
+    boundary)."""
+
+    def __init__(self, hint_ids, entity_ids, arrival, mask):
+        self.hint_ids = np.asarray(hint_ids, np.int32)
+        self.entity_ids = np.asarray(entity_ids, np.int32)
+        self.arrival = np.asarray(arrival, np.float32)
+        self.mask = np.asarray(mask, bool)
+
+    @property
+    def length(self) -> int:
+        return int(self.mask.sum())
+
+
+def encode_trace(
+    trace: SingleTrace,
+    L: int = DEFAULT_L,
+    H: int = DEFAULT_H,
+    entity_index: Optional[Dict[str, int]] = None,
+) -> EncodedTrace:
+    """Encode a recorded action trace.
+
+    Replay hints are reconstructed from each action's cause-event class +
+    entity + option (the action's own entity/class carry the semantic
+    identity; uuids and timing are excluded, matching the hint contract).
+    """
+    entity_index = entity_index if entity_index is not None else {}
+    hint_ids = np.zeros(L, np.int32)
+    entity_ids = np.zeros(L, np.int32)
+    arrival = np.zeros(L, np.float32)
+    mask = np.zeros(L, bool)
+
+    times: List[float] = []
+    for a in trace:
+        times.append(a.triggered_time if a.triggered_time else 0.0)
+    t0 = min((t for t in times if t), default=0.0)
+
+    for i, action in enumerate(trace):
+        if i >= L:
+            break
+        ent = action.entity_id
+        if ent not in entity_index:
+            entity_index[ent] = len(entity_index)
+        hint = f"{action.event_class or action.class_name()}:{ent}"
+        hint_ids[i] = hint_bucket(hint, H)
+        entity_ids[i] = entity_index[ent]
+        arrival[i] = (times[i] - t0) if times[i] else i * 1e-3
+        mask[i] = True
+    return EncodedTrace(hint_ids, entity_ids, arrival, mask)
+
+
+def encode_event_stream(
+    hints: Sequence[str],
+    arrivals: Optional[Sequence[float]] = None,
+    entities: Optional[Sequence[str]] = None,
+    L: int = DEFAULT_L,
+    H: int = DEFAULT_H,
+) -> EncodedTrace:
+    """Encode a live event stream (the TPU policy's view of the current
+    run) from raw replay hints."""
+    n = min(len(hints), L)
+    hint_ids = np.zeros(L, np.int32)
+    entity_ids = np.zeros(L, np.int32)
+    arrival = np.zeros(L, np.float32)
+    mask = np.zeros(L, bool)
+    ent_index: Dict[str, int] = {}
+    for i in range(n):
+        hint_ids[i] = hint_bucket(hints[i], H)
+        if entities is not None:
+            e = entities[i]
+            if e not in ent_index:
+                ent_index[e] = len(ent_index)
+            entity_ids[i] = ent_index[e]
+        arrival[i] = arrivals[i] if arrivals is not None else i * 1e-3
+        mask[i] = True
+    return EncodedTrace(hint_ids, entity_ids, arrival, mask)
+
+
+def sample_pairs(
+    K: int = DEFAULT_K, H: int = DEFAULT_H, seed: int = 0
+) -> np.ndarray:
+    """Deterministically sample K ordered hint-bucket pairs (u != v); the
+    precedence of bucket-u's first event vs bucket-v's first event is one
+    feature dimension."""
+    rng = np.random.RandomState(seed)
+    u = rng.randint(0, H, size=K).astype(np.int32)
+    v = rng.randint(0, H - 1, size=K).astype(np.int32)
+    v = np.where(v >= u, v + 1, v).astype(np.int32)  # ensure u != v
+    return np.stack([u, v], axis=1)  # [K, 2]
+
+
+def stack_traces(traces: Sequence[EncodedTrace]) -> Tuple[np.ndarray, ...]:
+    """Stack encoded traces into batched arrays [T, L]."""
+    return (
+        np.stack([t.hint_ids for t in traces]),
+        np.stack([t.entity_ids for t in traces]),
+        np.stack([t.arrival for t in traces]),
+        np.stack([t.mask for t in traces]),
+    )

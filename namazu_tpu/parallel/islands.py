@@ -1,0 +1,131 @@
+"""Island-model GA over a device mesh.
+
+Each device evolves an independent population shard ("island"); every step
+
+* scores its local genomes (vmap -> VPU/MXU),
+* evolves one GA generation locally,
+* migrates its elite genomes to the next island on a ring (``ppermute``
+  over ICI, replacing the neighbor's worst genomes),
+* and agrees on the global best via ``all_gather`` (tiny: one genome per
+  island).
+
+Everything device-to-device rides XLA collectives; the host only sees the
+replicated global best. This is the TPU-native replacement for the
+reference's single-process random exploration (SURVEY.md section 2.9).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from namazu_tpu.models.ga import GAConfig, Population, ga_generation, init_population
+from namazu_tpu.ops.schedule import ScoreWeights, TraceArrays, score_population
+
+
+class IslandState(NamedTuple):
+    pop: Population  # delays/faults f32[P, H], sharded over axis i
+    gen: jax.Array  # int32 scalar, replicated
+    best_fitness: jax.Array  # f32 scalar, replicated
+    best_delays: jax.Array  # f32[H], replicated
+    best_faults: jax.Array  # f32[H], replicated
+
+
+def init_island_state(key: jax.Array, P_total: int, H: int,
+                      cfg: GAConfig) -> IslandState:
+    pop = init_population(key, P_total, H, cfg)
+    return IslandState(
+        pop=pop,
+        gen=jnp.zeros((), jnp.int32),
+        best_fitness=jnp.full((), -jnp.inf, jnp.float32),
+        best_delays=jnp.zeros((H,), jnp.float32),
+        best_faults=jnp.zeros((H,), jnp.float32),
+    )
+
+
+def make_island_step(
+    mesh: Mesh,
+    cfg: GAConfig,
+    weights: ScoreWeights = ScoreWeights(),
+    migrate_k: int = 8,
+    axis: str = "i",
+):
+    """Build the jitted sharded step:
+    (state, base_key, trace, pairs, archive, failure_feats) -> state.
+    """
+    n_islands = mesh.shape[axis]
+
+    def _local_step(key, pop, trace, pairs, archive, failure_feats):
+        idx = jax.lax.axis_index(axis)
+        key = jax.random.fold_in(key, idx)
+
+        fitness, _feats = score_population(
+            pop.delays, trace, pairs, archive, failure_feats, weights
+        )
+        # local best before evolution (elites survive anyway)
+        best_i = jnp.argmax(fitness)
+        local_best_fit = fitness[best_i]
+        local_best_d = pop.delays[best_i]
+        local_best_f = pop.faults[best_i]
+
+        new_pop = ga_generation(key, pop, fitness, cfg)
+
+        # ring migration of the top-k genomes (replace neighbor's worst)
+        if n_islands > 1 and migrate_k > 0:
+            k = migrate_k
+            top_idx = jax.lax.top_k(fitness, k)[1]
+            perm = [(j, (j + 1) % n_islands) for j in range(n_islands)]
+            mig_d = jax.lax.ppermute(new_pop.delays[top_idx], axis, perm)
+            mig_f = jax.lax.ppermute(new_pop.faults[top_idx], axis, perm)
+            worst_idx = jax.lax.top_k(-fitness, k)[1]
+            new_pop = Population(
+                delays=new_pop.delays.at[worst_idx].set(mig_d),
+                faults=new_pop.faults.at[worst_idx].set(mig_f),
+            )
+
+        # replicated global best: gather one candidate per island
+        all_fit = jax.lax.all_gather(local_best_fit, axis)  # [nd]
+        all_d = jax.lax.all_gather(local_best_d, axis)  # [nd, H]
+        all_f = jax.lax.all_gather(local_best_f, axis)
+        g = jnp.argmax(all_fit)
+        return new_pop, all_fit[g], all_d[g], all_f[g]
+
+    sharded = jax.shard_map(
+        _local_step,
+        mesh=mesh,
+        in_specs=(
+            P(),  # key
+            Population(delays=P(axis, None), faults=P(axis, None)),
+            TraceArrays(hint_ids=P(), arrival=P(), mask=P()),
+            P(),  # pairs
+            P(),  # archive
+            P(),  # failure feats
+        ),
+        out_specs=(
+            Population(delays=P(axis, None), faults=P(axis, None)),
+            P(), P(), P(),
+        ),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(state: IslandState, base_key, trace: TraceArrays, pairs,
+             archive, failure_feats) -> IslandState:
+        key = jax.random.fold_in(base_key, state.gen)
+        new_pop, fit, bd, bf = sharded(
+            key, state.pop, trace, pairs, archive, failure_feats
+        )
+        improved = fit > state.best_fitness
+        return IslandState(
+            pop=new_pop,
+            gen=state.gen + 1,
+            best_fitness=jnp.where(improved, fit, state.best_fitness),
+            best_delays=jnp.where(improved, bd, state.best_delays),
+            best_faults=jnp.where(improved, bf, state.best_faults),
+        )
+
+    return step

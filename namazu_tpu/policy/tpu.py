@@ -1,0 +1,214 @@
+"""tpu_search policy: replay the best schedule found by the TPU search.
+
+The BASELINE.json north-star component: behind the same ``register_policy``
+plugin boundary as every other policy, but its delays are not random — they
+come from a per-hint-bucket delay table evolved by the island GA
+(namazu_tpu/models/search.py) against the experiment's recorded history.
+
+Division of labor (latency budget, SURVEY.md section 7):
+
+* **off the critical path**: at policy start (and between runs), a
+  background thread featurizes stored traces, adds them to the novelty/
+  failure archives, runs GA generations on the device mesh, and installs
+  the best ``delays[H]`` / ``faults[H]`` tables atomically;
+* **on the critical path**: each event costs one fnv64a hash + one table
+  lookup, then rides the same ScheduledQueue as every other policy. Until
+  the first search finishes, delays fall back to the replayable policy's
+  hash(seed, hint) — so the policy is never worse than `replayable`.
+
+Fault decisions are deterministic per (seed, hint) so a found schedule
+replays exactly.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from namazu_tpu.policy.base import QueueBackedPolicy, register_policy
+from namazu_tpu.policy.replayable import fnv64a, hint_delay
+from namazu_tpu.signal.action import ProcSetSchedAction
+from namazu_tpu.signal.event import Event, ProcSetEvent
+from namazu_tpu.policy.proc_subpolicies import create_proc_subpolicy
+from namazu_tpu.utils.config import parse_duration
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("policy.tpu")
+
+
+class TPUSearchPolicy(QueueBackedPolicy):
+    NAME = "tpu_search"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.seed = 0
+        self.max_interval = 0.1
+        self.generations = 64
+        self.population = 4096
+        self.H = 256
+        self.L = 256
+        self.K = 256
+        self.migrate_k = 8
+        self.n_devices: Optional[int] = None
+        self.checkpoint_path = ""
+        self.search_on_start = True
+        self.max_fault = 0.0
+        self.proc_policy_name = "mild"
+        import random as _random
+
+        self._rng = _random.Random(0)
+        self._proc_policy = create_proc_subpolicy("mild", self._rng)
+        # installed schedule tables (numpy arrays; rebinding is atomic)
+        self._delays = None
+        self._faults = None
+        self._search = None
+        self._search_thread: Optional[threading.Thread] = None
+        self._search_lock = threading.Lock()
+
+    # -- config ----------------------------------------------------------
+
+    def load_config(self, config) -> None:
+        p = config.policy_param
+        self.seed = int(p("seed", 0))
+        self._rng.seed(self.seed)
+        self.max_interval = parse_duration(p("max_interval", 100))
+        self.generations = int(p("generations", self.generations))
+        self.population = int(p("population", self.population))
+        self.H = int(p("hint_buckets", self.H))
+        self.L = int(p("trace_length", self.L))
+        self.K = int(p("feature_pairs", self.K))
+        self.migrate_k = int(p("migrate_k", self.migrate_k))
+        nd = p("devices", None)
+        self.n_devices = int(nd) if nd is not None else None
+        self.checkpoint_path = str(p("checkpoint", "") or "")
+        self.search_on_start = bool(p("search_on_start", True))
+        self.max_fault = float(p("max_fault", 0.0))
+        name = str(p("proc_policy", self.proc_policy_name))
+        self.proc_policy_name = name
+        self._proc_policy = create_proc_subpolicy(name, self._rng)
+        self._proc_policy.load_params(p("proc_policy_param", {}) or {})
+
+    # -- hot path ---------------------------------------------------------
+
+    def _bucket(self, hint: str) -> int:
+        return fnv64a(hint.encode()) % self.H
+
+    def _delay_for(self, hint: str) -> float:
+        delays = self._delays
+        if delays is None:
+            return hint_delay(str(self.seed), hint, self.max_interval)
+        return float(delays[self._bucket(hint)])
+
+    def _fault_for(self, hint: str) -> bool:
+        faults = self._faults
+        if faults is None or self.max_fault <= 0:
+            return False
+        p = float(faults[self._bucket(hint)])
+        if p <= 0:
+            return False
+        # deterministic coin: same (seed, hint) => same decision
+        coin = fnv64a(f"{self.seed}|fault|{hint}".encode()) % 10_000 / 10_000.0
+        return coin < p
+
+    def queue_event(self, event: Event) -> None:
+        self.start()
+        if isinstance(event, ProcSetEvent):
+            attrs = self._proc_policy.attrs_for(event.pids)
+            self._emit(ProcSetSchedAction.for_procset(event, attrs))
+            return
+        self._queue.put_at(event, self._delay_for(event.replay_hint()))
+
+    def _action_for(self, event: Event):
+        if self._fault_for(event.replay_hint()):
+            fault = event.default_fault_action()
+            if fault is not None:
+                return fault
+        return event.default_action()
+
+    # -- search plane -----------------------------------------------------
+
+    def start(self) -> None:
+        super().start()
+        if self.search_on_start and self._search_thread is None:
+            self._search_thread = self._spawn(self._search_once, "search")
+
+    def _build_search(self):
+        from namazu_tpu.models.ga import GAConfig
+        from namazu_tpu.models.search import ScheduleSearch, SearchConfig
+
+        cfg = SearchConfig(
+            H=self.H, L=self.L, K=self.K,
+            population=self.population,
+            migrate_k=self.migrate_k,
+            seed=self.seed,
+            ga=GAConfig(max_delay=self.max_interval,
+                        max_fault=self.max_fault),
+        )
+        return ScheduleSearch(cfg, n_devices=self.n_devices)
+
+    def _search_once(self) -> None:
+        """Background: ingest history, evolve, install the best tables."""
+        try:
+            with self._search_lock:
+                if self._search is None:
+                    self._search = self._build_search()
+                    if self.checkpoint_path and os.path.exists(self.checkpoint_path):
+                        self._search.load(self.checkpoint_path)
+                        log.info("loaded search checkpoint %s (gen %d)",
+                                 self.checkpoint_path,
+                                 self._search.generations_run)
+                search = self._search
+            reference = self._ingest_history(search)
+            if reference is None:
+                log.info("no stored history yet; keeping hash-based delays")
+                return
+            best = search.run(reference, generations=self.generations)
+            self._delays = best.delays
+            self._faults = best.faults
+            log.info("installed searched schedule (fitness %.4f, gen %d)",
+                     best.fitness, search.generations_run)
+            if self.checkpoint_path:
+                search.save(self.checkpoint_path)
+        except Exception:
+            log.exception("schedule search failed; hash-based delays remain")
+
+    def _ingest_history(self, search):
+        """Feed stored traces into the archives; return the reference trace
+        (most recent failure if any, else most recent run)."""
+        from namazu_tpu.ops import trace_encoding as te
+
+        storage = self._storage
+        if storage is None:
+            return None
+        try:
+            n = storage.nr_stored_histories()
+        except Exception:
+            return None
+        reference = None
+        for i in range(n):
+            try:
+                trace = storage.get_stored_history(i)
+                ok = storage.is_successful(i)
+            except Exception:
+                continue
+            enc = te.encode_trace(trace, L=self.L, H=self.H)
+            search.add_executed_trace(enc)
+            # "failure" = the run reproduced the bug (validate failed)
+            if not ok:
+                search.add_failure_trace(enc)
+                reference = enc
+            elif reference is None:
+                reference = enc
+        return reference
+
+    def wait_for_search(self, timeout: float = 120.0) -> bool:
+        """Block until the background search installed a schedule (tests)."""
+        t = self._search_thread
+        if t is None:
+            return self._delays is not None
+        t.join(timeout=timeout)
+        return self._delays is not None
+
+
+register_policy(TPUSearchPolicy.NAME, TPUSearchPolicy)

@@ -1,9 +1,13 @@
 """Test configuration.
 
-Force JAX onto a virtual 8-device CPU mesh *before* jax is imported anywhere,
-so multi-chip sharding paths (shard_map islands, psum/ppermute migration) are
-exercised without TPU hardware. Bench and production paths do NOT set these:
-they run on the real chip.
+Force JAX onto a virtual 8-device CPU mesh *before* jax is used anywhere,
+so multi-chip sharding paths (shard_map islands, psum/ppermute migration)
+are exercised without TPU hardware. Bench and production paths do NOT do
+this: they run on the real chip.
+
+Note: this image's sitecustomize registers the TPU ("axon") PJRT plugin at
+interpreter start and pins ``jax_platforms`` via jax.config — env vars
+alone do not win, so the config is overridden here as well.
 """
 
 import os
@@ -14,3 +18,7 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,112 @@
+"""tpu_search policy integration: history -> search -> installed schedule."""
+
+import time
+
+import numpy as np
+import pytest
+
+from namazu_tpu.policy import create_policy
+from namazu_tpu.signal import EventAcceptanceAction, PacketEvent
+from namazu_tpu.storage import new_storage
+from namazu_tpu.utils.config import Config
+from namazu_tpu.utils.policy_tester import pump_concurrent
+from namazu_tpu.utils.trace import SingleTrace
+
+
+def record_run(storage, entities, successful):
+    storage.create_new_working_dir()
+    t = SingleTrace()
+    now = time.time()
+    for i, e in enumerate(entities):
+        ev = PacketEvent.create(e, e, "peer", hint=f"{e}:{i % 4}")
+        a = ev.default_action()
+        a.mark_triggered(now + i * 0.002)
+        t.append(a)
+    storage.record_new_trace(t)
+    storage.record_result(successful, 0.5)
+
+
+@pytest.fixture
+def history(tmp_path):
+    st = new_storage("naive", str(tmp_path / "st"))
+    st.create()
+    record_run(st, ["a", "b", "a", "c", "b", "a"], successful=True)
+    record_run(st, ["b", "a", "c", "a", "b", "c"], successful=False)
+    return st
+
+
+def small_cfg(tmp_path, extra=None):
+    param = {
+        "max_interval": 30,
+        "generations": 6,
+        "population": 128,
+        "hint_buckets": 32,
+        "trace_length": 64,
+        "feature_pairs": 32,
+        "seed": 11,
+        "checkpoint": str(tmp_path / "search.npz"),
+    }
+    param.update(extra or {})
+    return Config({"explore_policy_param": param})
+
+
+def test_search_installs_schedule_from_history(tmp_path, history):
+    policy = create_policy("tpu_search")
+    policy.load_config(small_cfg(tmp_path))
+    policy.set_history_storage(history)
+    try:
+        policy.start()
+        assert policy.wait_for_search(timeout=180)
+        assert policy._delays is not None
+        assert policy._delays.shape == (32,)
+        assert (policy._delays >= 0).all()
+        assert (policy._delays <= 0.03 + 1e-6).all()
+        # checkpoint written for the next run
+        assert (tmp_path / "search.npz").exists()
+        # events answered using the searched table
+        acts = pump_concurrent(policy, 20, entities=3)
+        assert len(acts) == 20
+        assert all(isinstance(a, EventAcceptanceAction) for a in acts)
+    finally:
+        policy.shutdown()
+
+
+def test_fallback_to_hash_delays_without_history(tmp_path):
+    policy = create_policy("tpu_search")
+    policy.load_config(small_cfg(tmp_path, {"search_on_start": False}))
+    try:
+        acts = pump_concurrent(policy, 10, entities=2)
+        assert len(acts) == 10
+        assert policy._delays is None  # still on the hash fallback
+    finally:
+        policy.shutdown()
+
+
+def test_checkpoint_resume_across_policy_instances(tmp_path, history):
+    p1 = create_policy("tpu_search")
+    p1.load_config(small_cfg(tmp_path))
+    p1.set_history_storage(history)
+    p1.start()
+    assert p1.wait_for_search(timeout=180)
+    gen1 = p1._search.generations_run
+    p1.shutdown()
+
+    p2 = create_policy("tpu_search")
+    p2.load_config(small_cfg(tmp_path))
+    p2.set_history_storage(history)
+    p2.start()
+    assert p2.wait_for_search(timeout=180)
+    assert p2._search.generations_run == gen1 + 6  # resumed, not restarted
+    p2.shutdown()
+
+
+def test_delay_lookup_deterministic(tmp_path):
+    policy = create_policy("tpu_search")
+    policy.load_config(small_cfg(tmp_path, {"search_on_start": False}))
+    d1 = policy._delay_for("packet:a->b")
+    d2 = policy._delay_for("packet:a->b")
+    d3 = policy._delay_for("packet:b->a")
+    assert d1 == d2
+    assert 0 <= d1 < 0.03
+    assert d1 != d3
+    policy.shutdown()
