@@ -1,0 +1,116 @@
+"""Benchmark: interleavings scored per second per chip.
+
+The reference explores ONE interleaving per wall-clock experiment run
+(minutes); its published metric is bug-repro rate per N runs (BASELINE.md).
+This framework's throughput lever is how many candidate interleavings the
+search plane can *score* per second on one chip — the denominator of
+schedules-tried-per-hour. The benchmark times the jitted population scorer
+(counterfactual release times -> precedence features -> archive-distance
+matmul) at production sizes on the default device and compares against a
+single-thread numpy implementation of the same math (the CPU-python
+baseline a reference-style policy could at best use).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def numpy_score(delays, hint_ids, arrival, mask, pairs, archive, failures,
+                tau=0.005):
+    """Reference single-thread numpy implementation (one genome batch)."""
+    P, H = delays.shape
+    L = hint_ids.shape[0]
+    BIG = 1e9
+    t = arrival[None, :] + delays[:, hint_ids]  # [P, L]
+    t = np.where(mask[None, :], t, BIG)
+    first = np.full((P, H), BIG, np.float32)
+    for p in range(P):  # scatter-min, the honest scalar way
+        np.minimum.at(first[p], hint_ids, t[p])
+    du = first[:, pairs[:, 0]]
+    dv = first[:, pairs[:, 1]]
+    z = np.clip((dv - du) / tau, -30, 30)
+    feats = 1.0 / (1.0 + np.exp(-z))
+    d2a = ((feats[:, None, :] - archive[None]) ** 2).sum(-1).min(1)
+    d2f = ((feats[:, None, :] - failures[None]) ** 2).sum(-1).min(1)
+    return d2a - d2f - 0.01 * delays.mean(-1)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from namazu_tpu.models.ga import GAConfig, init_population
+    from namazu_tpu.ops import trace_encoding as te
+    from namazu_tpu.ops.schedule import (
+        ScoreWeights,
+        TraceArrays,
+        score_population,
+    )
+
+    # production sizes: 8192 genomes x 256-event trace, 1024-entry archive
+    P, H, L, K, A, F = 8192, 256, 256, 256, 1024, 64
+
+    enc = te.encode_event_stream(
+        [f"hint:{i % 96}" for i in range(240)],
+        arrivals=[i * 1e-3 for i in range(240)],
+        L=L, H=H,
+    )
+    trace = TraceArrays(
+        jnp.asarray(enc.hint_ids), jnp.asarray(enc.arrival),
+        jnp.asarray(enc.mask),
+    )
+    pairs = jnp.asarray(te.sample_pairs(K, H, 0))
+    archive = jnp.asarray(
+        np.random.RandomState(0).rand(A, K).astype(np.float32))
+    failures = jnp.asarray(
+        np.random.RandomState(1).rand(F, K).astype(np.float32))
+    pop = init_population(jax.random.PRNGKey(0), P, H,
+                          GAConfig(max_delay=0.1))
+    weights = ScoreWeights()
+
+    @jax.jit
+    def score(delays):
+        fit, _ = score_population(delays, trace, pairs, archive, failures,
+                                  weights)
+        return fit
+
+    # warmup/compile
+    score(pop.delays).block_until_ready()
+
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        score(pop.delays).block_until_ready()
+    dt = time.perf_counter() - t0
+    device_rate = P * iters / dt  # schedules scored per second
+
+    # numpy baseline on a small slice, per-schedule rate extrapolated
+    nb = 64
+    np_args = (
+        np.asarray(pop.delays)[:nb], np.asarray(trace.hint_ids),
+        np.asarray(trace.arrival), np.asarray(trace.mask),
+        np.asarray(pairs), np.asarray(archive), np.asarray(failures),
+    )
+    numpy_score(*np_args)  # warm cache
+    t0 = time.perf_counter()
+    numpy_score(*np_args)
+    np_dt = time.perf_counter() - t0
+    baseline_rate = nb / np_dt
+
+    print(json.dumps({
+        "metric": "interleavings_scored_per_sec_per_chip",
+        "value": round(device_rate, 1),
+        "unit": "schedules/s",
+        "vs_baseline": round(device_rate / baseline_rate, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""Pallas TPU kernel: fused archive-distance + min reduction.
+
+``min_sq_distance`` (namazu_tpu/ops/schedule.py) is the scoring hot spot:
+``d2[p,a] = |f_p|^2 + |a|^2 - 2 f_p.a`` followed by a min over ``a``. In
+XLA the [P, A] distance matrix is materialized in HBM before the reduce;
+at production sizes (P=8192, A=1024) that is 32 MB of HBM round-trip per
+scoring call. This kernel tiles the matmul over (P, A) blocks on the MXU
+and folds the min into the epilogue, so only the [P] result ever leaves
+VMEM.
+
+The kernel is numerically identical to the XLA path (f32 accumulation;
+bf16 operands on TPU). ``min_sq_distance_auto`` dispatches: Pallas on TPU,
+plain XLA elsewhere (tests run the kernel in interpret mode either way).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from namazu_tpu.ops import schedule as _sched
+
+BIG = 3.4e38  # min-identity for f32
+
+
+def _kernel(f_ref, a_ref, f2_ref, a2_ref, out_ref):
+    """Grid (P/TP, A/TA). Block shapes: f [TP,K], a [TA,K], f2 [TP,1],
+    a2 [TA,1] -> out [TP,1] running min across the A-tile axis."""
+    j = pl.program_id(1)
+
+    f = f_ref[:]
+    a = a_ref[:]
+    cross = jax.lax.dot_general(
+        f, a, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [TP, TA]
+    d2 = f2_ref[:] + a2_ref[:].reshape(1, -1) - 2.0 * cross
+    m = jnp.min(d2, axis=1, keepdims=True)  # [TP, 1]
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:] = m
+
+    @pl.when(j > 0)
+    def _acc():
+        out_ref[:] = jnp.minimum(out_ref[:], m)
+
+
+@functools.partial(jax.jit, static_argnames=("tile_p", "tile_a", "interpret"))
+def min_sq_distance_pallas(
+    feats: jax.Array,  # [P, K] f32
+    archive: jax.Array,  # [A, K] f32
+    tile_p: int = 256,
+    tile_a: int = 256,
+    interpret: bool = False,
+) -> jax.Array:
+    P, K = feats.shape
+    A = archive.shape[0]
+    # pad P and A up to tile multiples; padded archive rows use BIG norms
+    # so they never win the min
+    Pp = -(-P // tile_p) * tile_p
+    Ap = -(-A // tile_a) * tile_a
+    f = jnp.pad(feats, ((0, Pp - P), (0, 0)))
+    a = jnp.pad(archive, ((0, Ap - A), (0, 0)))
+    f2 = jnp.sum(f * f, axis=1, keepdims=True)  # [Pp, 1]
+    a2 = jnp.sum(a * a, axis=1)
+    a2 = jnp.where(jnp.arange(Ap) < A, a2, BIG).reshape(Ap, 1)
+
+    dt = _sched._matmul_dtype()
+    f = f.astype(dt)
+    a = a.astype(dt)
+
+    grid = (Pp // tile_p, Ap // tile_a)
+    out = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_p, K), lambda i, j: (i, 0)),
+            pl.BlockSpec((tile_a, K), lambda i, j: (j, 0)),
+            pl.BlockSpec((tile_p, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((tile_a, 1), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((tile_p, 1), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((Pp, 1), jnp.float32),
+        interpret=interpret,
+    )(f, a, f2, a2)
+    return jnp.maximum(out[:P, 0], 0.0)
+
+
+def min_sq_distance_auto(feats: jax.Array, archive: jax.Array) -> jax.Array:
+    """Pallas on TPU, XLA elsewhere."""
+    if jax.default_backend() in ("tpu", "axon"):
+        return min_sq_distance_pallas(feats, archive)
+    return _sched.min_sq_distance(feats, archive)
