@@ -1,0 +1,160 @@
+"""Guest-agent endpoint: framed TCP for in-process agents (C/C++/Java).
+
+Capability parity with /root/reference/nmz/endpoint/pb (pbendpoint.go:
+99-160) and its length-prefixed protobuf codec (util/pb/pbutil.go:28-107).
+Redesign: frames are ``uint32-LE length + UTF-8 JSON`` carrying exactly the
+same wire dicts as the REST endpoint — one codec for every transport, no
+generated protobuf stubs, and a guest agent implementable in ~200 lines of
+dependency-free C++ (native/agent/). The reference's JVM/byteman agent
+equivalent speaks this protocol from a byteman Helper the same way.
+
+Per-connection: a reader thread decodes event frames and posts them to the
+hub; actions for entities seen on a connection are written back as frames
+(the agent correlates by ``event_uuid``, like every transceiver).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Dict, Optional
+
+from namazu_tpu.endpoint.hub import Endpoint
+from namazu_tpu.signal.action import Action
+from namazu_tpu.signal.base import SignalError, signal_from_jsonable
+from namazu_tpu.signal.event import Event
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("endpoint.agent")
+
+MAX_FRAME = 16 * 1024 * 1024
+
+
+def write_frame(sock: socket.socket, payload: dict) -> None:
+    data = json.dumps(payload).encode()
+    sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def read_frame(sock: socket.socket) -> Optional[dict]:
+    header = _read_exact(sock, 4)
+    if header is None:
+        return None
+    (length,) = struct.unpack("<I", header)
+    if length > MAX_FRAME:
+        raise SignalError(f"frame too large: {length}")
+    body = _read_exact(sock, length)
+    if body is None:
+        return None
+    return json.loads(body)
+
+
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class AgentEndpoint(Endpoint):
+    NAME = "agent"
+
+    def __init__(self, port: int = 10081, host: str = "127.0.0.1"):
+        self._host = host
+        self._port = port
+        self._server: Optional[socket.socket] = None
+        self._conns: Dict[str, socket.socket] = {}  # entity -> connection
+        self._conn_lock = threading.Lock()
+        self._stop = threading.Event()
+
+    @property
+    def port(self) -> int:
+        if self._server is not None:
+            return self._server.getsockname()[1]
+        return self._port
+
+    def start(self) -> None:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self._host, self._port))
+        srv.listen(32)
+        self._server = srv
+        threading.Thread(target=self._accept_loop, name="agent-accept",
+                         daemon=True).start()
+        log.info("agent endpoint on %s:%d", self._host, self.port)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        with self._conn_lock:
+            for conn in self._conns.values():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._server.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._conn_loop, args=(conn,),
+                name=f"agent-conn-{addr[1]}", daemon=True,
+            ).start()
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        entities = set()
+        try:
+            while not self._stop.is_set():
+                frame = read_frame(conn)
+                if frame is None:
+                    return
+                try:
+                    sig = signal_from_jsonable(frame)
+                except (SignalError, KeyError, ValueError) as e:
+                    log.warning("agent: bad frame: %s", e)
+                    continue
+                if not isinstance(sig, Event):
+                    log.warning("agent: non-event frame %r", sig)
+                    continue
+                ent = sig.entity_id
+                if ent not in entities:
+                    entities.add(ent)
+                    with self._conn_lock:
+                        self._conns[ent] = conn
+                self.hub.post_event(sig, self.NAME)
+        finally:
+            with self._conn_lock:
+                for ent in entities:
+                    if self._conns.get(ent) is conn:
+                        del self._conns[ent]
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def send_action(self, action: Action) -> None:
+        with self._conn_lock:
+            conn = self._conns.get(action.entity_id)
+        if conn is None:
+            log.warning("agent: no connection for entity %s", action.entity_id)
+            return
+        try:
+            write_frame(conn, action.to_jsonable())
+        except OSError as e:
+            log.warning("agent: send to %s failed: %s", action.entity_id, e)

@@ -1,0 +1,68 @@
+"""Python client for the guest-agent framed-TCP protocol.
+
+The Python counterpart of the C++ agent (native/agent): useful for Python
+testee processes and as the protocol reference implementation. URL scheme:
+``agent://host:port`` (see new_transceiver).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from namazu_tpu.endpoint.agent import read_frame, write_frame
+from namazu_tpu.inspector.transceiver import Transceiver
+from namazu_tpu.signal.action import Action
+from namazu_tpu.signal.base import signal_from_jsonable
+from namazu_tpu.signal.event import Event
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("transceiver.agent")
+
+
+class AgentTransceiver(Transceiver):
+    def __init__(self, entity_id: str, host: str, port: int):
+        super().__init__(entity_id)
+        self._addr = (host, port)
+        self._sock: socket.socket | None = None
+        self._send_lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._sock is not None:
+            return
+        self._sock = socket.create_connection(self._addr, timeout=30)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._thread = threading.Thread(
+            target=self._receive_loop, name=f"agent-recv-{self.entity_id}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _post(self, event: Event) -> None:
+        if self._sock is None:
+            self.start()
+        with self._send_lock:
+            write_frame(self._sock, event.to_jsonable())
+
+    def _receive_loop(self) -> None:
+        sock = self._sock
+        while sock is not None:
+            frame = read_frame(sock)
+            if frame is None:
+                return
+            try:
+                action = signal_from_jsonable(frame)
+            except Exception as e:
+                log.warning("bad action frame: %s", e)
+                continue
+            if isinstance(action, Action):
+                self.dispatch_action(action)

@@ -118,4 +118,9 @@ def new_transceiver(
         from namazu_tpu.inspector.rest_transceiver import RestTransceiver
 
         return RestTransceiver(entity_id, url)
+    if url.startswith("agent://"):
+        from namazu_tpu.inspector.agent_transceiver import AgentTransceiver
+
+        host, _, port = url[len("agent://"):].rpartition(":")
+        return AgentTransceiver(entity_id, host or "127.0.0.1", int(port))
     raise ValueError(f"unsupported transceiver url {url!r}")

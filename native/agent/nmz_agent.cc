@@ -1,0 +1,290 @@
+/* Embeddable guest agent — see nmz_agent.h.
+ *
+ * Design: one reader thread per process; hooked threads build an event
+ * frame, register a waiter keyed by the event uuid, send, and park on a
+ * condition variable until the reader delivers the matching action
+ * (correlated by "event_uuid"). Mirrors the inspector-side transceiver
+ * contract (waiter registered before the frame leaves the process).
+ *
+ * JSON handling is deliberately minimal: frames we *emit* are built with a
+ * tiny escaper; frames we *receive* come from our own orchestrator with a
+ * fixed shape, so scanning for the "event_uuid" and "class" string fields
+ * is sufficient and keeps the agent dependency-free.
+ */
+#include "nmz_agent.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <map>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+
+namespace {
+
+struct Waiter {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  bool fault = false;
+};
+
+struct Agent {
+  int fd = -1;
+  bool enabled = false;
+  std::string entity;
+  std::mutex send_mu;
+  std::mutex waiters_mu;
+  std::map<std::string, Waiter*> waiters;
+  std::thread reader;
+};
+
+Agent* g_agent = nullptr;
+std::once_flag g_init_once;
+
+std::string json_escape(const char* s) {
+  std::string out;
+  for (const char* p = s; *p; ++p) {
+    unsigned char c = static_cast<unsigned char>(*p);
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += static_cast<char>(c);
+        }
+    }
+  }
+  return out;
+}
+
+std::string make_uuid() {
+  static std::mutex mu;
+  static std::mt19937_64 rng(std::random_device{}());
+  std::lock_guard<std::mutex> lk(mu);
+  char buf[40];
+  uint64_t a = rng(), b = rng();
+  snprintf(buf, sizeof buf, "%08x-%04x-4%03x-%04x-%012llx",
+           static_cast<uint32_t>(a >> 32),
+           static_cast<uint32_t>(a >> 16) & 0xffff,
+           static_cast<uint32_t>(a) & 0xfff,
+           static_cast<uint32_t>(b >> 48) & 0xffff,
+           static_cast<unsigned long long>(b & 0xffffffffffffULL));
+  return buf;
+}
+
+/* Extract the value of a top-level string field: "name":"value". */
+std::string scan_string_field(const std::string& json, const char* name) {
+  std::string needle = std::string("\"") + name + "\":";
+  size_t pos = json.find(needle);
+  if (pos == std::string::npos) return "";
+  pos += needle.size();
+  while (pos < json.size() && (json[pos] == ' ')) ++pos;
+  if (pos >= json.size() || json[pos] != '"') return "";
+  ++pos;
+  std::string out;
+  while (pos < json.size() && json[pos] != '"') {
+    if (json[pos] == '\\' && pos + 1 < json.size()) ++pos;
+    out += json[pos++];
+  }
+  return out;
+}
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = write(fd, p, n);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_frame(Agent* a, const std::string& payload) {
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  uint32_t le = htole32(len);
+  std::lock_guard<std::mutex> lk(a->send_mu);
+  return send_all(a->fd, &le, 4) &&
+         send_all(a->fd, payload.data(), payload.size());
+}
+
+void reader_loop(Agent* a) {
+  for (;;) {
+    uint32_t le = 0;
+    if (!recv_all(a->fd, &le, 4)) break;
+    uint32_t len = le32toh(le);
+    if (len > (16u << 20)) break;
+    std::string body(len, '\0');
+    if (!recv_all(a->fd, body.data(), len)) break;
+    std::string event_uuid = scan_string_field(body, "event_uuid");
+    std::string cls = scan_string_field(body, "class");
+    if (event_uuid.empty()) continue;
+    Waiter* w = nullptr;
+    {
+      std::lock_guard<std::mutex> lk(a->waiters_mu);
+      auto it = a->waiters.find(event_uuid);
+      if (it != a->waiters.end()) {
+        w = it->second;
+        a->waiters.erase(it);
+      }
+    }
+    if (w != nullptr) {
+      std::lock_guard<std::mutex> lk(w->mu);
+      w->fault = cls.find("Fault") != std::string::npos;
+      w->done = true;
+      w->cv.notify_all();
+    }
+  }
+  /* connection gone: release every parked thread (proceed, no fault) */
+  std::lock_guard<std::mutex> lk(a->waiters_mu);
+  for (auto& kv : a->waiters) {
+    std::lock_guard<std::mutex> wl(kv.second->mu);
+    kv.second->done = true;
+    kv.second->cv.notify_all();
+  }
+  a->waiters.clear();
+  a->enabled = false;
+}
+
+int do_init() {
+  const char* disable = getenv("NMZ_TPU_DISABLE");
+  if (disable != nullptr && disable[0] != '\0') return -1;
+  const char* addr = getenv("NMZ_TPU_AGENT_ADDR");
+  std::string host = "127.0.0.1";
+  std::string port = "10081";
+  if (addr != nullptr && addr[0] != '\0') {
+    std::string s(addr);
+    size_t colon = s.rfind(':');
+    if (colon == std::string::npos) return -1;
+    host = s.substr(0, colon);
+    port = s.substr(colon + 1);
+  }
+  const char* entity = getenv("NMZ_TPU_ENTITY_ID");
+
+  struct addrinfo hints;
+  memset(&hints, 0, sizeof hints);
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0) return -1;
+  int fd = -1;
+  for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) return -1;
+
+  g_agent = new Agent();
+  g_agent->fd = fd;
+  g_agent->entity =
+      (entity != nullptr && entity[0] != '\0') ? entity : "_nmz_c_agent";
+  g_agent->enabled = true;
+  g_agent->reader = std::thread(reader_loop, g_agent);
+  g_agent->reader.detach();
+  return 0;
+}
+
+/* Send one event and park until its action arrives.
+ * option_json: the option dict body, already JSON (no braces). */
+int emit_and_wait(const char* cls, const std::string& option_json) {
+  std::call_once(g_init_once, [] { do_init(); });
+  Agent* a = g_agent;
+  if (a == nullptr || !a->enabled) return -1;
+
+  std::string uuid = make_uuid();
+  Waiter w;
+  {
+    std::lock_guard<std::mutex> lk(a->waiters_mu);
+    a->waiters[uuid] = &w;
+  }
+  std::string frame = std::string("{\"type\":\"event\",\"class\":\"") + cls +
+                      "\",\"entity\":\"" + json_escape(a->entity.c_str()) +
+                      "\",\"uuid\":\"" + uuid + "\",\"option\":{" +
+                      option_json + "}}";
+  if (!send_frame(a, frame)) {
+    std::lock_guard<std::mutex> lk(a->waiters_mu);
+    a->waiters.erase(uuid);
+    return -1;
+  }
+  std::unique_lock<std::mutex> lk(w.mu);
+  w.cv.wait(lk, [&] { return w.done; });
+  return w.fault ? 1 : 0;
+}
+
+int func_event(const char* func_name, const char* func_type) {
+  std::string opt = std::string("\"func_name\":\"") + json_escape(func_name) +
+                    "\",\"func_type\":\"" + func_type +
+                    "\",\"runtime\":\"c\"";
+  return emit_and_wait("FunctionEvent", opt);
+}
+
+}  // namespace
+
+extern "C" {
+
+int nmz_agent_init(void) {
+  std::call_once(g_init_once, [] { do_init(); });
+  return (g_agent != nullptr && g_agent->enabled) ? 0 : -1;
+}
+
+int nmz_agent_enabled(void) {
+  return (g_agent != nullptr && g_agent->enabled) ? 1 : 0;
+}
+
+int nmz_agent_func_call(const char* func_name) {
+  return func_event(func_name, "call");
+}
+
+int nmz_agent_func_return(const char* func_name) {
+  return func_event(func_name, "return");
+}
+
+int nmz_agent_fs_event(const char* op, const char* path) {
+  std::string opt = std::string("\"op\":\"") + json_escape(op) +
+                    "\",\"path\":\"" + json_escape(path) + "\"";
+  return emit_and_wait("FilesystemEvent", opt);
+}
+
+void nmz_agent_shutdown(void) {
+  Agent* a = g_agent;
+  if (a != nullptr && a->fd >= 0) {
+    shutdown(a->fd, SHUT_RDWR);
+    close(a->fd);
+    a->enabled = false;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,48 @@
+/* nmz_agent: embeddable in-process inspector agent.
+ *
+ * Capability parity with the reference's embedded C inspector
+ * (/root/reference/misc/inspector/c/embed/eq_embed.cc) and the wire role of
+ * its Java PBInspector: hook functions in a native testee, ship each
+ * call/return as an event to the orchestrator over the guest-agent framed
+ * TCP protocol (uint32-LE length + JSON; namazu_tpu/endpoint/agent.py),
+ * and park the calling thread until the policy releases it.
+ *
+ * Environment (reference parity: NMZ_GA_TCP_PORT / NMZ_DISABLE /
+ * NMZ_ENV_PROCESS_ID):
+ *   NMZ_TPU_AGENT_ADDR  host:port of the agent endpoint (default
+ *                       127.0.0.1:10081)
+ *   NMZ_TPU_ENTITY_ID   entity id (default "_nmz_c_agent")
+ *   NMZ_TPU_DISABLE     if set (non-empty), every hook is a no-op
+ *
+ * All functions are thread-safe. C linkage so the library preloads into
+ * anything.
+ */
+#ifndef NMZ_AGENT_H_
+#define NMZ_AGENT_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Returns 0 on success, -1 on failure (agent then disables itself). */
+int nmz_agent_init(void);
+
+/* True when the agent is connected and enabled. */
+int nmz_agent_enabled(void);
+
+/* Block until the orchestrator releases this function event.
+ * Returns 0 = proceed, 1 = fault injected, -1 = error/disabled.  */
+int nmz_agent_func_call(const char *func_name);
+int nmz_agent_func_return(const char *func_name);
+
+/* Generic event hook used by the fs interposer: class is the event class
+ * name ("FilesystemEvent"), op/path fill its option dict. Same returns. */
+int nmz_agent_fs_event(const char *op, const char *path);
+
+void nmz_agent_shutdown(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* NMZ_AGENT_H_ */

@@ -1,0 +1,137 @@
+/* LD_PRELOAD filesystem interposer.
+ *
+ * The reference intercepts filesystem ops with a FUSE passthrough
+ * (hookfs); this environment has no libfuse headers, and FUSE needs a
+ * privileged mount anyway. An LD_PRELOAD interposer achieves the same
+ * capability — defer + fault-inject the testee's filesystem ops — with no
+ * mount and no privileges: preload this library into the testee, set
+ * NMZ_TPU_FS_ROOT to the watched subtree, and every hooked libc call under
+ * that subtree becomes a deferred FilesystemEvent through the guest-agent
+ * protocol (nmz_agent.cc). A FilesystemFaultAction makes the call fail
+ * with EIO before touching the real filesystem (pre-hooks), matching the
+ * reference's hook split (fs.go:77-183).
+ *
+ * Hooked: mkdir, rmdir, fsync, unlink, open/open64/creat (write modes
+ * pre-hooked; read-only opens post-hooked).
+ */
+#define _GNU_SOURCE 1
+#include <dlfcn.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+#include "../agent/nmz_agent.h"
+
+namespace {
+
+const char* fs_root() {
+  static const char* root = getenv("NMZ_TPU_FS_ROOT");
+  return root;
+}
+
+bool watched(const char* path) {
+  const char* root = fs_root();
+  if (root == nullptr || root[0] == '\0' || path == nullptr) return false;
+  size_t n = strlen(root);
+  return strncmp(path, root, n) == 0 &&
+         (path[n] == '\0' || path[n] == '/' || root[n - 1] == '/');
+}
+
+/* Returns 1 when the op must fail with EIO. */
+int hook(const char* op, const char* path) {
+  if (!watched(path)) return 0;
+  int r = nmz_agent_fs_event(op, path);
+  return r == 1 ? 1 : 0;
+}
+
+template <typename Fn>
+Fn real(const char* name) {
+  return reinterpret_cast<Fn>(dlsym(RTLD_NEXT, name));
+}
+
+}  // namespace
+
+extern "C" {
+
+int mkdir(const char* path, mode_t mode) {
+  static auto fn = real<int (*)(const char*, mode_t)>("mkdir");
+  if (hook("pre-mkdir", path)) {
+    errno = EIO;
+    return -1;
+  }
+  return fn(path, mode);
+}
+
+int rmdir(const char* path) {
+  static auto fn = real<int (*)(const char*)>("rmdir");
+  if (hook("pre-rmdir", path)) {
+    errno = EIO;
+    return -1;
+  }
+  return fn(path);
+}
+
+int unlink(const char* path) {
+  static auto fn = real<int (*)(const char*)>("unlink");
+  if (hook("pre-write", path)) {
+    errno = EIO;
+    return -1;
+  }
+  return fn(path);
+}
+
+int fsync(int fd) {
+  static auto fn = real<int (*)(int)>("fsync");
+  char linkpath[64], target[4096];
+  snprintf(linkpath, sizeof linkpath, "/proc/self/fd/%d", fd);
+  ssize_t n = readlink(linkpath, target, sizeof target - 1);
+  if (n > 0) {
+    target[n] = '\0';
+    if (hook("pre-fsync", target)) {
+      errno = EIO;
+      return -1;
+    }
+  }
+  return fn(fd);
+}
+
+static int open_common(const char* name, const char* path, int flags,
+                       mode_t mode) {
+  static auto fn = real<int (*)(const char*, int, ...)>("open");
+  static auto fn64 = real<int (*)(const char*, int, ...)>("open64");
+  auto call = (strcmp(name, "open64") == 0) ? fn64 : fn;
+  bool writes = (flags & (O_WRONLY | O_RDWR | O_CREAT | O_TRUNC)) != 0;
+  if (writes && hook("pre-write", path)) {
+    errno = EIO;
+    return -1;
+  }
+  int fd = call(path, flags, mode);
+  if (!writes && fd >= 0) hook("post-read", path);
+  return fd;
+}
+
+int open(const char* path, int flags, ...) {
+  va_list ap;
+  va_start(ap, flags);
+  mode_t mode = (flags & O_CREAT) ? va_arg(ap, mode_t) : 0;
+  va_end(ap);
+  return open_common("open", path, flags, mode);
+}
+
+int open64(const char* path, int flags, ...) {
+  va_list ap;
+  va_start(ap, flags);
+  mode_t mode = (flags & O_CREAT) ? va_arg(ap, mode_t) : 0;
+  va_end(ap);
+  return open_common("open64", path, flags, mode);
+}
+
+int creat(const char* path, mode_t mode) {
+  return open_common("open", path, O_CREAT | O_WRONLY | O_TRUNC, mode);
+}
+
+}  // extern "C"

@@ -1,0 +1,208 @@
+"""Native-plane tests: framed-TCP agent endpoint, Python agent transceiver,
+the C++ guest agent (ctypes), and the LD_PRELOAD fs interposer (subprocess).
+
+Parity: the reference drives its PB codec over a real TCP socket in
+pbendpoint_test.go; here the real C++ library connects to a real endpoint.
+"""
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from namazu_tpu.endpoint.agent import AgentEndpoint
+from namazu_tpu.endpoint.hub import EndpointHub
+from namazu_tpu.endpoint.local import LocalEndpoint
+from namazu_tpu.inspector.transceiver import new_transceiver
+from namazu_tpu.orchestrator import Orchestrator
+from namazu_tpu.policy import create_policy
+from namazu_tpu.signal import EventAcceptanceAction, FunctionEvent
+from namazu_tpu.utils.config import Config
+from namazu_tpu.utils.mock_orchestrator import MockOrchestrator
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+AGENT_LIB = os.path.join(NATIVE_DIR, "build", "libnmz_agent.so")
+INTERPOSE_LIB = os.path.join(NATIVE_DIR, "build", "libnmz_fs_interpose.so")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def build_native():
+    r = subprocess.run(["make", "-C", NATIVE_DIR], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, f"native build failed:\n{r.stdout}\n{r.stderr}"
+
+
+@pytest.fixture
+def agent_hub():
+    hub = EndpointHub()
+    hub.add_endpoint(LocalEndpoint())
+    agent = AgentEndpoint(port=0)
+    hub.add_endpoint(agent)
+    mock = MockOrchestrator(hub)
+    mock.start()
+    yield hub, agent
+    mock.shutdown()
+
+
+def test_python_agent_transceiver_roundtrip(agent_hub):
+    hub, agent = agent_hub
+    trans = new_transceiver(f"agent://127.0.0.1:{agent.port}", "py-agent")
+    trans.start()
+    try:
+        ev = FunctionEvent.create("py-agent", "Foo.bar", runtime="python")
+        ch = trans.send_event(ev)
+        act = ch.get(timeout=10)
+        assert isinstance(act, EventAcceptanceAction)
+        assert act.event_uuid == ev.uuid
+    finally:
+        trans.shutdown()
+
+
+def test_cpp_agent_func_hooks(agent_hub):
+    hub, agent = agent_hub
+    os.environ["NMZ_TPU_AGENT_ADDR"] = f"127.0.0.1:{agent.port}"
+    os.environ["NMZ_TPU_ENTITY_ID"] = "c-agent"
+    os.environ.pop("NMZ_TPU_DISABLE", None)
+    lib = ctypes.CDLL(AGENT_LIB)
+    assert lib.nmz_agent_init() == 0
+    assert lib.nmz_agent_enabled() == 1
+
+    results = []
+
+    def hooked_thread(i):
+        r1 = lib.nmz_agent_func_call(f"Server.processRequest{i}".encode())
+        r2 = lib.nmz_agent_func_return(f"Server.processRequest{i}".encode())
+        results.append((r1, r2))
+
+    threads = [threading.Thread(target=hooked_thread, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(results) == 4
+    assert all(r == (0, 0) for r in results)  # released, no fault
+    lib.nmz_agent_shutdown()
+
+
+def test_cpp_agent_fs_fault_injection(tmp_path):
+    """C++ agent against a real orchestrator with fault probability 1:
+    fs events must come back as faults (return 1)."""
+    cfg = Config({
+        "agent_port": 0,
+        "explore_policy_param": {"fault_action_probability": 1.0,
+                                 "max_interval": 5},
+    })
+    policy = create_policy("random")
+    policy.load_config(cfg)
+    orc = Orchestrator(cfg, policy, collect_trace=False)
+    orc.start()
+    agent_ep = orc.hub.endpoint("agent")
+    try:
+        env = dict(os.environ,
+                   NMZ_TPU_AGENT_ADDR=f"127.0.0.1:{agent_ep.port}",
+                   NMZ_TPU_ENTITY_ID="c-fault-agent")
+        env.pop("NMZ_TPU_DISABLE", None)
+        # run in a subprocess: the agent caches env at init
+        code = (
+            "import ctypes;"
+            f"lib = ctypes.CDLL({AGENT_LIB!r});"
+            "assert lib.nmz_agent_init() == 0;"
+            "r = lib.nmz_agent_fs_event(b'pre-write', b'/data/edits.log');"
+            "print('fault' if r == 1 else 'released')"
+        )
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == "fault"
+    finally:
+        orc.shutdown()
+
+
+def test_cpp_agent_disabled_env():
+    env = dict(os.environ, NMZ_TPU_DISABLE="1")
+    code = (
+        "import ctypes;"
+        f"lib = ctypes.CDLL({AGENT_LIB!r});"
+        "assert lib.nmz_agent_init() == -1;"
+        "assert lib.nmz_agent_enabled() == 0;"
+        "assert lib.nmz_agent_func_call(b'x') == -1;"
+        "print('disabled-ok')"
+    )
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=30)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "disabled-ok"
+
+
+def test_ld_preload_interposer_defers_and_faults(tmp_path, agent_hub):
+    """mkdir under NMZ_TPU_FS_ROOT flows through the agent protocol; with
+    the mock orchestrator (accept-all) it succeeds; outside the root it is
+    not intercepted."""
+    hub, agent = agent_hub
+    root = tmp_path / "watched"
+    root.mkdir()
+    env = dict(
+        os.environ,
+        LD_PRELOAD=os.path.abspath(INTERPOSE_LIB),
+        NMZ_TPU_AGENT_ADDR=f"127.0.0.1:{agent.port}",
+        NMZ_TPU_ENTITY_ID="fs-preload",
+        NMZ_TPU_FS_ROOT=str(root),
+    )
+    env.pop("NMZ_TPU_DISABLE", None)
+    code = (
+        "import os, sys\n"
+        f"root = {str(root)!r}\n"
+        "os.mkdir(os.path.join(root, 'd1'))\n"
+        "os.rmdir(os.path.join(root, 'd1'))\n"
+        "os.mkdir(os.path.join(root, 'd2'))\n"
+        "print('preload-ok')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == "preload-ok"
+    assert (root / "d2").exists()
+
+
+def test_ld_preload_fault_returns_eio(tmp_path):
+    cfg = Config({
+        "agent_port": 0,
+        "explore_policy_param": {"fault_action_probability": 1.0,
+                                 "max_interval": 5},
+    })
+    policy = create_policy("random")
+    policy.load_config(cfg)
+    orc = Orchestrator(cfg, policy, collect_trace=False)
+    orc.start()
+    agent_ep = orc.hub.endpoint("agent")
+    root = tmp_path / "watched"
+    root.mkdir()
+    try:
+        env = dict(
+            os.environ,
+            LD_PRELOAD=os.path.abspath(INTERPOSE_LIB),
+            NMZ_TPU_AGENT_ADDR=f"127.0.0.1:{agent_ep.port}",
+            NMZ_TPU_ENTITY_ID="fs-preload-fault",
+            NMZ_TPU_FS_ROOT=str(root),
+        )
+        env.pop("NMZ_TPU_DISABLE", None)
+        code = (
+            "import os\n"
+            f"root = {str(root)!r}\n"
+            "try:\n"
+            "    os.mkdir(os.path.join(root, 'dx'))\n"
+            "    print('no-error')\n"
+            "except OSError as e:\n"
+            "    print('errno', e.errno)\n"
+        )
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == "errno 5"
+        assert not (root / "dx").exists()
+    finally:
+        orc.shutdown()
