@@ -1,0 +1,88 @@
+"""A deliberately scheduling-sensitive flaky test (the system under test).
+
+Classic two-phase-init race (the shape of YARN-4548/ZOOKEEPER-2137 style
+bugs), repeated for many rounds like a real integration test: each round a
+*writer* process creates its status file and then fills it in
+(non-atomically — create, compute, write); a *reader* process spins until
+the file exists and immediately consumes it, assuming creation implies
+content, then acknowledges by removing the file. Under normal scheduling
+the create->write window is tens of microseconds and the reader virtually
+never catches it. A scheduler fuzzer that gives the reader priority over
+the writer stretches the window by orders of magnitude and the reader
+observes the half-initialized state.
+
+Both processes pin to CPU 0 so the kernel scheduler — the thing the fuzzer
+perturbs — decides who runs inside the window.
+
+Exit status: 0 = all rounds consistent, 1 = race manifested.
+"""
+
+import os
+import sys
+import time
+
+ROUNDS = 150
+DEADLINE_S = 8.0
+
+
+def writer(path: str, ack: str) -> None:
+    for _ in range(ROUNDS):
+        # phase 1: create the status file (visible to the reader at once)
+        fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
+        # ... the preemption window: some "initialization work" ...
+        x = 0
+        for i in range(400):
+            x += i * i
+        # phase 2: fill in the content
+        os.write(fd, b"ready=1 checksum=%d\n" % (x % 997))
+        os.close(fd)
+        # wait for the reader's ack (it removes the file)
+        t0 = time.monotonic()
+        while os.path.exists(path):
+            if time.monotonic() - t0 > 2.0:
+                return
+    # signal completion
+    open(ack, "w").close()
+
+
+def reader(path: str, ack: str) -> int:
+    t0 = time.monotonic()
+    rounds = 0
+    while rounds < ROUNDS and time.monotonic() - t0 < DEADLINE_S:
+        if os.path.exists(ack):
+            break
+        if os.path.exists(path):
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                continue
+            if not data:
+                return 1  # the faulty assumption bites: empty status file
+            os.unlink(path)
+            rounds += 1
+    return 0
+
+
+def main() -> int:
+    workdir = sys.argv[1] if len(sys.argv) > 1 else "."
+    path = os.path.join(workdir, "status.file")
+    ack = os.path.join(workdir, "done.marker")
+    for p in (path, ack):
+        if os.path.exists(p):
+            os.unlink(p)
+    try:
+        os.sched_setaffinity(0, {0})
+    except OSError:
+        pass
+    pid = os.fork()
+    if pid == 0:
+        writer(path, ack)
+        os._exit(0)
+    rc = reader(path, ack)
+    os.waitpid(pid, 0)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

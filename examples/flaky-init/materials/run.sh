@@ -1,0 +1,14 @@
+#!/bin/sh
+# Run the flaky test under the proc inspector, which reports the process
+# tree to the orchestrator (REST) and applies the policy's scheduler
+# attributes. PALLAS_AXON_POOL_IPS= skips this image's TPU plugin boot in
+# the short-lived helper interpreters (it costs ~2s per python startup).
+PORT="${NMZ_REST_PORT:-10980}"
+PALLAS_AXON_POOL_IPS= python -m namazu_tpu.cli inspectors proc \
+    --orchestrator-url "http://127.0.0.1:${PORT}" \
+    --entity-id racy \
+    --watch-interval 0.01 \
+    --cmd "PALLAS_AXON_POOL_IPS= python \"$NMZ_MATERIALS_DIR/racy.py\" \"$NMZ_WORKING_DIR\""
+rc=$?
+echo "$rc" > "$NMZ_WORKING_DIR/rc.txt"
+exit 0

@@ -1,0 +1,5 @@
+#!/bin/sh
+# Oracle: the run reproduced the race iff racy.py exited non-zero.
+# validate succeeding == test passed (no repro), matching the reference's
+# convention (repro rate = failure rate in `tools summary`).
+test "$(cat "$NMZ_WORKING_DIR/rc.txt")" = "0"

@@ -1,0 +1,56 @@
+"""Reader side of the WAL-commit race (NOT under the interposer — it plays
+the independent consumer whose assumption the bug violates).
+
+For each epoch directory that appears, the reader immediately expects the
+data file to exist and be non-empty — the faulty "marker implies payload"
+assumption. Exit 1 the moment it catches a committed-but-empty epoch.
+"""
+
+import os
+import sys
+import time
+
+EPOCHS = 12
+DEADLINE_S = 30.0
+
+
+def main() -> int:
+    root = sys.argv[1]
+    t0 = time.monotonic()
+    epoch = 0
+    while epoch < EPOCHS and time.monotonic() - t0 < DEADLINE_S:
+        d = os.path.join(root, f"epoch-{epoch:03d}")
+        if not os.path.isdir(d):
+            time.sleep(0.0005)
+            continue
+        # the marker exists: the payload must be there and complete.
+        # The reader is even lenient: it retries once after a grace period
+        # (so ordinary IPC latency never trips it — only a genuinely
+        # stretched window does).
+        data = os.path.join(d, "data")
+        ok = _payload_ok(data)
+        if not ok:
+            time.sleep(GRACE_S)
+            if not _payload_ok(data):
+                return 1  # race: committed epoch without usable payload
+        os.unlink(data)
+        os.rmdir(d)  # ack
+        epoch += 1
+    return 0
+
+
+GRACE_S = 0.025
+
+
+def _payload_ok(data: str) -> bool:
+    if not os.path.exists(data):
+        return False
+    try:
+        with open(data, "rb") as f:
+            return bool(f.read())
+    except OSError:
+        return False
+
+
+if __name__ == "__main__":
+    sys.exit(main())

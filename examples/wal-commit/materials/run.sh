@@ -1,0 +1,25 @@
+#!/bin/sh
+# WAL-commit race under the LD_PRELOAD fs interposer.
+# The writer runs with the interposer preloaded: its mkdir/create calls
+# become deferred FilesystemEvents through the guest-agent endpoint; the
+# reader runs clean. PALLAS_AXON_POOL_IPS= skips this image's TPU plugin
+# boot in the short-lived interpreters.
+PORT="${NMZ_AGENT_PORT:-10981}"
+LIB=$(PALLAS_AXON_POOL_IPS= python -c 'import namazu_tpu, os; print(os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(namazu_tpu.__file__))), "native", "build", "libnmz_fs_interpose.so"))')
+WAL="$NMZ_WORKING_DIR/wal"
+mkdir -p "$WAL"
+
+env LD_PRELOAD="$LIB" \
+    NMZ_TPU_AGENT_ADDR="127.0.0.1:${PORT}" \
+    NMZ_TPU_ENTITY_ID=waldb-writer \
+    NMZ_TPU_FS_ROOT="$WAL" \
+    PALLAS_AXON_POOL_IPS= \
+    python "$NMZ_MATERIALS_DIR/writer.py" "$WAL" &
+writer_pid=$!
+
+PALLAS_AXON_POOL_IPS= python "$NMZ_MATERIALS_DIR/reader.py" "$WAL"
+rc=$?
+echo "$rc" > "$NMZ_WORKING_DIR/rc.txt"
+kill "$writer_pid" 2>/dev/null
+wait "$writer_pid" 2>/dev/null
+exit 0

@@ -1,0 +1,3 @@
+#!/bin/sh
+# Oracle: the run reproduced the race iff the reader exited non-zero.
+test "$(cat "$NMZ_WORKING_DIR/rc.txt")" = "0"

@@ -1,0 +1,39 @@
+"""Writer side of the WAL-commit race (runs under the LD_PRELOAD fs
+interposer; every mkdir / file-create below becomes a deferred
+FilesystemEvent the policy can delay).
+
+Protocol per epoch (the buggy two-step commit, the shape of YARN-4301 /
+write-ahead-log bugs): the writer first creates the epoch directory (the
+"commit marker" readers key on), then writes the data file inside it.
+Creation of the data file is a separate, hooked operation — so the
+scheduler's delay on it IS the race window during which a reader observes
+a committed-but-empty epoch.
+"""
+
+import os
+import sys
+import time
+
+EPOCHS = 12
+
+
+def main() -> int:
+    root = sys.argv[1]
+    for epoch in range(EPOCHS):
+        d = os.path.join(root, f"epoch-{epoch:03d}")
+        os.mkdir(d)  # step 1: the commit marker [hooked: pre-mkdir]
+        # step 2: the payload  [hooked: pre-write on a different path]
+        fd = os.open(os.path.join(d, "data"), os.O_CREAT | os.O_WRONLY, 0o644)
+        os.write(fd, b"epoch=%d payload-ok\n" % epoch)
+        os.close(fd)
+        # wait for the reader to consume and ack (it removes the dir)
+        t0 = time.monotonic()
+        while os.path.exists(d):
+            if time.monotonic() - t0 > 5.0:
+                return 0
+            time.sleep(0.001)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

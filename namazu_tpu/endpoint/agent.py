@@ -85,18 +85,23 @@ class AgentEndpoint(Endpoint):
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         srv.bind((self._host, self._port))
         srv.listen(32)
+        # a thread parked in accept() would keep the listening fd alive past
+        # close(); a short timeout lets the loop observe _stop and close the
+        # server from its own thread
+        srv.settimeout(0.2)
         self._server = srv
-        threading.Thread(target=self._accept_loop, name="agent-accept",
-                         daemon=True).start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="agent-accept", daemon=True)
+        self._accept_thread.start()
         log.info("agent endpoint on %s:%d", self._host, self.port)
 
     def shutdown(self) -> None:
         self._stop.set()
-        if self._server is not None:
-            try:
-                self._server.close()
-            except OSError:
-                pass
+        # block until the accept loop has really closed the listening fd,
+        # so a back-to-back experiment run can rebind the port
+        t = getattr(self, "_accept_thread", None)
+        if t is not None:
+            t.join(timeout=2.0)
         with self._conn_lock:
             for conn in self._conns.values():
                 try:
@@ -106,16 +111,26 @@ class AgentEndpoint(Endpoint):
             self._conns.clear()
 
     def _accept_loop(self) -> None:
-        while not self._stop.is_set():
+        srv = self._server
+        try:
+            while not self._stop.is_set():
+                try:
+                    conn, addr = srv.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                conn.settimeout(None)
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                threading.Thread(
+                    target=self._conn_loop, args=(conn,),
+                    name=f"agent-conn-{addr[1]}", daemon=True,
+                ).start()
+        finally:
             try:
-                conn, addr = self._server.accept()
+                srv.close()
             except OSError:
-                return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            threading.Thread(
-                target=self._conn_loop, args=(conn,),
-                name=f"agent-conn-{addr[1]}", daemon=True,
-            ).start()
+                pass
 
     def _conn_loop(self, conn: socket.socket) -> None:
         entities = set()

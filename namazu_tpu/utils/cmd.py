@@ -27,6 +27,17 @@ class CmdFactory:
         if self.materials_dir:
             env["NMZ_MATERIALS_DIR"] = self.materials_dir
             env["NMZ_TPU_MATERIALS_DIR"] = self.materials_dir
+        # experiment scripts spawn fresh interpreters that must be able to
+        # import the framework (e.g. `python -m namazu_tpu.cli inspectors`)
+        # even when it is not installed site-wide
+        import namazu_tpu
+
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.abspath(namazu_tpu.__file__)))
+        parts = env.get("PYTHONPATH", "").split(os.pathsep)
+        if pkg_parent not in parts:
+            env["PYTHONPATH"] = os.pathsep.join(
+                [pkg_parent] + [p for p in parts if p])
         return env
 
     def run(

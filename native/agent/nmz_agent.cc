@@ -15,6 +15,7 @@
 
 #include <arpa/inet.h>
 #include <netdb.h>
+#include <netinet/tcp.h>
 #include <pthread.h>
 #include <stdint.h>
 #include <stdio.h>
@@ -130,11 +131,13 @@ bool recv_all(int fd, void* buf, size_t n) {
 }
 
 bool send_frame(Agent* a, const std::string& payload) {
-  uint32_t len = static_cast<uint32_t>(payload.size());
-  uint32_t le = htole32(len);
+  /* single write: a split header/body send turns into 40ms of
+   * Nagle + delayed-ACK latency per event */
+  uint32_t le = htole32(static_cast<uint32_t>(payload.size()));
+  std::string buf(reinterpret_cast<const char*>(&le), 4);
+  buf += payload;
   std::lock_guard<std::mutex> lk(a->send_mu);
-  return send_all(a->fd, &le, 4) &&
-         send_all(a->fd, payload.data(), payload.size());
+  return send_all(a->fd, buf.data(), buf.size());
 }
 
 void reader_loop(Agent* a) {
@@ -206,6 +209,8 @@ int do_init() {
   }
   freeaddrinfo(res);
   if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
 
   g_agent = new Agent();
   g_agent->fd = fd;
