@@ -1,0 +1,88 @@
+"""Fault-localization analyzer: rank branches by success/failure divergence.
+
+Capability parity with the reference's Java analyzer
+(/root/reference/misc/analyzer/java/.../Analyzer.java:17-145), which loads
+each experiment run's JaCoCo coverage + result.json and prints
+"Suspicious:" branches whose hit counts diverge between successful and
+failed runs. Redesign: coverage is a plain JSON mapping
+``branch_id -> hit_count`` per run (any tracer can emit it — coverage.py,
+a JVM agent, or the C++ agent's hook counters), stored as
+``coverage.json`` in the run's working dir or passed explicitly.
+
+The divergence score doubles as a dense search-reward ingredient: branches
+that only fire in failing runs point the schedule search toward the bug
+(SURVEY.md section 7 "reward sparsity").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from namazu_tpu.storage.base import HistoryStorage
+
+Coverage = Dict[str, float]
+
+
+def load_run_coverage(storage: HistoryStorage, i: int) -> Optional[Coverage]:
+    path = os.path.join(storage._run_dir(i), "coverage.json")  # type: ignore[attr-defined]
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        raw = json.load(f)
+    return {str(k): float(v) for k, v in raw.items()}
+
+
+def divergence_ranking(
+    success_covs: Iterable[Coverage],
+    failure_covs: Iterable[Coverage],
+) -> List[Tuple[str, float, float, float]]:
+    """Rank branches by |P(hit | failure) - P(hit | success)|.
+
+    Returns [(branch, divergence, fail_rate, success_rate)] sorted
+    descending — the analyzer's "Suspicious" list.
+    """
+    success_covs = list(success_covs)
+    failure_covs = list(failure_covs)
+    branches = set()
+    for c in success_covs + failure_covs:
+        branches.update(c)
+
+    def hit_rate(covs: List[Coverage], b: str) -> float:
+        if not covs:
+            return 0.0
+        return sum(1.0 for c in covs if c.get(b, 0) > 0) / len(covs)
+
+    ranked = []
+    for b in branches:
+        fr = hit_rate(failure_covs, b)
+        sr = hit_rate(success_covs, b)
+        ranked.append((b, abs(fr - sr), fr, sr))
+    ranked.sort(key=lambda t: (-t[1], t[0]))
+    return ranked
+
+
+def analyze_storage(
+    storage: HistoryStorage, top: int = 20
+) -> List[Tuple[str, float, float, float]]:
+    """Analyze every completed run with recorded coverage."""
+    succ, fail = [], []
+    for i in range(storage.nr_stored_histories()):
+        cov = load_run_coverage(storage, i)
+        if cov is None:
+            continue
+        try:
+            ok = storage.is_successful(i)
+        except Exception:
+            continue
+        (succ if ok else fail).append(cov)
+    return divergence_ranking(succ, fail)[:top]
+
+
+def print_report(ranking, min_divergence: float = 0.0) -> None:
+    for branch, div, fr, sr in ranking:
+        if div < min_divergence:
+            continue
+        print(f"Suspicious: {branch}  divergence={div:.2f} "
+              f"(failure hit-rate {fr:.2f}, success hit-rate {sr:.2f})")

@@ -15,6 +15,7 @@ from typing import List, Optional
 
 def build_parser() -> argparse.ArgumentParser:
     from namazu_tpu.cli import (
+        container_cmd,
         init_cmd,
         inspectors_cmd,
         orchestrator_cmd,
@@ -32,6 +33,7 @@ def build_parser() -> argparse.ArgumentParser:
     orchestrator_cmd.register(sub)
     inspectors_cmd.register(sub)
     tools_cmd.register(sub)
+    container_cmd.register(sub)
     return parser
 
 

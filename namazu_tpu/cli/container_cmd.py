@@ -1,0 +1,43 @@
+"""``nmz-tpu container run [-v HOST:CONT]... IMAGE CMD...``
+
+Parity: the reference's docker-like `nmz container run`
+(/root/reference/nmz/cli/container/run/run.go:83-124). Gated on a docker
+CLI being present; see namazu_tpu/container.py for the interception
+wiring (LD_PRELOAD interposer + proc inspector instead of FUSE + NFQUEUE).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from namazu_tpu.container import ContainerRunError, run_container
+from namazu_tpu.utils.config import Config
+from namazu_tpu.utils.log import init_log
+
+
+def register(sub) -> None:
+    p = sub.add_parser("container", help="fuzz a containerized testee")
+    csub = p.add_subparsers(dest="container_cmd", required=True)
+    pr = csub.add_parser("run", help="docker-like run with fuzzing pre-wired")
+    pr.add_argument("-v", "--volume", action="append", default=[],
+                    help="HOST:CONT bind mount (repeatable)")
+    pr.add_argument("--autopilot", default=None,
+                    help="config for the embedded orchestrator")
+    pr.add_argument("--fs-root", default="/data",
+                    help="container path subtree to intercept")
+    pr.add_argument("image")
+    pr.add_argument("command", nargs="+")
+    pr.set_defaults(func=run)
+
+
+def run(args) -> int:
+    init_log()
+    cfg = Config.from_file(args.autopilot) if args.autopilot else Config()
+    try:
+        return run_container(
+            args.image, args.command,
+            volumes=args.volume, config=cfg, fs_root=args.fs_root,
+        )
+    except ContainerRunError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1

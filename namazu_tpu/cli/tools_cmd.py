@@ -35,6 +35,27 @@ def register(sub) -> None:
                     help="emit gnuplot-ready two-column data only")
     pv.set_defaults(func=visualize)
 
+    pa = tsub.add_parser(
+        "analyze",
+        help="rank coverage branches by success/failure divergence "
+             "(fault localization)",
+    )
+    pa.add_argument("storage")
+    pa.add_argument("--top", type=int, default=20)
+    pa.set_defaults(func=analyze)
+
+
+def analyze(args) -> int:
+    from namazu_tpu.analyzer import analyze_storage, print_report
+
+    st = load_storage(args.storage)
+    ranking = analyze_storage(st, top=args.top)
+    if not ranking:
+        print("no runs with coverage.json found")
+        return 0
+    print_report(ranking)
+    return 0
+
 
 def summary(args) -> int:
     st = load_storage(args.storage)

@@ -1,0 +1,117 @@
+"""Container mode: fuzz a containerized testee with one command.
+
+Capability parity with /root/reference/nmz/container + nmz/cli/container
+(`nmz container run`, SURVEY.md section 2.12): boot a container with the
+framework's interception pre-wired — an embedded autopilot orchestrator on
+the host, the LD_PRELOAD fs interposer bind-mounted into the container
+(replacing the reference's FUSE-volume rewrite), and a proc inspector
+attached to the container's root PID (replacing its in-netns NFQUEUE
+setup, which needs kernel privileges a TPU-pod environment will not have).
+
+Requires a ``docker`` CLI; this image has none, so everything is gated
+behind :func:`docker_available` and the CLI reports the gap cleanly.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import threading
+from typing import List, Optional
+
+from namazu_tpu.utils.config import Config
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("container")
+
+INTERPOSE_LIB = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native", "build", "libnmz_fs_interpose.so",
+)
+
+
+def docker_available() -> bool:
+    return shutil.which("docker") is not None
+
+
+class ContainerRunError(RuntimeError):
+    pass
+
+
+def run_container(
+    image: str,
+    command: List[str],
+    volumes: Optional[List[str]] = None,
+    config: Optional[Config] = None,
+    fs_root: str = "/data",
+    proc_watch_interval: float = 1.0,
+    docker_args: Optional[List[str]] = None,
+) -> int:
+    """`nmz-tpu container run` core.
+
+    Boots an autopilot orchestrator (agent endpoint on an auto port), runs
+    ``docker run --network=host`` with the interposer preloaded and
+    pointed at it, attaches a proc inspector to the container's root PID,
+    and returns the container's exit status.
+    """
+    if not docker_available():
+        raise ContainerRunError(
+            "container mode needs a `docker` CLI on PATH; none found. "
+            "(The interception itself — LD_PRELOAD interposer + proc "
+            "inspector — has no other host requirements.)"
+        )
+    if not os.path.exists(INTERPOSE_LIB):
+        raise ContainerRunError(
+            f"{INTERPOSE_LIB} missing; build it with `make -C native`"
+        )
+
+    from namazu_tpu.inspector.proc import ProcInspector
+    from namazu_tpu.inspector.transceiver import new_transceiver
+    from namazu_tpu.orchestrator import AutopilotOrchestrator
+
+    cfg = config or Config()
+    cfg.set("agent_port", 0)
+    orc = AutopilotOrchestrator(cfg)
+    orc.hub.add_endpoint(_agent_endpoint())
+    orc.start()
+    agent = orc.hub.endpoint("agent")
+
+    name = f"nmz-tpu-{os.getpid()}"
+    cmd = [
+        "docker", "run", "--rm", "--name", name, "--network=host",
+        "-v", f"{os.path.abspath(INTERPOSE_LIB)}:/opt/nmz/interpose.so:ro",
+        "-e", "LD_PRELOAD=/opt/nmz/interpose.so",
+        "-e", f"NMZ_TPU_AGENT_ADDR=127.0.0.1:{agent.port}",
+        "-e", f"NMZ_TPU_FS_ROOT={fs_root}",
+        "-e", "NMZ_TPU_ENTITY_ID=container",
+    ]
+    for v in volumes or []:
+        cmd += ["-v", v]
+    cmd += docker_args or []
+    cmd += [image] + command
+
+    log.info("booting container: %s", " ".join(cmd))
+    proc = subprocess.Popen(cmd)
+
+    inspector = ProcInspector(
+        new_transceiver("local://", "_nmz_container_proc",
+                        orc.local_endpoint),
+        root_pid=proc.pid,
+        entity_id="_nmz_container_proc",
+        watch_interval=proc_watch_interval,
+    )
+    t = threading.Thread(target=inspector.serve, daemon=True)
+    t.start()
+    try:
+        return proc.wait()
+    finally:
+        inspector.stop()
+        t.join(timeout=5)
+        orc.shutdown()
+
+
+def _agent_endpoint():
+    from namazu_tpu.endpoint.agent import AgentEndpoint
+
+    return AgentEndpoint(port=0)

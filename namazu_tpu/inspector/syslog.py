@@ -1,0 +1,79 @@
+"""Syslog inspector: observation-only LogEvents from a UDP syslog socket.
+
+Parity: /root/reference/misc/pynmz/inspector/syslog.py:16-84 — point the
+system-under-test's syslog at this server; every line becomes a
+non-deferred LogEvent (useful as a bug-predicate signal for the search
+plane: "leader elected", stack traces, ...).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Callable, Optional
+
+from namazu_tpu.inspector.transceiver import Transceiver
+from namazu_tpu.signal.event import LogEvent
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("inspector.syslog")
+
+
+class SyslogInspector:
+    def __init__(
+        self,
+        transceiver: Transceiver,
+        entity_id: str = "_nmz_syslog_inspector",
+        host: str = "127.0.0.1",
+        port: int = 10514,
+        line_filter: Optional[Callable[[str], bool]] = None,
+    ):
+        self.trans = transceiver
+        self.entity_id = entity_id
+        self._addr = (host, port)
+        self.line_filter = line_filter
+        self._sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self.line_count = 0
+
+    @property
+    def port(self) -> int:
+        return self._sock.getsockname()[1] if self._sock else self._addr[1]
+
+    def start(self) -> None:
+        self.trans.start()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind(self._addr)
+        self._sock.settimeout(0.2)
+        threading.Thread(target=self._serve, name="syslog-inspector",
+                         daemon=True).start()
+        log.info("syslog inspector on udp %s:%d", self._addr[0], self.port)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _serve(self) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    data, _ = self._sock.recvfrom(65536)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                for raw in data.decode(errors="replace").splitlines():
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    if self.line_filter and not self.line_filter(line):
+                        continue
+                    self.line_count += 1
+                    # observation-only: no action expected back
+                    self.trans.send_notification(
+                        LogEvent.create(self.entity_id, line)
+                    )
+        finally:
+            try:
+                self._sock.close()
+            except OSError:
+                pass

@@ -9,6 +9,7 @@ pymongo is not part of this image.
 
 from namazu_tpu.storage.base import HistoryStorage, StorageError, new_storage, load_storage
 from namazu_tpu.storage.naive import NaiveStorage
+from namazu_tpu.storage import mongodb as _mongodb  # registers when pymongo exists  # noqa: F401
 
 __all__ = [
     "HistoryStorage",

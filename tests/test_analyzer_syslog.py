@@ -1,0 +1,106 @@
+"""Analyzer + syslog inspector + container/mongodb gating tests."""
+
+import json
+import socket
+import time
+
+import pytest
+
+from namazu_tpu.analyzer import divergence_ranking, analyze_storage
+from namazu_tpu.cli import cli_main
+from namazu_tpu.container import ContainerRunError, docker_available, run_container
+from namazu_tpu.endpoint.hub import EndpointHub
+from namazu_tpu.endpoint.local import LocalEndpoint
+from namazu_tpu.inspector.syslog import SyslogInspector
+from namazu_tpu.inspector.transceiver import new_transceiver
+from namazu_tpu.signal import LogEvent, NopEvent, PacketEvent
+from namazu_tpu.storage import StorageError, new_storage
+from namazu_tpu.utils.mock_orchestrator import MockOrchestrator
+from namazu_tpu.utils.trace import SingleTrace
+
+
+def test_divergence_ranking_orders_by_signal():
+    succ = [{"b1": 1, "b2": 1}, {"b1": 1}]
+    fail = [{"b1": 1, "b2": 1, "bug_branch": 3},
+            {"b1": 1, "bug_branch": 1}]
+    ranking = divergence_ranking(succ, fail)
+    assert ranking[0][0] == "bug_branch"
+    assert ranking[0][1] == pytest.approx(1.0)  # 100% fail vs 0% success
+    by_name = {b: d for b, d, *_ in ranking}
+    assert by_name["b1"] == pytest.approx(0.0)
+    assert by_name["b2"] == pytest.approx(0.0)
+
+
+def test_analyze_storage_and_cli(tmp_path, capsys):
+    st = new_storage("naive", str(tmp_path / "st"))
+    st.create()
+    for covs, ok in (
+        ({"common": 1}, True),
+        ({"common": 1, "racy": 2}, False),
+        ({"common": 1}, True),
+        ({"common": 1, "racy": 1}, False),
+    ):
+        wd = st.create_new_working_dir()
+        st.record_new_trace(SingleTrace([NopEvent("e").default_action()]))
+        st.record_result(ok, 0.1)
+        with open(f"{wd}/coverage.json", "w") as f:
+            json.dump(covs, f)
+    ranking = analyze_storage(st)
+    assert ranking[0][0] == "racy"
+
+    assert cli_main(["tools", "analyze", str(tmp_path / "st")]) == 0
+    out = capsys.readouterr().out
+    assert "Suspicious: racy" in out
+
+
+def test_syslog_inspector_emits_log_events():
+    hub = EndpointHub()
+    lep = LocalEndpoint()
+    hub.add_endpoint(lep)
+    received = []
+    orig_post = hub.post_event
+
+    def spy(event, name):
+        received.append(event)
+        orig_post(event, name)
+
+    hub.post_event = spy
+    mock = MockOrchestrator(hub)
+    mock.start()
+    trans = new_transceiver("local://", "syslog0", lep)
+    insp = SyslogInspector(trans, entity_id="syslog0", port=0,
+                           line_filter=lambda l: "ERROR" in l)
+    insp.start()
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.sendto(b"<11>app: ERROR election failed\n<11>app: INFO ok\n",
+                 ("127.0.0.1", insp.port))
+        deadline = time.monotonic() + 5
+        while insp.line_count < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert insp.line_count == 1  # filter dropped the INFO line
+        logs = [e for e in received if isinstance(e, LogEvent)]
+        assert len(logs) == 1
+        assert "ERROR election failed" in logs[0].line
+    finally:
+        insp.stop()
+        mock.shutdown()
+
+
+def test_container_mode_gated_without_docker():
+    if docker_available():
+        pytest.skip("docker present; gating not applicable")
+    with pytest.raises(ContainerRunError, match="docker"):
+        run_container("ubuntu", ["true"])
+    assert cli_main(["container", "run", "ubuntu", "true"]) == 1
+
+
+def test_mongodb_storage_gated_without_pymongo(tmp_path):
+    try:
+        import pymongo  # noqa: F401
+
+        pytest.skip("pymongo present; gating not applicable")
+    except ImportError:
+        pass
+    with pytest.raises(StorageError, match="unknown storage type 'mongodb'"):
+        new_storage("mongodb", str(tmp_path))
