@@ -104,18 +104,16 @@ class ScheduleSearch:
 
     # -- search ----------------------------------------------------------
 
-    def run(self, encoded: te.EncodedTrace, generations: int = 50) -> BestSchedule:
-        """Evolve against one reference trace for N generations; returns
-        the best schedule seen so far (monotonic across calls)."""
+    def run(self, encoded, generations: int = 50) -> BestSchedule:
+        """Evolve against one or more reference traces for N generations;
+        returns the best schedule seen so far (monotonic across calls)."""
         import jax.numpy as jnp
 
         from namazu_tpu.ops.schedule import TraceArrays
 
-        trace = TraceArrays(
-            jnp.asarray(encoded.hint_ids),
-            jnp.asarray(encoded.arrival),
-            jnp.asarray(encoded.mask),
-        )
+        encs = encoded if isinstance(encoded, (list, tuple)) else [encoded]
+        h, _, a, m = te.stack_traces(encs)
+        trace = TraceArrays(jnp.asarray(h), jnp.asarray(a), jnp.asarray(m))
         pairs = jnp.asarray(self.pairs)
         archive = jnp.asarray(self.archive)
         failures = jnp.asarray(self.failures)

@@ -150,3 +150,102 @@ def score_population_jit(delays, trace, pairs, archive, failure_feats,
                          weights: ScoreWeights = ScoreWeights()):
     return score_population(delays, trace, pairs, archive, failure_feats,
                             weights)
+
+
+# -- multi-trace scoring ----------------------------------------------------
+
+
+def score_population_multi(
+    delays: jax.Array,  # [P, H]
+    traces: TraceArrays,  # arrays with leading trace dim [T, L]
+    pairs: jax.Array,  # [K, 2]
+    archive: jax.Array,  # [A, K]
+    failure_feats: jax.Array,  # [F, K]
+    weights: ScoreWeights = ScoreWeights(),
+) -> tuple[jax.Array, jax.Array]:
+    """Fitness aggregated over T recorded traces.
+
+    A schedule that is only novel against one historical run is usually
+    just exploiting that run's noise; averaging novelty/bug affinity over
+    every stored trace rewards schedules whose *interleaving structure*
+    transfers. Returns (fitness f32[P], feats f32[P, T, K]).
+    """
+    H = delays.shape[1] if delays.ndim == 2 else delays.shape[0]
+
+    def per_trace(tr: TraceArrays):
+        return jax.vmap(
+            lambda d: schedule_features(d, tr, pairs, weights.tau)
+        )(delays)  # [P, K]
+
+    feats = jax.vmap(
+        lambda h, a, m: per_trace(TraceArrays(h, a, m))
+    )(traces.hint_ids, traces.arrival, traces.mask)  # [T, P, K]
+    feats = jnp.swapaxes(feats, 0, 1)  # [P, T, K]
+    P, T, K = feats.shape
+    flat = feats.reshape(P * T, K)
+    novelty = min_sq_distance(flat, archive).reshape(P, T).mean(axis=1)
+    bug = -min_sq_distance(flat, failure_feats).reshape(P, T).mean(axis=1)
+    delay_cost = jnp.mean(delays, axis=-1)
+    fitness = (
+        weights.novelty * novelty
+        + weights.bug * bug
+        - weights.delay_cost * delay_cost
+    )
+    return fitness, feats
+
+
+# -- long traces: blockwise first-occurrence --------------------------------
+
+
+def first_occurrence_blockwise(
+    delays: jax.Array,  # [H]
+    hint_ids: jax.Array,  # [L] with L = n_chunks * chunk
+    arrival: jax.Array,  # [L]
+    mask: jax.Array,  # [L]
+    chunk: int = 512,
+) -> jax.Array:
+    """First-occurrence times over an arbitrarily long trace via lax.scan.
+
+    min is associative, so the [H] running minimum is a scan carry and the
+    peak live buffer is one [chunk] block instead of the whole trace —
+    the long-sequence analogue of blockwise attention for this workload
+    (SURVEY.md section 5.7: schedule genomes over long event traces are
+    this framework's long sequences).
+    """
+    H = delays.shape[0]
+    L = hint_ids.shape[0]
+    n_chunks = -(-L // chunk)
+    pad = n_chunks * chunk - L
+    hint_ids = jnp.pad(hint_ids, (0, pad))
+    arrival = jnp.pad(arrival, (0, pad))
+    mask = jnp.pad(mask, (0, pad))
+
+    def step(first, blk):
+        h, a, m = blk
+        t = jnp.where(m, a + delays[h], BIG)
+        first = first.at[h].min(t)
+        return first, None
+
+    init = jnp.full((H,), BIG, jnp.float32)
+    first, _ = jax.lax.scan(
+        step,
+        init,
+        (
+            hint_ids.reshape(n_chunks, chunk),
+            arrival.reshape(n_chunks, chunk),
+            mask.reshape(n_chunks, chunk),
+        ),
+    )
+    return first
+
+
+def schedule_features_long(
+    delays: jax.Array, trace: TraceArrays, pairs: jax.Array, tau: float,
+    chunk: int = 512,
+) -> jax.Array:
+    """Feature vector for long traces (thousands of events) with bounded
+    memory; numerically identical to :func:`schedule_features`."""
+    first = first_occurrence_blockwise(
+        delays, trace.hint_ids, trace.arrival, trace.mask, chunk
+    )
+    return precedence_features(first, pairs, tau)

@@ -24,7 +24,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from namazu_tpu.models.ga import GAConfig, Population, ga_generation, init_population
-from namazu_tpu.ops.schedule import ScoreWeights, TraceArrays, score_population
+from namazu_tpu.ops.schedule import (
+    ScoreWeights,
+    TraceArrays,
+    score_population_multi,
+)
 
 
 class IslandState(NamedTuple):
@@ -63,7 +67,7 @@ def make_island_step(
         idx = jax.lax.axis_index(axis)
         key = jax.random.fold_in(key, idx)
 
-        fitness, _feats = score_population(
+        fitness, _feats = score_population_multi(
             pop.delays, trace, pairs, archive, failure_feats, weights
         )
         # local best before evolution (elites survive anyway)
@@ -115,6 +119,10 @@ def make_island_step(
     @jax.jit
     def step(state: IslandState, base_key, trace: TraceArrays, pairs,
              archive, failure_feats) -> IslandState:
+        if trace.hint_ids.ndim == 1:  # single trace -> batch of one
+            trace = TraceArrays(
+                trace.hint_ids[None], trace.arrival[None], trace.mask[None]
+            )
         key = jax.random.fold_in(base_key, state.gen)
         new_pop, fit, bd, bf = sharded(
             key, state.pop, trace, pairs, archive, failure_feats

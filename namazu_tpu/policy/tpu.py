@@ -159,11 +159,11 @@ class TPUSearchPolicy(QueueBackedPolicy):
                                  self.checkpoint_path,
                                  self._search.generations_run)
                 search = self._search
-            reference = self._ingest_history(search)
-            if reference is None:
+            references = self._ingest_history(search)
+            if not references:
                 log.info("no stored history yet; keeping hash-based delays")
                 return
-            best = search.run(reference, generations=self.generations)
+            best = search.run(references, generations=self.generations)
             self._delays = best.delays
             self._faults = best.faults
             log.info("installed searched schedule (fitness %.4f, gen %d)",
@@ -173,19 +173,22 @@ class TPUSearchPolicy(QueueBackedPolicy):
         except Exception:
             log.exception("schedule search failed; hash-based delays remain")
 
+    MAX_REFERENCE_TRACES = 4
+
     def _ingest_history(self, search):
-        """Feed stored traces into the archives; return the reference trace
-        (most recent failure if any, else most recent run)."""
+        """Feed stored traces into the archives; return the reference
+        traces to evolve against (most recent failures, padded with the
+        most recent successes, newest first, up to MAX_REFERENCE_TRACES)."""
         from namazu_tpu.ops import trace_encoding as te
 
         storage = self._storage
         if storage is None:
-            return None
+            return []
         try:
             n = storage.nr_stored_histories()
         except Exception:
-            return None
-        reference = None
+            return []
+        failures, successes = [], []
         for i in range(n):
             try:
                 trace = storage.get_stored_history(i)
@@ -197,10 +200,11 @@ class TPUSearchPolicy(QueueBackedPolicy):
             # "failure" = the run reproduced the bug (validate failed)
             if not ok:
                 search.add_failure_trace(enc)
-                reference = enc
-            elif reference is None:
-                reference = enc
-        return reference
+                failures.append(enc)
+            else:
+                successes.append(enc)
+        refs = (failures[::-1] + successes[::-1])[: self.MAX_REFERENCE_TRACES]
+        return refs
 
     def wait_for_search(self, timeout: float = 120.0) -> bool:
         """Block until the background search installed a schedule (tests)."""

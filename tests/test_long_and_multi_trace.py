@@ -1,0 +1,112 @@
+"""Multi-trace scoring and blockwise long-trace support."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from namazu_tpu.models.ga import GAConfig
+from namazu_tpu.models.search import ScheduleSearch, SearchConfig
+from namazu_tpu.ops import trace_encoding as te
+from namazu_tpu.ops.schedule import (
+    ScoreWeights,
+    TraceArrays,
+    first_occurrence,
+    first_occurrence_blockwise,
+    release_times,
+    schedule_features,
+    schedule_features_long,
+    score_population,
+    score_population_multi,
+)
+from namazu_tpu.parallel.islands import init_island_state, make_island_step
+from namazu_tpu.parallel.mesh import make_mesh
+
+H, L, K = 32, 64, 64
+
+
+def enc(stream, L_=L):
+    return te.encode_event_stream(stream, L=L_, H=H)
+
+
+def as_arrays(e):
+    return TraceArrays(jnp.asarray(e.hint_ids), jnp.asarray(e.arrival),
+                       jnp.asarray(e.mask))
+
+
+def test_multi_trace_matches_mean_of_single():
+    t1 = as_arrays(enc([f"a{i % 7}" for i in range(40)]))
+    t2 = as_arrays(enc([f"b{i % 5}" for i in range(30)]))
+    batch = TraceArrays(
+        jnp.stack([t1.hint_ids, t2.hint_ids]),
+        jnp.stack([t1.arrival, t2.arrival]),
+        jnp.stack([t1.mask, t2.mask]),
+    )
+    pairs = jnp.asarray(te.sample_pairs(K, H, 0))
+    archive = jnp.asarray(np.random.RandomState(0).rand(8, K).astype(np.float32))
+    fails = jnp.asarray(np.random.RandomState(1).rand(4, K).astype(np.float32))
+    delays = jnp.asarray(
+        np.random.RandomState(2).rand(16, H).astype(np.float32) * 0.05)
+    w = ScoreWeights()
+
+    multi_fit, multi_feats = score_population_multi(
+        delays, batch, pairs, archive, fails, w)
+    f1, _ = score_population(delays, t1, pairs, archive, fails, w)
+    f2, _ = score_population(delays, t2, pairs, archive, fails, w)
+    # fitness decomposes: novelty/bug average over traces, delay cost once
+    dc = w.delay_cost * delays.mean(axis=-1)
+    want = ((f1 + dc) + (f2 + dc)) / 2 - dc
+    assert np.allclose(np.asarray(multi_fit), np.asarray(want), rtol=1e-4,
+                       atol=1e-5)
+    assert multi_feats.shape == (16, 2, K)
+
+
+def test_blockwise_first_occurrence_matches_dense():
+    e = enc([f"h{i % 13}" for i in range(200)], L_=256)
+    tr = as_arrays(e)
+    delays = jnp.asarray(
+        np.random.RandomState(3).rand(H).astype(np.float32) * 0.05)
+    dense = first_occurrence(release_times(delays, tr), tr, H)
+    block = first_occurrence_blockwise(
+        delays, tr.hint_ids, tr.arrival, tr.mask, chunk=64)
+    assert np.allclose(np.asarray(dense), np.asarray(block))
+
+
+def test_long_trace_features_match_dense_and_scale():
+    # a 4096-event trace scores with bounded memory
+    Llong = 4096
+    e = enc([f"h{i % 29}" for i in range(4000)], L_=Llong)
+    tr = as_arrays(e)
+    pairs = jnp.asarray(te.sample_pairs(K, H, 0))
+    delays = jnp.asarray(
+        np.random.RandomState(4).rand(H).astype(np.float32) * 0.05)
+    f_long = schedule_features_long(delays, tr, pairs, 0.005, chunk=512)
+    f_dense = schedule_features(delays, tr, pairs, 0.005)
+    assert np.allclose(np.asarray(f_long), np.asarray(f_dense), atol=1e-6)
+
+
+def test_island_step_accepts_trace_batch():
+    mesh = make_mesh(8)
+    cfg = GAConfig(max_delay=0.05)
+    step = make_island_step(mesh, cfg, ScoreWeights(), migrate_k=2)
+    t1 = enc([f"a{i % 7}" for i in range(40)])
+    t2 = enc([f"b{i % 5}" for i in range(30)])
+    h, _, a, m = te.stack_traces([t1, t2])
+    batch = TraceArrays(jnp.asarray(h), jnp.asarray(a), jnp.asarray(m))
+    pairs = jnp.asarray(te.sample_pairs(K, H, 0))
+    archive = jnp.full((8, K), 0.5)
+    fails = jnp.full((2, K), 0.5)
+    state = init_island_state(jax.random.PRNGKey(0), 256, H, cfg)
+    state = step(state, jax.random.PRNGKey(1), batch, pairs, archive, fails)
+    assert int(state.gen) == 1
+    assert np.isfinite(float(state.best_fitness))
+
+
+def test_search_driver_accepts_trace_list(tmp_path):
+    cfg = SearchConfig(H=H, L=L, K=K, population=128,
+                       ga=GAConfig(max_delay=0.05))
+    search = ScheduleSearch(cfg)
+    t1 = enc([f"a{i % 7}" for i in range(40)])
+    t2 = enc([f"b{i % 5}" for i in range(30)])
+    search.add_failure_trace(t1)
+    best = search.run([t1, t2], generations=3)
+    assert np.isfinite(best.fitness)
