@@ -170,8 +170,6 @@ def score_population_multi(
     every stored trace rewards schedules whose *interleaving structure*
     transfers. Returns (fitness f32[P], feats f32[P, T, K]).
     """
-    H = delays.shape[1] if delays.ndim == 2 else delays.shape[0]
-
     def per_trace(tr: TraceArrays):
         return jax.vmap(
             lambda d: schedule_features(d, tr, pairs, weights.tau)
@@ -199,7 +197,7 @@ def score_population_multi(
 
 def first_occurrence_blockwise(
     delays: jax.Array,  # [H]
-    hint_ids: jax.Array,  # [L] with L = n_chunks * chunk
+    hint_ids: jax.Array,  # [L], any length (padded internally)
     arrival: jax.Array,  # [L]
     mask: jax.Array,  # [L]
     chunk: int = 512,
