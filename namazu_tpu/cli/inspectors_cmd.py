@@ -40,6 +40,10 @@ def register(sub) -> None:
                     help="proxy listen address host:port")
     pe.add_argument("--upstream", default=None,
                     help="upstream address host:port")
+    pe.add_argument("--parser", default=None,
+                    help="semantic parser: zookeeper (protocol by upstream "
+                         "port), zookeeper-fle, zookeeper-zab, "
+                         "zookeeper-client, http/etcd")
     pe.set_defaults(func=run_ethernet)
 
 
@@ -109,6 +113,26 @@ def run_fs(args) -> int:
             orc.shutdown()
 
 
+def make_parser(name, upstream: str = ""):
+    """Resolve a --parser flag value to a PacketParser (or None)."""
+    if not name:
+        return None
+    if name == "zookeeper":
+        from namazu_tpu.inspector.zookeeper import zk_parser_for_port
+
+        _, _, port = upstream.rpartition(":")
+        return zk_parser_for_port(int(port or 0))
+    if name.startswith("zookeeper-"):
+        from namazu_tpu.inspector.zookeeper import ZkStreamParser
+
+        return ZkStreamParser(name[len("zookeeper-"):])
+    if name in ("http", "etcd"):
+        from namazu_tpu.inspector.http_parser import HttpStreamParser
+
+        return HttpStreamParser()
+    raise ValueError(f"unknown parser {name!r}")
+
+
 def run_ethernet(args) -> int:
     init_log()
     from namazu_tpu.inspector.ethernet import serve_proxy_inspector
@@ -116,9 +140,15 @@ def run_ethernet(args) -> int:
     if not (args.listen and args.upstream):
         print("error: --listen and --upstream are required", file=sys.stderr)
         return 1
+    try:
+        parser = make_parser(args.parser, args.upstream)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     trans, orc = _make_transceiver(args, "_nmz_ethernet_inspector")
     try:
-        return serve_proxy_inspector(trans, args.listen, args.upstream)
+        return serve_proxy_inspector(trans, args.listen, args.upstream,
+                                     parser=parser)
     finally:
         if orc is not None:
             orc.shutdown()

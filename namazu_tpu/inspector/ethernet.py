@@ -35,8 +35,13 @@ from namazu_tpu.utils.log import get_logger
 
 log = get_logger("inspector.ethernet")
 
-# chunk -> replay hint (or "" for no semantic identity)
-PacketParser = Callable[[bytes, str, str], str]
+# (chunk, src, dst[, conn_id]) -> replay hint; "" = no semantic identity
+# (still deferred), None = uninteresting traffic, forward immediately
+# without deferring (parity: map_packet_to_event returning None,
+# misc/pynmz/inspector/ether.py). Stateful parsers (StreamParser
+# subclasses) take conn_id so concurrent connections on one link never
+# share a parse buffer; plain 3-arg callables are also accepted.
+PacketParser = Callable[..., Optional[str]]
 
 
 def _addr(host_port: str) -> tuple[str, int]:
@@ -100,19 +105,20 @@ class ProxyLink:
                 log.warning("upstream %s unreachable: %s", self.upstream, e)
                 client.close()
                 continue
+            conn_id = self.inspector.next_conn_id()
             for src, dst, se, de in (
                 (client, up, self.src_entity, self.dst_entity),
                 (up, client, self.dst_entity, self.src_entity),
             ):
                 t = threading.Thread(
-                    target=self._pump, args=(src, dst, se, de),
+                    target=self._pump, args=(src, dst, se, de, conn_id),
                     daemon=True, name=f"proxy-pump-{se}->{de}",
                 )
                 t.start()
                 self._threads.append(t)
 
     def _pump(self, src: socket.socket, dst: socket.socket,
-              src_entity: str, dst_entity: str) -> None:
+              src_entity: str, dst_entity: str, conn_id: int = 0) -> None:
         try:
             while not self._stop.is_set():
                 try:
@@ -121,7 +127,8 @@ class ProxyLink:
                     break
                 if not chunk:
                     break
-                if self.inspector.allow(chunk, src_entity, dst_entity):
+                if self.inspector.allow(chunk, src_entity, dst_entity,
+                                        conn_id):
                     try:
                         dst.sendall(chunk)
                     except OSError:
@@ -150,10 +157,30 @@ class EthernetProxyInspector:
         self.trans = transceiver
         self.entity_id = entity_id
         self.parser = parser
+        # does the parser accept a conn_id (stateful stream parsers do)?
+        self._parser_takes_conn = False
+        if parser is not None:
+            import inspect
+
+            try:
+                sig = inspect.signature(parser)
+                self._parser_takes_conn = len(sig.parameters) >= 4 or any(
+                    p.kind == inspect.Parameter.VAR_POSITIONAL
+                    for p in sig.parameters.values()
+                )
+            except (TypeError, ValueError):
+                pass
         self.action_timeout = action_timeout
         self.links: list[ProxyLink] = []
         self.packet_count = 0
         self.drop_count = 0
+        self._conn_counter = 0
+        self._conn_lock = threading.Lock()
+
+    def next_conn_id(self) -> int:
+        with self._conn_lock:
+            self._conn_counter += 1
+            return self._conn_counter
 
     def add_link(self, listen: str, upstream: str,
                  src_entity: str, dst_entity: str) -> ProxyLink:
@@ -172,10 +199,18 @@ class EthernetProxyInspector:
 
     # -- the per-chunk hook (parity: onPacket, ethernet_nfq.go:95-109) ---
 
-    def allow(self, chunk: bytes, src_entity: str, dst_entity: str) -> bool:
+    def allow(self, chunk: bytes, src_entity: str, dst_entity: str,
+              conn_id: int = 0) -> bool:
         """Defer ``chunk``; returns False when the policy drops it."""
         self.packet_count += 1
-        hint = self.parser(chunk, src_entity, dst_entity) if self.parser else ""
+        if self.parser is None:
+            hint = ""
+        elif self._parser_takes_conn:
+            hint = self.parser(chunk, src_entity, dst_entity, conn_id)
+        else:
+            hint = self.parser(chunk, src_entity, dst_entity)
+        if hint is None:
+            return True
         event = PacketEvent.create(
             self.entity_id, src_entity, dst_entity,
             payload=chunk[:128], hint=hint,
@@ -195,10 +230,11 @@ class EthernetProxyInspector:
 
 
 def serve_proxy_inspector(
-    transceiver: Transceiver, listen: str, upstream: str
+    transceiver: Transceiver, listen: str, upstream: str,
+    parser: Optional[PacketParser] = None,
 ) -> int:
     """CLI entry: proxy one link until interrupted."""
-    inspector = EthernetProxyInspector(transceiver)
+    inspector = EthernetProxyInspector(transceiver, parser=parser)
     inspector.add_link(listen, upstream, src_entity="client",
                        dst_entity="server")
     inspector.start()

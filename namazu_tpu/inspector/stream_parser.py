@@ -1,0 +1,112 @@
+"""Shared scaffolding for stateful stream parsers (PacketParser impls).
+
+Both semantic parsers (zookeeper, http) are incremental state machines
+over per-direction byte streams. This base class owns the mechanics so
+they can't drift between protocols:
+
+* parse state keyed by ``(src, dst, conn_id)`` — **per TCP connection**,
+  not per link, so concurrent connections on one proxied link never
+  interleave bytes into one buffer;
+* a lock (pump threads for both directions call concurrently);
+* bounded buffering (``MAX_BUFFER``) and desync-to-passthrough: a parse
+  error marks only that direction broken ("" hints = no semantic
+  identity, traffic still flows);
+* keepalive suppression: messages matching ``NOISE_PREFIXES`` are
+  dropped from hints, and a chunk that is *pure* keepalive returns
+  ``None`` = forward without deferring.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("inspector.stream_parser")
+
+MAX_BUFFER = 16 * 1024 * 1024
+
+
+class DirState:
+    """Per-(direction, connection) incremental parse state."""
+
+    __slots__ = ("buf", "stage", "broken", "is_request", "skip", "chunked",
+                 "mode")
+
+    def __init__(self, is_request: bool) -> None:
+        self.buf = bytearray()
+        self.stage = "init"
+        self.broken = False
+        self.is_request = is_request
+        # http1-specific fields live here so DirState stays one class
+        self.skip = 0
+        self.chunked = False
+        self.mode = "detect"
+
+
+class StreamParser:
+    """Base PacketParser: subclasses implement ``_step(state)``.
+
+    ``_step`` must consume complete messages from ``state.buf`` and return
+    a hint string (or None when it needs more bytes); it is called in a
+    loop until it makes no progress. Raise to mark the direction broken.
+    """
+
+    #: hint prefixes suppressed when ignore_keepalive is set
+    NOISE_PREFIXES: Tuple[str, ...] = ()
+
+    def __init__(self, ignore_keepalive: bool = True):
+        self.ignore_keepalive = ignore_keepalive
+        self._dirs: Dict[Tuple[str, str, int], DirState] = {}
+        self._first_dir: Dict[int, Tuple[str, str]] = {}
+        self._lock = threading.Lock()
+
+    def __call__(self, chunk: bytes, src: str, dst: str,
+                 conn_id: int = 0) -> Optional[str]:
+        with self._lock:
+            key = (src, dst, conn_id)
+            d = self._dirs.get(key)
+            if d is None:
+                # the first direction seen on a connection is the side
+                # that connected (TCP: the client talks first)
+                first = self._first_dir.setdefault(conn_id, (src, dst))
+                d = self._dirs[key] = DirState(is_request=first == (src, dst))
+            if d.broken:
+                return ""
+            d.buf.extend(chunk)
+            if len(d.buf) > MAX_BUFFER:
+                log.warning("%s parser buffer overflow %s->%s; passthrough",
+                            type(self).__name__, src, dst)
+                d.broken = True
+                d.buf.clear()
+                return ""
+            try:
+                msgs = self._drain(d)
+            except Exception as e:  # defensive: never kill the pump thread
+                log.warning("%s parser desync %s->%s: %s; passthrough",
+                            type(self).__name__, src, dst, e)
+                d.broken = True
+                d.buf.clear()
+                return ""
+        if not msgs:
+            return ""  # incomplete frame: no semantic identity this chunk
+        if self.ignore_keepalive:
+            noise = self.NOISE_PREFIXES
+            msgs = [m for m in msgs if not m.startswith(noise)]
+            if not msgs:
+                return None  # pure keepalive: forward without deferring
+        return ";".join(msgs)
+
+    def _drain(self, d: DirState) -> List[str]:
+        msgs: List[str] = []
+        while True:
+            before = len(d.buf)
+            m = self._step(d)
+            if m:
+                msgs.append(m)
+            if len(d.buf) == before:  # no progress: need more bytes
+                return msgs
+
+    def _step(self, d: DirState) -> Optional[str]:
+        raise NotImplementedError

@@ -1,0 +1,293 @@
+"""ZooKeeper protocol parsers: semantic replay hints for the proxy inspector.
+
+Capability parity with the reference's zktraffic-based inspector
+(/root/reference/misc/pynmz/inspector/zookeeper.py:23-167), which sniffs
+raw packets and classifies them into FLE / ZAB / client messages so
+``PacketEvent``s carry *semantic* replay hints instead of raw bytes — the
+precondition for deterministic replay (and for the TPU search plane's
+hint->delay tables to transfer across runs).
+
+TPU-era redesign: interception happens in the userspace TCP proxy
+(namazu_tpu/inspector/ethernet.py), so instead of per-packet sniffing +
+TCP reassembly (zktraffic's job), each (direction, connection) of a link
+is a clean ordered byte stream and the parser is a small incremental
+state machine. No scapy, no zktraffic — the ZooKeeper wire formats are
+decoded directly:
+
+* **FLE** (election port, default 3888): QuorumCnxManager handshake —
+  a bare 8-byte sid (<=3.4), or the 8-byte PROTOCOL_VERSION ``-65536``
+  followed by sid and the sender's addr buffer (3.5+) — then 4-byte
+  length-framed notifications
+  ``state, leader, zxid, electionEpoch[, peerEpoch][, version]``.
+* **ZAB** (quorum port, default 2888): unframed jute QuorumPacket records
+  ``type(i32) zxid(i64) data(buffer) authinfo(vector<Id>)``.
+* **client** (default 2181): 4-byte length-framed requests/responses;
+  ConnectRequest/Response on the first frame; 4-letter admin words.
+
+Hints deliberately exclude per-run-volatile fields (session ids, xids,
+timestamps, payload bytes) the same way the reference's
+``map_zktraffic_message_to_dict`` ignores them (zookeeper.py:74-79), but
+are human-readable strings rather than opaque hashes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import Optional
+
+from namazu_tpu.inspector.stream_parser import MAX_BUFFER, DirState, \
+    StreamParser
+
+# 4-byte framed payloads never legitimately approach this
+MAX_FRAME = 4 * 1024 * 1024
+
+FLE_PROTOCOL_VERSION = -65536  # QuorumCnxManager.PROTOCOL_VERSION (3.5+)
+
+FLE_STATES = {0: "looking", 1: "following", 2: "leading", 3: "observing"}
+
+ZAB_TYPES = {
+    1: "request", 2: "proposal", 3: "ack", 4: "commit", 5: "ping",
+    6: "revalidate", 7: "sync", 8: "inform", 9: "commitandactivate",
+    10: "newleader", 11: "followerinfo", 12: "uptodate", 13: "diff",
+    14: "trunc", 15: "snap", 16: "observerinfo", 17: "leaderinfo",
+    18: "ackepoch", 19: "informandactivate",
+}
+
+CLIENT_OPS = {
+    0: "notification", 1: "create", 2: "delete", 3: "exists", 4: "getData",
+    5: "setData", 6: "getACL", 7: "setACL", 8: "getChildren", 9: "sync",
+    11: "ping", 12: "getChildren2", 13: "check", 14: "multi",
+    15: "create2", 16: "reconfig", 100: "auth", 101: "setWatches",
+    102: "sasl", -10: "createSession", -11: "closeSession", -1: "error",
+}
+
+# ops whose request body starts with a path string (first field after the
+# header) — enough to give the hint a semantic identity
+_PATH_OPS = frozenset(
+    ["create", "delete", "exists", "getData", "setData", "getACL", "setACL",
+     "getChildren", "sync", "getChildren2", "check", "create2"]
+)
+
+FOUR_LETTER_WORDS = frozenset(
+    [b"conf", b"cons", b"crst", b"dump", b"envi", b"ruok", b"srst", b"srvr",
+     b"stat", b"wchs", b"wchc", b"wchp", b"mntr", b"isro", b"gtmk", b"stmk"]
+)
+
+
+def _i32(b, off: int = 0) -> int:
+    return struct.unpack_from(">i", b, off)[0]
+
+
+def _i64(b, off: int = 0) -> int:
+    return struct.unpack_from(">q", b, off)[0]
+
+
+def _digest(data: bytes) -> str:
+    return hashlib.md5(data).hexdigest()[:8]
+
+
+class ZkStreamParser(StreamParser):
+    """Stateful chunk->hint parser for one ZooKeeper protocol.
+
+    Use one instance per proxied link (links are per-port, so the protocol
+    is known: election port -> "fle", quorum port -> "zab", client port ->
+    "client"). Returning ``None`` tells the inspector to forward without
+    deferring (pings), mirroring the reference's ``map_packet_to_event``
+    returning None (zookeeper.py:134-167).
+    """
+
+    NOISE_PREFIXES = ("ping",)
+
+    def __init__(self, protocol: str, ignore_pings: bool = True):
+        if protocol not in ("fle", "zab", "client"):
+            raise ValueError(f"unknown protocol {protocol!r}")
+        super().__init__(ignore_keepalive=ignore_pings)
+        self.protocol = protocol
+
+    @property
+    def ignore_pings(self) -> bool:
+        return self.ignore_keepalive
+
+    def _step(self, d: DirState) -> Optional[str]:
+        if self.protocol == "fle":
+            return self._fle_step(d)
+        if self.protocol == "zab":
+            return self._zab_step(d)
+        return self._client_step(d)
+
+    # -- FLE --------------------------------------------------------------
+
+    def _fle_step(self, d: DirState) -> Optional[str]:
+        buf = d.buf
+        if d.stage == "init":
+            if len(buf) < 8:
+                return None
+            first = _i64(buf)
+            if first == FLE_PROTOCOL_VERSION:
+                # 3.5+ initial: version(i64 -65536) sid(i64) addr(buffer)
+                if len(buf) < 20:
+                    return None
+                alen = _i32(buf, 16)
+                if not 0 <= alen <= MAX_FRAME:
+                    raise ValueError(f"bad FLE initial addr len {alen}")
+                if len(buf) < 20 + alen:
+                    return None
+                sid = _i64(buf, 8)
+                del buf[:20 + alen]
+                d.stage = "frames"
+                return f"fle:init:sid={sid}"
+            if _i32(buf) == 0:
+                # <=3.4 initial: bare big-endian sid (small, high word 0)
+                sid = first
+                del buf[:8]
+                d.stage = "frames"
+                return f"fle:init:sid={sid}"
+            d.stage = "frames"  # mid-stream attach: assume framed
+            return None
+        # length-framed notification
+        if len(buf) < 4:
+            return None
+        flen = _i32(buf)
+        if not 0 < flen <= MAX_FRAME:
+            raise ValueError(f"bad FLE frame len {flen}")
+        if len(buf) < 4 + flen:
+            return None
+        p = bytes(buf[4:4 + flen])
+        del buf[:4 + flen]
+        if flen < 28:
+            return f"fle:short:{_digest(p)}"
+        state = _i32(p, 0)
+        leader = _i64(p, 4)
+        zxid = _i64(p, 12)
+        epoch = _i64(p, 20)
+        peer_epoch = _i64(p, 28) if flen >= 36 else None
+        parts = [
+            "fle:notif",
+            f"state={FLE_STATES.get(state, state)}",
+            f"leader={leader}",
+            f"zxid={zxid:#x}",
+            f"epoch={epoch}",
+        ]
+        if peer_epoch is not None:
+            parts.append(f"peerEpoch={peer_epoch}")
+        return ":".join(parts)
+
+    # -- ZAB --------------------------------------------------------------
+
+    @staticmethod
+    def _zab_step(d: DirState) -> Optional[str]:
+        buf = d.buf
+        # jute QuorumPacket: type(i32) zxid(i64) data(buffer) authinfo(vec)
+        if len(buf) < 16:
+            return None
+        ptype = _i32(buf)
+        if ptype not in ZAB_TYPES:
+            raise ValueError(f"unknown ZAB packet type {ptype}")
+        zxid = _i64(buf, 4)
+        off = 12
+        dlen = _i32(buf, off)
+        off += 4
+        if dlen > MAX_FRAME:
+            raise ValueError(f"bad ZAB data len {dlen}")
+        ndata = max(0, dlen)  # -1 == null buffer
+        if len(buf) < off + ndata + 4:
+            return None
+        off += ndata
+        nauth = _i32(buf, off)
+        off += 4
+        if nauth > 64:
+            raise ValueError(f"bad ZAB authinfo count {nauth}")
+        for _ in range(max(0, nauth)):  # vector<Id{scheme, id}>
+            for _field in range(2):
+                if len(buf) < off + 4:
+                    return None
+                slen = _i32(buf, off)
+                off += 4
+                if slen > MAX_FRAME:
+                    raise ValueError(f"bad ZAB authinfo string {slen}")
+                slen = max(0, slen)
+                if len(buf) < off + slen:
+                    return None
+                off += slen
+        del buf[:off]
+        name = ZAB_TYPES[ptype]
+        if name == "ping":
+            return "ping"
+        return f"zab:{name}:zxid={zxid:#x}:dlen={ndata}"
+
+    # -- client protocol --------------------------------------------------
+
+    def _client_step(self, d: DirState) -> Optional[str]:
+        buf = d.buf
+        if d.stage == "init" and len(buf) >= 4 and bytes(buf[:4]) in \
+                FOUR_LETTER_WORDS:
+            word = bytes(buf[:4]).decode("ascii")
+            del buf[:4]
+            d.stage = "fourletter"  # rest of stream is the text reply
+            return f"cm:4lw:{word}"
+        if d.stage == "fourletter":
+            # free-form text response / nothing further to frame
+            buf.clear()
+            return None
+        if len(buf) < 4:
+            return None
+        flen = _i32(buf)
+        if not 0 <= flen <= MAX_FRAME:
+            raise ValueError(f"bad client frame len {flen}")
+        if len(buf) < 4 + flen:
+            return None
+        p = bytes(buf[4:4 + flen])
+        del buf[:4 + flen]
+        first = d.stage == "init"
+        d.stage = "frames"
+        if d.is_request:
+            return self._client_request(p, first)
+        return self._client_response(p, first)
+
+    @staticmethod
+    def _client_request(p: bytes, first: bool) -> str:
+        if first and len(p) >= 28:
+            # ConnectRequest: ver(i32) lastZxid(i64) timeout(i32)
+            # sessionId(i64) passwd(buffer) [readOnly(b)]
+            last_zxid = _i64(p, 4)
+            return f"cm:connect:lastZxid={last_zxid:#x}"
+        if len(p) < 8:
+            return f"cm:short:{_digest(p)}"
+        xid = _i32(p, 0)
+        op = _i32(p, 4)
+        name = CLIENT_OPS.get(op, f"op{op}")
+        if name == "ping" or xid == -2:
+            return "ping"
+        if name in _PATH_OPS and len(p) >= 12:
+            plen = _i32(p, 8)
+            if 0 <= plen <= len(p) - 12:
+                path = p[12:12 + plen].decode("utf-8", "replace")
+                return f"cm:{name}:{path}"
+        return f"cm:{name}"
+
+    @staticmethod
+    def _client_response(p: bytes, first: bool) -> str:
+        if first and len(p) >= 20:
+            # ConnectResponse: ver(i32) timeout(i32) sessionId(i64) passwd
+            return "sm:connect"
+        if len(p) < 16:
+            return f"sm:short:{_digest(p)}"
+        xid = _i32(p, 0)
+        zxid = _i64(p, 4)
+        err = _i32(p, 12)
+        if xid == -2:
+            return "ping"
+        if xid == -1:  # watch notification fired by the server
+            return f"sm:notification:zxid={zxid:#x}"
+        return f"sm:reply:zxid={zxid:#x}:err={err}"
+
+
+def zk_parser_for_port(port: int, ignore_pings: bool = True) -> ZkStreamParser:
+    """Pick the protocol by conventional ZooKeeper port (3888 election,
+    2888 quorum, anything else client)."""
+    if port % 10000 == 3888:
+        return ZkStreamParser("fle", ignore_pings)
+    if port % 10000 == 2888:
+        return ZkStreamParser("zab", ignore_pings)
+    return ZkStreamParser("client", ignore_pings)

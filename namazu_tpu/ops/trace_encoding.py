@@ -57,9 +57,10 @@ def encode_trace(
 ) -> EncodedTrace:
     """Encode a recorded action trace.
 
-    Replay hints are reconstructed from each action's cause-event class +
-    entity + option (the action's own entity/class carry the semantic
-    identity; uuids and timing are excluded, matching the hint contract).
+    Each action's preserved cause-event hint (``action.event_hint``, set by
+    ``Action.for_event``) is the semantic identity; actions recorded
+    without one (e.g. traces from before a semantic parser was attached)
+    fall back to cause-event class + entity.
     """
     entity_index = entity_index if entity_index is not None else {}
     hint_ids = np.zeros(L, np.int32)
@@ -78,7 +79,8 @@ def encode_trace(
         ent = action.entity_id
         if ent not in entity_index:
             entity_index[ent] = len(entity_index)
-        hint = f"{action.event_class or action.class_name()}:{ent}"
+        hint = getattr(action, "event_hint", "") or \
+            f"{action.event_class or action.class_name()}:{ent}"
         hint_ids[i] = hint_bucket(hint, H)
         entity_ids[i] = entity_index[ent]
         arrival[i] = (times[i] - t0) if times[i] else i * 1e-3

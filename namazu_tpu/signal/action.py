@@ -38,10 +38,15 @@ class Action(Signal):
         uuid: Optional[str] = None,
         event_uuid: str = "",
         event_class: str = "",
+        event_hint: str = "",
     ):
         super().__init__(entity_id=entity_id, option=option, uuid=uuid)
         self.event_uuid = event_uuid
         self.event_class = event_class
+        # the cause event's semantic replay hint, preserved so recorded
+        # traces keep the identity the search plane / replay keys on (the
+        # reference loses this: its traces are action-only gobs)
+        self.event_hint = event_hint
         self.triggered_time: Optional[float] = None
 
     @classmethod
@@ -56,6 +61,7 @@ class Action(Signal):
             option=option,
             event_uuid=event.uuid,
             event_class=event.class_name(),
+            event_hint=event.replay_hint(),
         )
 
     def mark_triggered(self, now: Optional[float] = None) -> None:
@@ -85,6 +91,8 @@ class Action(Signal):
             d["event_uuid"] = self.event_uuid
         if self.event_class:
             d["event_class"] = self.event_class
+        if self.event_hint:
+            d["event_hint"] = self.event_hint
         return d
 
     @classmethod
@@ -95,6 +103,7 @@ class Action(Signal):
             uuid=d.get("uuid"),
             event_uuid=d.get("event_uuid", ""),
             event_class=d.get("event_class", ""),
+            event_hint=d.get("event_hint", ""),
         )
 
 

@@ -1,0 +1,96 @@
+"""HTTP/1.x + HTTP/2 stream parser tests (etcd-style peer traffic)."""
+
+import struct
+
+from namazu_tpu.inspector.http_parser import (
+    H2_PREFACE,
+    HttpStreamParser,
+    etcd_parser,
+)
+
+
+def h2_frame(ftype, stream_id, payload=b"", flags=0):
+    return (struct.pack(">I", len(payload))[1:]
+            + bytes([ftype, flags])
+            + struct.pack(">I", stream_id)
+            + payload)
+
+
+def test_http1_raft_posts():
+    p = HttpStreamParser()
+    req = (b"POST /raft HTTP/1.1\r\nHost: peer\r\nContent-Length: 5\r\n\r\n"
+           b"hello")
+    assert p(req, "e1", "e2") == "http:POST:/raft"
+    # query strings are volatile: stripped from hints
+    req2 = b"GET /v2/keys/x?wait=true HTTP/1.1\r\nContent-Length: 0\r\n\r\n"
+    assert p(req2, "e1", "e2") == "http:GET:/v2/keys/x"
+
+
+def test_http1_response_and_pipelining():
+    p = HttpStreamParser()
+    resp = (b"HTTP/1.1 200 OK\r\nContent-Length: 2\r\n\r\nok"
+            b"HTTP/1.1 404 Not Found\r\nContent-Length: 0\r\n\r\n")
+    assert p(resp, "e2", "e1") == "http:resp:200;http:resp:404"
+
+
+def test_http1_body_split_across_chunks():
+    p = HttpStreamParser()
+    msg = b"POST /raft HTTP/1.1\r\nContent-Length: 10\r\n\r\n0123456789"
+    assert p(msg[:30], "a", "b") == ""
+    assert p(msg[30:48], "a", "b") == "http:POST:/raft"
+    assert p(msg[48:], "a", "b") == ""  # remaining body: no new identity
+    # next request parses cleanly after the body
+    assert p(b"GET /x HTTP/1.1\r\nContent-Length: 0\r\n\r\n", "a", "b") == \
+        "http:GET:/x"
+
+
+def test_http1_chunked_body():
+    p = HttpStreamParser()
+    msg = (b"POST /stream HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n"
+           b"4\r\nwiki\r\n0\r\n\r\n"
+           b"GET /after HTTP/1.1\r\nContent-Length: 0\r\n\r\n")
+    assert p(msg, "a", "b") == "http:POST:/stream;http:GET:/after"
+
+
+def test_h2_preface_and_frames():
+    p = HttpStreamParser()
+    stream = (H2_PREFACE
+              + h2_frame(4, 0)                       # SETTINGS (noise)
+              + h2_frame(1, 1, b"\x82\x86")          # HEADERS
+              + h2_frame(0, 1, b"grpc-payload"))     # DATA
+    hint = p(stream, "e1", "e2")
+    assert hint == "h2:preface;h2:HEADERS:s1:len=2;h2:DATA:s1:len=12"
+
+
+def test_h2_keepalive_suppressed():
+    p = HttpStreamParser()
+    p(H2_PREFACE, "a", "b")
+    assert p(h2_frame(6, 0, b"\x00" * 8), "a", "b") is None  # PING
+    assert p(h2_frame(8, 0, b"\x00\x00\x10\x00"), "a", "b") is None
+
+
+def test_h2_server_side_no_preface():
+    """The server direction starts with frames (no preface)."""
+    p = HttpStreamParser()
+    hint = p(h2_frame(4, 0) + h2_frame(1, 1, b"\x88"), "srv", "cli")
+    assert hint == "h2:HEADERS:s1:len=1"
+
+
+def test_h2_server_settings_with_payload():
+    """A realistic initial SETTINGS frame carries entries (6 bytes each);
+    detection must still pick h2, not HTTP/1."""
+    p = HttpStreamParser()
+    settings = h2_frame(4, 0, struct.pack(">HI", 3, 100)
+                        + struct.pack(">HI", 4, 65535))
+    hint = p(settings + h2_frame(1, 1, b"\x88\x84"), "srv", "cli")
+    assert hint == "h2:HEADERS:s1:len=2"
+
+
+def test_garbage_passthrough():
+    p = HttpStreamParser()
+    assert p(b"\xde\xad\xbe\xef not http at all\r\n\r\n", "a", "b") == ""
+    assert p(b"more garbage", "a", "b") == ""
+
+
+def test_etcd_parser_factory():
+    assert isinstance(etcd_parser(), HttpStreamParser)

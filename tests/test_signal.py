@@ -131,6 +131,19 @@ def test_deferred_default_action_is_acceptance():
     roundtrip(act)
 
 
+def test_action_preserves_event_hint():
+    """Actions carry the cause event's semantic replay hint through the
+    wire codec, so recorded traces keep the identity replay/search key on."""
+    ev = PacketEvent.create("e", "s", "d", hint="fle:notif:leader=3")
+    act = ev.default_action()
+    assert act.event_hint == "fle:notif:leader=3"
+    back = roundtrip(act)
+    assert back.event_hint == "fle:notif:leader=3"
+    # events without an explicit hint still stamp their derived hint
+    act2 = PacketEvent.create("e", "s", "d").default_action()
+    assert act2.event_hint == "packet:s->d"
+
+
 def test_fault_actions_roundtrip():
     ev = PacketEvent.create("e", "s", "d")
     fault = ev.default_fault_action()

@@ -1,0 +1,275 @@
+"""ZooKeeper wire-protocol parser tests (synthetic byte streams).
+
+Parity target: the reference's zktraffic-based semantic inspector
+(/root/reference/misc/pynmz/inspector/zookeeper.py) — classified FLE / ZAB
+/ client messages with stable replay hints, pings suppressed.
+"""
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from namazu_tpu.inspector.ethernet import EthernetProxyInspector
+from namazu_tpu.inspector.zookeeper import (
+    FLE_PROTOCOL_VERSION,
+    ZkStreamParser,
+    zk_parser_for_port,
+)
+
+
+def fle_notification(state, leader, zxid, epoch, peer_epoch=None):
+    body = struct.pack(">iqqq", state, leader, zxid, epoch)
+    if peer_epoch is not None:
+        body += struct.pack(">q", peer_epoch)
+    return struct.pack(">i", len(body)) + body
+
+
+def zab_packet(ptype, zxid, data=b"", auth=()):
+    out = struct.pack(">iq", ptype, zxid)
+    out += struct.pack(">i", len(data)) + data if data else struct.pack(">i", -1)
+    out += struct.pack(">i", len(auth)) if auth else struct.pack(">i", -1)
+    for scheme, ident in auth:
+        out += struct.pack(">i", len(scheme)) + scheme
+        out += struct.pack(">i", len(ident)) + ident
+    return out
+
+
+def client_frame(payload):
+    return struct.pack(">i", len(payload)) + payload
+
+
+def connect_request(last_zxid=0x100):
+    body = struct.pack(">iqiq", 0, last_zxid, 30000, 0)
+    body += struct.pack(">i", 16) + b"\x00" * 16
+    return client_frame(body)
+
+
+def request(xid, op, path=None):
+    body = struct.pack(">ii", xid, op)
+    if path is not None:
+        raw = path.encode()
+        body += struct.pack(">i", len(raw)) + raw
+    return client_frame(body)
+
+
+def response(xid, zxid, err=0):
+    return client_frame(struct.pack(">iqi", xid, zxid, err))
+
+
+# -- FLE ---------------------------------------------------------------------
+
+
+def test_fle_v34_handshake_and_notifications():
+    p = ZkStreamParser("fle")
+    stream = struct.pack(">q", 2)  # bare sid handshake (3.4)
+    stream += fle_notification(0, 3, 0x200000001, 7, 7)
+    hint = p(stream, "zk1", "zk2")
+    assert "fle:init:sid=2" in hint
+    assert "fle:notif:state=looking:leader=3:zxid=0x200000001:epoch=7:peerEpoch=7" in hint
+
+
+def test_fle_v35_handshake():
+    # 3.5+ initial: writeLong(PROTOCOL_VERSION) writeLong(sid)
+    # writeInt(addrLen) addr — the protocol version is an 8-byte long
+    p = ZkStreamParser("fle")
+    addr = b"10.0.0.1:3888"
+    stream = struct.pack(">qq", FLE_PROTOCOL_VERSION, 5)
+    stream += struct.pack(">i", len(addr)) + addr
+    assert p(stream, "a", "b") == "fle:init:sid=5"
+    # followed by a regular notification frame
+    hint = p(fle_notification(0, 5, 0x1, 2, 2), "a", "b")
+    assert hint.startswith("fle:notif:state=looking:leader=5")
+
+
+def test_fle_split_across_chunks():
+    p = ZkStreamParser("fle")
+    frame = struct.pack(">q", 1) + fle_notification(2, 1, 0x10, 3)
+    # first chunk completes the handshake but leaves the notification split
+    assert p(frame[:11], "a", "b") == "fle:init:sid=1"
+    hint = p(frame[11:], "a", "b")
+    assert "fle:notif:state=leading:leader=1" in hint
+
+
+def test_fle_directions_independent():
+    p = ZkStreamParser("fle")
+    assert p(struct.pack(">q", 1), "a", "b") == "fle:init:sid=1"
+    assert p(struct.pack(">q", 2), "b", "a") == "fle:init:sid=2"
+
+
+def test_fle_garbage_goes_passthrough_not_crash():
+    p = ZkStreamParser("fle")
+    p(struct.pack(">q", 1), "a", "b")
+    bad = struct.pack(">i", -5) + b"xxxx"
+    assert p(bad, "a", "b") == ""
+    # direction is marked broken; later chunks parse as no-identity
+    assert p(fle_notification(0, 1, 1, 1), "a", "b") == ""
+    # ...but the other direction still parses
+    assert p(struct.pack(">q", 3), "b", "a") == "fle:init:sid=3"
+
+
+# -- ZAB ---------------------------------------------------------------------
+
+
+def test_zab_stream():
+    p = ZkStreamParser("zab")
+    stream = (
+        zab_packet(11, 0x0, b"learnerinfo")
+        + zab_packet(2, 0x300000001, b"txn-bytes")
+        + zab_packet(3, 0x300000001)
+        + zab_packet(4, 0x300000001)
+    )
+    hint = p(stream, "follower", "leader")
+    parts = hint.split(";")
+    assert parts[0] == "zab:followerinfo:zxid=0x0:dlen=11"
+    assert parts[1] == "zab:proposal:zxid=0x300000001:dlen=9"
+    assert parts[2] == "zab:ack:zxid=0x300000001:dlen=0"
+    assert parts[3] == "zab:commit:zxid=0x300000001:dlen=0"
+
+
+def test_zab_ping_suppressed():
+    p = ZkStreamParser("zab")
+    assert p(zab_packet(5, 0x1), "f", "l") is None
+    # ping mixed with a real packet: real packet's hint survives
+    hint = p(zab_packet(5, 0x2) + zab_packet(4, 0x5), "f", "l")
+    assert hint == "zab:commit:zxid=0x5:dlen=0"
+
+
+def test_zab_ping_kept_when_not_ignored():
+    p = ZkStreamParser("zab", ignore_pings=False)
+    assert p(zab_packet(5, 0x1), "f", "l") == "ping"
+
+
+def test_zab_35_reconfig_types():
+    p = ZkStreamParser("zab")
+    hint = p(zab_packet(9, 0x7) + zab_packet(19, 0x8, b"cfg"), "l", "f")
+    assert hint == ("zab:commitandactivate:zxid=0x7:dlen=0;"
+                    "zab:informandactivate:zxid=0x8:dlen=3")
+
+
+def test_concurrent_connections_do_not_share_buffers():
+    """Two simultaneous connections on one link (same entities) parse
+    independently — interleaved chunks must not desync each other."""
+    p = ZkStreamParser("fle")
+    n1 = fle_notification(0, 1, 0x1, 1, 1)
+    n2 = fle_notification(0, 2, 0x2, 2, 2)
+    # conn 1 handshake, then conn 2 handshake, then interleaved halves
+    assert p(struct.pack(">q", 1), "a", "b", 1) == "fle:init:sid=1"
+    assert p(struct.pack(">q", 2), "a", "b", 2) == "fle:init:sid=2"
+    assert p(n1[:15], "a", "b", 1) == ""
+    assert p(n2[:20], "a", "b", 2) == ""
+    h1 = p(n1[15:], "a", "b", 1)
+    h2 = p(n2[20:], "a", "b", 2)
+    assert "leader=1:zxid=0x1" in h1
+    assert "leader=2:zxid=0x2" in h2
+
+
+def test_zab_authinfo_parsed():
+    p = ZkStreamParser("zab")
+    pkt = zab_packet(1, 0x9, b"req", auth=[(b"digest", b"user:pass")])
+    assert p(pkt, "f", "l") == "zab:request:zxid=0x9:dlen=3"
+
+
+def test_zab_split_mid_header():
+    p = ZkStreamParser("zab")
+    pkt = zab_packet(2, 0x42, b"payload")
+    assert p(pkt[:7], "f", "l") == ""
+    assert p(pkt[7:], "f", "l") == "zab:proposal:zxid=0x42:dlen=7"
+
+
+# -- client protocol ---------------------------------------------------------
+
+
+def test_client_session_and_paths():
+    p = ZkStreamParser("client")
+    hint = p(connect_request(0x77), "cli", "srv")
+    assert hint == "cm:connect:lastZxid=0x77"
+    hint = p(request(1, 1, "/locks/n1") + request(2, 4, "/data"), "cli", "srv")
+    assert hint == "cm:create:/locks/n1;cm:getData:/data"
+    # server direction (seen second) parses responses
+    conn_resp = client_frame(struct.pack(">iiq", 0, 30000, 0x55)
+                             + struct.pack(">i", 16) + b"\x00" * 16)
+    assert p(conn_resp, "srv", "cli") == "sm:connect"
+    assert p(response(1, 0x80), "srv", "cli") == "sm:reply:zxid=0x80:err=0"
+    assert p(response(-1, 0x81), "srv", "cli") == "sm:notification:zxid=0x81"
+
+
+def test_client_ping_suppressed():
+    p = ZkStreamParser("client")
+    p(connect_request(), "cli", "srv")
+    assert p(request(-2, 11), "cli", "srv") is None
+
+
+def test_four_letter_word():
+    p = ZkStreamParser("client")
+    assert p(b"ruok", "cli", "srv") == "cm:4lw:ruok"
+
+
+def test_hints_stable_across_instances():
+    """Same semantic stream => same hints (the determinism the replay /
+    TPU hint->delay tables rely on)."""
+    stream = struct.pack(">q", 3) + fle_notification(0, 3, 0x1, 2, 2)
+    h1 = ZkStreamParser("fle")(stream, "a", "b")
+    h2 = ZkStreamParser("fle")(stream, "a", "b")
+    assert h1 == h2
+
+
+def test_port_dispatch():
+    assert zk_parser_for_port(3888).protocol == "fle"
+    assert zk_parser_for_port(13888).protocol == "fle"
+    assert zk_parser_for_port(2888).protocol == "zab"
+    assert zk_parser_for_port(2181).protocol == "client"
+
+
+# -- integration through the proxy inspector ---------------------------------
+
+
+class _Accepting:
+    """Transceiver stub: immediately accept every event."""
+
+    def start(self):
+        pass
+
+    def send_event(self, event):
+        import queue
+
+        from namazu_tpu.signal.action import EventAcceptanceAction
+
+        ch = queue.Queue()
+        ch.put(EventAcceptanceAction.for_event(event))
+        self.last_event = event
+        return ch
+
+    def forget(self, event):
+        pass
+
+
+def test_proxy_link_with_zk_parser():
+    """FLE bytes through a real proxied socket produce semantic hints."""
+    upstream = socket.socket()
+    upstream.bind(("127.0.0.1", 0))
+    upstream.listen(1)
+    up_port = upstream.getsockname()[1]
+
+    trans = _Accepting()
+    insp = EthernetProxyInspector(trans, parser=ZkStreamParser("fle"))
+    link = insp.add_link("127.0.0.1:0", f"127.0.0.1:{up_port}", "zk1", "zk2")
+    insp.start()
+    try:
+        cli = socket.create_connection(("127.0.0.1", link.port), timeout=5)
+        srv, _ = upstream.accept()
+        payload = struct.pack(">q", 1) + fle_notification(0, 1, 0x5, 1, 1)
+        cli.sendall(payload)
+        got = b""
+        srv.settimeout(5)
+        while len(got) < len(payload):
+            got += srv.recv(4096)
+        assert got == payload  # forwarded verbatim after acceptance
+        ev = trans.last_event
+        assert "fle:notif:state=looking:leader=1" in ev.replay_hint()
+        cli.close()
+        srv.close()
+    finally:
+        insp.stop()
+        upstream.close()
