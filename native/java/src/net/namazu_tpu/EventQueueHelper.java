@@ -1,0 +1,27 @@
+// Byteman helper for namazu_tpu: bind these static methods from .btm
+// rules to defer JVM function calls/returns through the orchestrator.
+//
+// Capability parity with the reference's PBEQHelper
+// (/root/reference/misc/inspector/java/base/src/net/osrg/namazu/
+// PBEQHelper.java:8-65). Example rule:
+//
+//   RULE inspect FooServer.processRequest entry
+//   CLASS com.example.FooServer
+//   METHOD processRequest
+//   HELPER net.namazu_tpu.EventQueueHelper
+//   AT ENTRY
+//   IF TRUE
+//   DO eventFuncCall("processRequest")
+//   ENDRULE
+
+package net.namazu_tpu;
+
+public class EventQueueHelper {
+    public static void eventFuncCall(String funcName) {
+        NmzAgent.getInstance().eventFunc(funcName, "call");
+    }
+
+    public static void eventFuncReturn(String funcName) {
+        NmzAgent.getInstance().eventFunc(funcName, "return");
+    }
+}

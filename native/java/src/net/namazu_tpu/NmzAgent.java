@@ -1,0 +1,255 @@
+// Java guest agent for the namazu_tpu orchestrator.
+//
+// Capability parity with the reference's JVM inspector
+// (/root/reference/misc/inspector/java/base/src/net/osrg/namazu/
+// PBInspector.java:19-120): intercepted function calls/returns are sent to
+// the orchestrator and the calling thread parks until the corresponding
+// action frame arrives. Redesign: instead of generated protobuf stubs the
+// wire format is the framework-wide ``uint32-LE length + UTF-8 JSON``
+// framing of namazu_tpu/endpoint/agent.py, so this file has zero
+// dependencies beyond the JDK.
+//
+// Environment (same contract as the C++ agent, native/agent/nmz_agent.h):
+//   NMZ_TPU_AGENT_ADDR  host:port of the agent endpoint (default
+//                       127.0.0.1:10081)
+//   NMZ_TPU_ENTITY_ID   entity id (default "_nmz_java_agent")
+//   NMZ_TPU_DISABLE     set to any value to no-op every hook
+
+package net.namazu_tpu;
+
+import java.io.DataInputStream;
+import java.io.IOException;
+import java.io.OutputStream;
+import java.net.Socket;
+import java.nio.charset.StandardCharsets;
+import java.util.Map;
+import java.util.UUID;
+import java.util.concurrent.ConcurrentHashMap;
+import java.util.concurrent.SynchronousQueue;
+
+public final class NmzAgent {
+    private static NmzAgent instance;
+
+    private final String entityId;
+    private Socket socket;
+    private OutputStream out;
+    private final Object sendLock = new Object();
+    private final Map<String, SynchronousQueue<String>> waiting =
+            new ConcurrentHashMap<String, SynchronousQueue<String>>();
+    private final boolean disabled;
+
+    public static synchronized NmzAgent getInstance() {
+        if (instance == null) {
+            instance = new NmzAgent();
+        }
+        return instance;
+    }
+
+    private NmzAgent() {
+        this.disabled = System.getenv("NMZ_TPU_DISABLE") != null;
+        String entity = System.getenv("NMZ_TPU_ENTITY_ID");
+        this.entityId = entity != null ? entity : "_nmz_java_agent";
+        if (disabled) {
+            return;
+        }
+        String addr = System.getenv("NMZ_TPU_AGENT_ADDR");
+        if (addr == null) {
+            addr = "127.0.0.1:10081";
+        }
+        int colon = addr.lastIndexOf(':');
+        String host = colon > 0 ? addr.substring(0, colon) : "127.0.0.1";
+        int port = Integer.parseInt(addr.substring(colon + 1));
+        try {
+            socket = new Socket(host, port);
+            socket.setTcpNoDelay(true);
+            out = socket.getOutputStream();
+            Thread reader = new Thread(new Runnable() {
+                public void run() {
+                    readLoop();
+                }
+            }, "nmz-agent-reader");
+            reader.setDaemon(true);
+            reader.start();
+        } catch (IOException e) {
+            throw new RuntimeException(
+                    "nmz agent: cannot reach orchestrator at " + addr, e);
+        }
+    }
+
+    /**
+     * Send a FunctionEvent and park the calling thread until the
+     * orchestrator's action releases it. funcType is "call" or "return".
+     * Returns the action's class name (e.g. "EventAcceptanceAction").
+     */
+    public String eventFunc(String funcName, String funcType) {
+        if (disabled || socket == null) {
+            return "NopAction";
+        }
+        String uuid = UUID.randomUUID().toString();
+        SynchronousQueue<String> q = new SynchronousQueue<String>();
+        waiting.put(uuid, q);
+        StringBuilder sb = new StringBuilder(256);
+        sb.append("{\"type\":\"event\",\"class\":\"FunctionEvent\"");
+        sb.append(",\"entity\":").append(quote(entityId));
+        sb.append(",\"uuid\":").append(quote(uuid));
+        sb.append(",\"option\":{\"func_name\":").append(quote(funcName));
+        sb.append(",\"func_type\":").append(quote(funcType));
+        sb.append(",\"runtime\":\"java\"");
+        sb.append(",\"thread_name\":")
+          .append(quote(Thread.currentThread().getName()));
+        sb.append("}}");
+        try {
+            writeFrame(sb.toString());
+            return q.take(); // park until the reader hands us the action
+        } catch (IOException e) {
+            waiting.remove(uuid);
+            return "NopAction"; // orchestrator gone: release the thread
+        } catch (InterruptedException e) {
+            waiting.remove(uuid);
+            Thread.currentThread().interrupt();
+            return "NopAction";
+        }
+    }
+
+    private void writeFrame(String json) throws IOException {
+        byte[] body = json.getBytes(StandardCharsets.UTF_8);
+        byte[] frame = new byte[4 + body.length];
+        // uint32 little-endian length prefix
+        frame[0] = (byte) (body.length & 0xFF);
+        frame[1] = (byte) ((body.length >> 8) & 0xFF);
+        frame[2] = (byte) ((body.length >> 16) & 0xFF);
+        frame[3] = (byte) ((body.length >> 24) & 0xFF);
+        System.arraycopy(body, 0, frame, 4, body.length);
+        synchronized (sendLock) {
+            out.write(frame); // single write: one frame per segment
+            out.flush();
+        }
+    }
+
+    private void readLoop() {
+        try {
+            DataInputStream in = new DataInputStream(socket.getInputStream());
+            byte[] header = new byte[4];
+            while (true) {
+                in.readFully(header);
+                int length = (header[0] & 0xFF)
+                        | ((header[1] & 0xFF) << 8)
+                        | ((header[2] & 0xFF) << 16)
+                        | ((header[3] & 0xFF) << 24);
+                if (length < 0 || length > 16 * 1024 * 1024) {
+                    throw new IOException("bad frame length " + length);
+                }
+                byte[] body = new byte[length];
+                in.readFully(body);
+                String json = new String(body, StandardCharsets.UTF_8);
+                String eventUuid = extractString(json, "event_uuid");
+                if (eventUuid == null) {
+                    continue; // not an event-answering action
+                }
+                SynchronousQueue<String> q = waiting.remove(eventUuid);
+                if (q != null) {
+                    String klass = extractString(json, "class");
+                    q.put(klass != null ? klass : "NopAction");
+                }
+            }
+        } catch (IOException e) {
+            releaseAll();
+        } catch (InterruptedException e) {
+            releaseAll();
+            Thread.currentThread().interrupt();
+        }
+    }
+
+    private void releaseAll() {
+        // connection lost: unblock every parked thread so the testee can
+        // proceed (parity with the reference's fail-open behaviour)
+        for (Map.Entry<String, SynchronousQueue<String>> e
+                : waiting.entrySet()) {
+            waiting.remove(e.getKey());
+            try {
+                e.getValue().put("NopAction");
+            } catch (InterruptedException ie) {
+                Thread.currentThread().interrupt();
+                return;
+            }
+        }
+    }
+
+    /** Minimal JSON string-field extractor: finds "key":"value" at any
+     *  nesting level. Safe here because the orchestrator emits flat,
+     *  known-shape action dicts and values never embed escaped quotes
+     *  except via backslash escapes, which are handled. */
+    static String extractString(String json, String key) {
+        String needle = "\"" + key + "\"";
+        int i = json.indexOf(needle);
+        if (i < 0) {
+            return null;
+        }
+        i = json.indexOf(':', i + needle.length());
+        if (i < 0) {
+            return null;
+        }
+        i++;
+        while (i < json.length()
+                && Character.isWhitespace(json.charAt(i))) {
+            i++;
+        }
+        if (i >= json.length() || json.charAt(i) != '"') {
+            return null;
+        }
+        StringBuilder sb = new StringBuilder();
+        i++;
+        while (i < json.length()) {
+            char c = json.charAt(i);
+            if (c == '\\' && i + 1 < json.length()) {
+                char n = json.charAt(i + 1);
+                switch (n) {
+                    case 'n': sb.append('\n'); break;
+                    case 't': sb.append('\t'); break;
+                    case 'r': sb.append('\r'); break;
+                    case 'b': sb.append('\b'); break;
+                    case 'f': sb.append('\f'); break;
+                    case 'u':
+                        if (i + 5 < json.length()) {
+                            sb.append((char) Integer.parseInt(
+                                    json.substring(i + 2, i + 6), 16));
+                            i += 4;
+                        }
+                        break;
+                    default: sb.append(n);
+                }
+                i += 2;
+                continue;
+            }
+            if (c == '"') {
+                return sb.toString();
+            }
+            sb.append(c);
+            i++;
+        }
+        return null;
+    }
+
+    static String quote(String s) {
+        StringBuilder sb = new StringBuilder(s.length() + 2);
+        sb.append('"');
+        for (int i = 0; i < s.length(); i++) {
+            char c = s.charAt(i);
+            switch (c) {
+                case '"': sb.append("\\\""); break;
+                case '\\': sb.append("\\\\"); break;
+                case '\n': sb.append("\\n"); break;
+                case '\r': sb.append("\\r"); break;
+                case '\t': sb.append("\\t"); break;
+                default:
+                    if (c < 0x20) {
+                        sb.append(String.format("\\u%04x", (int) c));
+                    } else {
+                        sb.append(c);
+                    }
+            }
+        }
+        sb.append('"');
+        return sb.toString();
+    }
+}
