@@ -38,41 +38,22 @@ class BestSchedule(NamedTuple):
     fitness: float
 
 
-class ScheduleSearch:
-    def __init__(self, cfg: SearchConfig = SearchConfig(),
-                 mesh=None, n_devices: Optional[int] = None):
-        import jax
+class SearchBase:
+    """Shared host-side state of every search backend: the precedence-pair
+    sample, the novelty/failure feature archives (ring buffers), and the
+    backend-tagged ``.npz`` checkpoint format."""
 
-        from namazu_tpu.parallel.islands import (
-            init_island_state,
-            make_island_step,
-        )
-        from namazu_tpu.parallel.mesh import make_mesh
+    BACKEND = "base"
 
+    def __init__(self, cfg: SearchConfig):
         self.cfg = cfg
-        self.mesh = mesh if mesh is not None else make_mesh(n_devices)
-        n_islands = self.mesh.shape["i"]
-        # population must divide evenly across islands
-        per_island = max(1, cfg.population // n_islands)
-        self.population = per_island * n_islands
-
         self.pairs = te.sample_pairs(cfg.K, cfg.H, cfg.seed)
         # neutral (0.5) features = "no information"; rings overwrite oldest
         self.archive = np.full((cfg.archive_size, cfg.K), 0.5, np.float32)
         self._archive_n = 0
         self.failures = np.full((cfg.failure_size, cfg.K), 0.5, np.float32)
         self._failure_n = 0
-
-        self._key = jax.random.PRNGKey(cfg.seed)
-        self._step = make_island_step(
-            self.mesh, cfg.ga, cfg.weights, migrate_k=cfg.migrate_k
-        )
-        self._state = init_island_state(
-            jax.random.PRNGKey(cfg.seed + 1), self.population, cfg.H, cfg.ga
-        )
         self.generations_run = 0
-
-    # -- archives --------------------------------------------------------
 
     def _feats_of(self, encoded: te.EncodedTrace) -> np.ndarray:
         import jax.numpy as jnp
@@ -102,11 +83,9 @@ class ScheduleSearch:
         )
         self._failure_n += 1
 
-    # -- search ----------------------------------------------------------
-
-    def run(self, encoded, generations: int = 50) -> BestSchedule:
-        """Evolve against one or more reference traces for N generations;
-        returns the best schedule seen so far (monotonic across calls)."""
+    def _device_inputs(self, encoded):
+        """(traces, pairs, archive, failures) as device arrays, from one
+        encoded trace or a list of them."""
         import jax.numpy as jnp
 
         from namazu_tpu.ops.schedule import TraceArrays
@@ -114,9 +93,89 @@ class ScheduleSearch:
         encs = encoded if isinstance(encoded, (list, tuple)) else [encoded]
         h, _, a, m = te.stack_traces(encs)
         trace = TraceArrays(jnp.asarray(h), jnp.asarray(a), jnp.asarray(m))
-        pairs = jnp.asarray(self.pairs)
-        archive = jnp.asarray(self.archive)
-        failures = jnp.asarray(self.failures)
+        return encs, trace, jnp.asarray(self.pairs), \
+            jnp.asarray(self.archive), jnp.asarray(self.failures)
+
+    # -- persistence -----------------------------------------------------
+
+    def _state_dict(self) -> dict:
+        raise NotImplementedError
+
+    def _restore_state(self, z) -> None:
+        raise NotImplementedError
+
+    def save(self, path: str) -> None:
+        import jax
+
+        flat = {
+            "backend": np.asarray(self.BACKEND),
+            "archive": self.archive,
+            "archive_n": np.asarray(self._archive_n),
+            "failures": self.failures,
+            "failure_n": np.asarray(self._failure_n),
+            "key": np.asarray(jax.random.key_data(self._key)),
+            "generations_run": np.asarray(self.generations_run),
+        }
+        flat.update(self._state_dict())
+        tmp = path + ".tmp.npz"
+        np.savez(tmp, **flat)
+        os.replace(tmp, path)
+
+    def load(self, path: str) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        with np.load(path) as z:
+            # pre-backend-tag checkpoints (GA only) have no "backend" key
+            saved = str(z["backend"]) if "backend" in z else "ga"
+            if saved != self.BACKEND:
+                raise ValueError(
+                    f"checkpoint {path} was written by the {saved!r} "
+                    f"backend, not {self.BACKEND!r}"
+                )
+            self.archive = z["archive"]
+            self._archive_n = int(z["archive_n"])
+            self.failures = z["failures"]
+            self._failure_n = int(z["failure_n"])
+            self._key = jax.random.wrap_key_data(jnp.asarray(z["key"]))
+            self.generations_run = int(z["generations_run"])
+            self._restore_state(z)
+
+
+class ScheduleSearch(SearchBase):
+    BACKEND = "ga"
+
+    def __init__(self, cfg: SearchConfig = SearchConfig(),
+                 mesh=None, n_devices: Optional[int] = None):
+        import jax
+
+        from namazu_tpu.parallel.islands import (
+            init_island_state,
+            make_island_step,
+        )
+        from namazu_tpu.parallel.mesh import make_mesh
+
+        super().__init__(cfg)
+        self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        n_islands = self.mesh.shape["i"]
+        # population must divide evenly across islands
+        per_island = max(1, cfg.population // n_islands)
+        self.population = per_island * n_islands
+
+        self._key = jax.random.PRNGKey(cfg.seed)
+        self._step = make_island_step(
+            self.mesh, cfg.ga, cfg.weights, migrate_k=cfg.migrate_k
+        )
+        self._state = init_island_state(
+            jax.random.PRNGKey(cfg.seed + 1), self.population, cfg.H, cfg.ga
+        )
+
+    # -- search ----------------------------------------------------------
+
+    def run(self, encoded, generations: int = 50) -> BestSchedule:
+        """Evolve against one or more reference traces for N generations;
+        returns the best schedule seen so far (monotonic across calls)."""
+        _encs, trace, pairs, archive, failures = self._device_inputs(encoded)
         state = self._state
         for _ in range(generations):
             state = self._step(state, self._key, trace, pairs, archive,
@@ -135,48 +194,115 @@ class ScheduleSearch:
 
     # -- persistence -----------------------------------------------------
 
-    def save(self, path: str) -> None:
-        import jax
-
-        flat = {
+    def _state_dict(self) -> dict:
+        return {
             "pop_delays": np.asarray(self._state.pop.delays),
             "pop_faults": np.asarray(self._state.pop.faults),
             "gen": np.asarray(self._state.gen),
             "best_fitness": np.asarray(self._state.best_fitness),
             "best_delays": np.asarray(self._state.best_delays),
             "best_faults": np.asarray(self._state.best_faults),
-            "archive": self.archive,
-            "archive_n": np.asarray(self._archive_n),
-            "failures": self.failures,
-            "failure_n": np.asarray(self._failure_n),
-            "key": np.asarray(jax.random.key_data(self._key)),
-            "generations_run": np.asarray(self.generations_run),
         }
-        tmp = path + ".tmp"
-        np.savez(tmp, **flat)
-        os.replace(tmp + ".npz" if not tmp.endswith(".npz") else tmp, path)
 
-    def load(self, path: str) -> None:
-        import jax
+    def _restore_state(self, z) -> None:
         import jax.numpy as jnp
 
         from namazu_tpu.parallel.islands import IslandState
         from namazu_tpu.models.ga import Population
 
-        with np.load(path) as z:
-            self._state = IslandState(
-                pop=Population(
-                    delays=jnp.asarray(z["pop_delays"]),
-                    faults=jnp.asarray(z["pop_faults"]),
-                ),
-                gen=jnp.asarray(z["gen"]),
-                best_fitness=jnp.asarray(z["best_fitness"]),
-                best_delays=jnp.asarray(z["best_delays"]),
-                best_faults=jnp.asarray(z["best_faults"]),
-            )
-            self.archive = z["archive"]
-            self._archive_n = int(z["archive_n"])
-            self.failures = z["failures"]
-            self._failure_n = int(z["failure_n"])
-            self._key = jax.random.wrap_key_data(jnp.asarray(z["key"]))
-            self.generations_run = int(z["generations_run"])
+        self._state = IslandState(
+            pop=Population(
+                delays=jnp.asarray(z["pop_delays"]),
+                faults=jnp.asarray(z["pop_faults"]),
+            ),
+            gen=jnp.asarray(z["gen"]),
+            best_fitness=jnp.asarray(z["best_fitness"]),
+            best_delays=jnp.asarray(z["best_delays"]),
+            best_faults=jnp.asarray(z["best_faults"]),
+        )
+
+
+class MCTSSearch(SearchBase):
+    """Config-5 backend: root-parallel MCTS (models/mcts.py) behind the
+    same driver API as :class:`ScheduleSearch`, so ``policy/tpu.py`` can
+    swap backends with one config key (``search_backend = "mcts"``)."""
+
+    BACKEND = "mcts"
+
+    def __init__(self, cfg: SearchConfig = SearchConfig(), mcts_cfg=None,
+                 mesh=None, n_devices: Optional[int] = None):
+        import jax
+
+        from namazu_tpu.models.mcts import MCTSConfig, make_parallel_mcts
+        from namazu_tpu.parallel.mesh import make_mesh
+
+        super().__init__(cfg)
+        self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        self.mcts_cfg = mcts_cfg if mcts_cfg is not None else MCTSConfig(
+            max_delay=cfg.ga.max_delay, max_fault=cfg.ga.max_fault
+        )
+        if self.mcts_cfg.tree_depth > cfg.H:
+            # the tree cannot decide more buckets than the genome has
+            self.mcts_cfg = self.mcts_cfg._replace(tree_depth=cfg.H)
+        self._run = make_parallel_mcts(self.mesh, cfg.H, self.mcts_cfg,
+                                       cfg.weights)
+        self._key = jax.random.PRNGKey(cfg.seed)
+        self._best_fitness = float("-inf")
+        self._best_delays = np.zeros((cfg.H,), np.float32)
+        self._best_faults = np.zeros((cfg.H,), np.float32)
+
+    def _hint_order(self, encs) -> np.ndarray:
+        """Bucket ids ordered by frequency across the reference traces —
+        the tree decides the most-often-hit buckets first."""
+        counts = np.zeros((self.cfg.H,), np.int64)
+        for e in encs:
+            counts += np.bincount(e.hint_ids[e.mask],
+                                  minlength=self.cfg.H)
+        return np.argsort(-counts)[: self.mcts_cfg.tree_depth].astype(
+            np.int32
+        )
+
+    def run(self, encoded, generations: int = 1) -> BestSchedule:
+        """Run ``max(1, generations // 64)`` independent tree searches of
+        ``mcts_cfg.simulations`` expansions each (the GA's ``generations``
+        knob maps onto simulation budget so configs stay comparable);
+        returns the best schedule seen so far (monotonic across calls)."""
+        import jax
+        import jax.numpy as jnp
+
+        encs, trace, pairs, archive, failures = self._device_inputs(encoded)
+        hint_order = jnp.asarray(self._hint_order(encs))
+
+        searches = max(1, generations // 64)
+        for _ in range(searches):
+            self._key, sub = jax.random.split(self._key)
+            fit, d, f = self._run(sub, trace, pairs, archive, failures,
+                                  hint_order)
+            fit = float(fit)
+            if fit > self._best_fitness:
+                self._best_fitness = fit
+                self._best_delays = np.asarray(d)
+                self._best_faults = np.asarray(f)
+        self.generations_run += searches * self.mcts_cfg.simulations
+        return self.best()
+
+    def best(self) -> BestSchedule:
+        return BestSchedule(
+            delays=self._best_delays,
+            faults=self._best_faults,
+            fitness=self._best_fitness,
+        )
+
+    # -- persistence -----------------------------------------------------
+
+    def _state_dict(self) -> dict:
+        return {
+            "best_fitness": np.asarray(self._best_fitness, np.float32),
+            "best_delays": self._best_delays,
+            "best_faults": self._best_faults,
+        }
+
+    def _restore_state(self, z) -> None:
+        self._best_fitness = float(z["best_fitness"])
+        self._best_delays = z["best_delays"]
+        self._best_faults = z["best_faults"]

@@ -54,6 +54,11 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.checkpoint_path = ""
         self.search_on_start = True
         self.max_fault = 0.0
+        self.search_backend = "ga"  # "ga" (island GA) | "mcts" (config 5)
+        self.mcts_simulations = 256
+        self.mcts_tree_depth = 24
+        self.mcts_levels = 8
+        self.mcts_rollouts = 64
         self.proc_policy_name = "mild"
         import random as _random
 
@@ -84,6 +89,21 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.checkpoint_path = str(p("checkpoint", "") or "")
         self.search_on_start = bool(p("search_on_start", True))
         self.max_fault = float(p("max_fault", 0.0))
+        self.search_backend = str(p("search_backend", self.search_backend))
+        if self.search_backend not in ("ga", "mcts"):
+            # fail fast: an exception inside the background search thread
+            # would be logged-and-swallowed, silently degrading to hash
+            # delays for the whole experiment
+            raise ValueError(
+                f"unknown search_backend {self.search_backend!r} "
+                "(expected 'ga' or 'mcts')"
+            )
+        self.mcts_simulations = int(p("mcts_simulations",
+                                      self.mcts_simulations))
+        self.mcts_tree_depth = int(p("mcts_tree_depth",
+                                     self.mcts_tree_depth))
+        self.mcts_levels = int(p("mcts_levels", self.mcts_levels))
+        self.mcts_rollouts = int(p("mcts_rollouts", self.mcts_rollouts))
         name = str(p("proc_policy", self.proc_policy_name))
         self.proc_policy_name = name
         self._proc_policy = create_proc_subpolicy(name, self._rng)
@@ -135,7 +155,11 @@ class TPUSearchPolicy(QueueBackedPolicy):
 
     def _build_search(self):
         from namazu_tpu.models.ga import GAConfig
-        from namazu_tpu.models.search import ScheduleSearch, SearchConfig
+        from namazu_tpu.models.search import (
+            MCTSSearch,
+            ScheduleSearch,
+            SearchConfig,
+        )
 
         cfg = SearchConfig(
             H=self.H, L=self.L, K=self.K,
@@ -145,6 +169,24 @@ class TPUSearchPolicy(QueueBackedPolicy):
             ga=GAConfig(max_delay=self.max_interval,
                         max_fault=self.max_fault),
         )
+        if self.search_backend == "mcts":
+            from namazu_tpu.models.mcts import MCTSConfig
+
+            mcts_cfg = MCTSConfig(
+                tree_depth=self.mcts_tree_depth,
+                n_levels=self.mcts_levels,
+                simulations=self.mcts_simulations,
+                rollouts=self.mcts_rollouts,
+                max_delay=self.max_interval,
+                max_fault=self.max_fault,
+            )
+            return MCTSSearch(cfg, mcts_cfg=mcts_cfg,
+                              n_devices=self.n_devices)
+        if self.search_backend != "ga":
+            raise ValueError(
+                f"unknown search_backend {self.search_backend!r} "
+                "(expected 'ga' or 'mcts')"
+            )
         return ScheduleSearch(cfg, n_devices=self.n_devices)
 
     def _search_once(self) -> None:

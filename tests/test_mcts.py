@@ -1,0 +1,252 @@
+"""MCTS search backend (config 5) on the virtual 8-device CPU mesh.
+
+Covers: jittable single-tree search (determinism, tree invariants, pinned
+prefixes), targeted improvement over random rollouts, root-parallel
+shard_map variant, the MCTSSearch driver (hint ordering, monotonic best,
+checkpoint round-trip), and the tpu_search policy's backend switch.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from namazu_tpu.models.mcts import (
+    MCTSConfig,
+    init_tree,
+    make_parallel_mcts,
+    mcts_search_jit,
+)
+from namazu_tpu.models.search import MCTSSearch, SearchConfig
+from namazu_tpu.ops import trace_encoding as te
+from namazu_tpu.ops.schedule import (
+    ScoreWeights,
+    TraceArrays,
+    schedule_features,
+    score_population_multi,
+)
+from namazu_tpu.parallel.mesh import make_mesh
+
+H, L, K = 32, 64, 64
+CFG = MCTSConfig(tree_depth=6, n_levels=4, simulations=48, rollouts=16,
+                 max_delay=0.05)
+
+
+def toy_inputs(n=48, n_hints=12, seed=0):
+    enc = te.encode_event_stream(
+        [f"hint{i % n_hints}" for i in range(n)],
+        arrivals=[i * 0.001 for i in range(n)],
+        L=L, H=H,
+    )
+    trace = TraceArrays(
+        jnp.asarray(enc.hint_ids)[None],
+        jnp.asarray(enc.arrival)[None],
+        jnp.asarray(enc.mask)[None],
+    )
+    pairs = jnp.asarray(te.sample_pairs(K, H, seed))
+    archive = jnp.full((16, K), 0.5, jnp.float32)
+    failures = jnp.full((4, K), 0.5, jnp.float32)
+    counts = np.bincount(enc.hint_ids[enc.mask], minlength=H)
+    order = jnp.asarray(np.argsort(-counts)[: CFG.tree_depth].astype(
+        np.int32))
+    return enc, trace, pairs, archive, failures, order
+
+
+def run_search(key, cfg=CFG, **over):
+    enc, trace, pairs, archive, failures, order = toy_inputs()
+    failures = over.pop("failures", failures)
+    res = mcts_search_jit(key, trace, pairs, archive, failures, order, H,
+                          cfg)
+    return res
+
+
+def test_search_runs_and_is_bounded():
+    res = run_search(jax.random.PRNGKey(0))
+    assert np.isfinite(float(res.best_fitness))
+    d = np.asarray(res.best_delays)
+    assert d.shape == (H,)
+    assert (d >= 0).all() and (d <= CFG.max_delay + 1e-6).all()
+    # delay-only config: faults stay at zero
+    assert float(np.abs(np.asarray(res.best_faults)).max()) == 0.0
+
+
+def test_search_deterministic():
+    a = run_search(jax.random.PRNGKey(7))
+    b = run_search(jax.random.PRNGKey(7))
+    assert float(a.best_fitness) == float(b.best_fitness)
+    np.testing.assert_array_equal(np.asarray(a.best_delays),
+                                  np.asarray(b.best_delays))
+    c = run_search(jax.random.PRNGKey(8))
+    assert not np.array_equal(np.asarray(a.best_delays),
+                              np.asarray(c.best_delays))
+
+
+def test_tree_invariants():
+    res = run_search(jax.random.PRNGKey(1))
+    visits = np.asarray(res.tree_visits)
+    # the root is updated by every simulation's backprop
+    assert visits[0] == CFG.simulations
+    # every allocated node was visited at least once, and no node more
+    # often than the root
+    assert (visits <= visits[0]).all()
+    # root children visits sum to at most the root's (terminal-at-root
+    # cannot happen with tree_depth > 0)
+    rc = np.asarray(res.root_child_visits)
+    assert rc.sum() == CFG.simulations
+
+
+def test_mcts_finds_bug_affine_schedule():
+    """Plant a 'bug' at the features of a known delay table; MCTS must end
+    up closer to it than a random schedule population's mean."""
+    enc, trace, pairs, archive, _neutral, order = toy_inputs()
+    target_delays = jnp.zeros((H,), jnp.float32).at[
+        jnp.asarray(order)].set(CFG.max_delay)
+    tr_single = TraceArrays(trace.hint_ids[0], trace.arrival[0],
+                            trace.mask[0])
+    target_feat = schedule_features(target_delays, tr_single, pairs,
+                                    ScoreWeights().tau)
+    failures = jnp.tile(target_feat[None], (4, 1))
+
+    res = mcts_search_jit(jax.random.PRNGKey(3), trace, pairs, archive,
+                          failures, order, H, CFG)
+
+    rand = jax.random.uniform(jax.random.PRNGKey(4), (256, H),
+                              jnp.float32, 0.0, CFG.max_delay)
+    rand_fit, _ = score_population_multi(rand, trace, pairs, archive,
+                                         failures)
+    assert float(res.best_fitness) > float(rand_fit.mean())
+
+
+def test_parallel_mcts_on_mesh():
+    mesh = make_mesh(8)
+    enc, trace, pairs, archive, failures, order = toy_inputs()
+    run = make_parallel_mcts(mesh, H, CFG)
+    fit, d, f = run(jax.random.PRNGKey(0), trace, pairs, archive,
+                    failures, order)
+    assert np.isfinite(float(fit))
+    assert np.asarray(d).shape == (H,)
+    # parallel best is at least as good as one single-device tree with the
+    # same folded key (device 0 runs exactly fold_in(key, 0))
+    solo = mcts_search_jit(
+        jax.random.fold_in(jax.random.PRNGKey(0), 0), trace, pairs,
+        archive, failures, order, H, CFG)
+    assert float(fit) >= float(solo.best_fitness) - 1e-6
+
+
+def test_init_tree_shapes():
+    t = init_tree(CFG)
+    assert t.children.shape == (CFG.simulations + 1, CFG.n_levels)
+    assert int(t.n_nodes) == 1
+
+
+# -- driver ------------------------------------------------------------
+
+
+def toy_encoded(n=40, n_hints=10):
+    return te.encode_event_stream(
+        [f"hint{i % n_hints}" for i in range(n)],
+        arrivals=[i * 0.001 for i in range(n)],
+        L=L, H=H,
+    )
+
+
+def search_cfg():
+    from namazu_tpu.models.ga import GAConfig
+
+    return SearchConfig(H=H, L=L, K=K, archive_size=16, failure_size=4,
+                        seed=5, ga=GAConfig(max_delay=0.05))
+
+
+def test_mcts_driver_monotonic_and_checkpoint(tmp_path):
+    enc = toy_encoded()
+    s = MCTSSearch(search_cfg(), mcts_cfg=CFG, n_devices=2)
+    s.add_executed_trace(enc)
+    s.add_failure_trace(enc)
+    best1 = s.run(enc, generations=64)
+    best2 = s.run([enc, enc], generations=64)
+    assert best2.fitness >= best1.fitness  # monotonic across calls
+    assert s.generations_run == 2 * CFG.simulations
+
+    path = str(tmp_path / "mcts.npz")
+    s.save(path)
+    s2 = MCTSSearch(search_cfg(), mcts_cfg=CFG, n_devices=2)
+    s2.load(path)
+    assert s2.best().fitness == best2.fitness
+    np.testing.assert_array_equal(s2.best().delays, best2.delays)
+    assert s2.generations_run == s.generations_run
+    # resumed search stays monotonic
+    best3 = s2.run(enc, generations=64)
+    assert best3.fitness >= best2.fitness
+
+
+def test_hint_order_prefers_frequent_buckets():
+    enc = toy_encoded(n=40, n_hints=4)  # only 4 distinct hints
+    s = MCTSSearch(search_cfg(), mcts_cfg=CFG, n_devices=1)
+    order = s._hint_order([enc])
+    assert order.shape == (CFG.tree_depth,)
+    counts = np.bincount(enc.hint_ids[enc.mask], minlength=H)
+    # the 4 hot buckets come first, in descending frequency
+    hot = set(np.nonzero(counts)[0].tolist())
+    assert set(order[: len(hot)].tolist()) == hot
+
+
+def test_policy_backend_switch():
+    from namazu_tpu.policy.base import create_policy
+
+    pol = create_policy("tpu_search")
+    cfg = _policy_config({
+        "search_backend": "mcts", "mcts_simulations": 8,
+        "mcts_tree_depth": 4, "mcts_levels": 3, "mcts_rollouts": 8,
+        "search_on_start": False, "hint_buckets": H, "trace_length": L,
+        "feature_pairs": K, "devices": 1,
+    })
+    pol.load_config(cfg)
+    s = pol._build_search()
+    assert isinstance(s, MCTSSearch)
+    assert s.mcts_cfg.simulations == 8
+
+    # a typo'd backend fails fast at config time, not in the background
+    # search thread where it would be logged-and-swallowed
+    pol2 = create_policy("tpu_search")
+    with pytest.raises(ValueError):
+        pol2.load_config(_policy_config({"search_backend": "bogus",
+                                         "search_on_start": False}))
+
+
+def test_tree_depth_clamped_to_hint_buckets():
+    from namazu_tpu.models.ga import GAConfig
+
+    cfg = SearchConfig(H=8, L=L, K=K, seed=0, ga=GAConfig(max_delay=0.05))
+    s = MCTSSearch(cfg, mcts_cfg=MCTSConfig(tree_depth=24, n_levels=3,
+                                            simulations=8, rollouts=4,
+                                            max_delay=0.05), n_devices=1)
+    assert s.mcts_cfg.tree_depth == 8
+    enc = te.encode_event_stream(
+        ["a", "b", "c", "a"], arrivals=[0.0, 0.001, 0.002, 0.003],
+        L=L, H=8)
+    best = s.run(enc, generations=1)  # must not shape-error
+    assert np.isfinite(best.fitness)
+
+
+def test_checkpoint_backend_mismatch_rejected(tmp_path):
+    from namazu_tpu.models.search import ScheduleSearch
+
+    s = MCTSSearch(search_cfg(), mcts_cfg=CFG, n_devices=1)
+    path = str(tmp_path / "ck.npz")
+    s.save(path)
+    ga = ScheduleSearch(search_cfg(), n_devices=1)
+    with pytest.raises(ValueError, match="mcts"):
+        ga.load(path)
+    ga.save(path)
+    with pytest.raises(ValueError, match="ga"):
+        MCTSSearch(search_cfg(), mcts_cfg=CFG, n_devices=1).load(path)
+
+
+def _policy_config(params):
+    from namazu_tpu.utils.config import Config
+
+    return Config({
+        "explore_policy": "tpu_search",
+        "explore_policy_param": params,
+    })
