@@ -276,23 +276,32 @@ def mcts_search_jit(key, trace, pairs, archive, failure_feats, hint_order,
 
 
 def make_parallel_mcts(mesh, H: int, cfg: MCTSConfig = MCTSConfig(),
-                       weights: ScoreWeights = ScoreWeights(),
-                       axis: str = "i"):
+                       weights: ScoreWeights = ScoreWeights()):
     """Root-parallel MCTS over a device mesh: each device grows an
     independent tree from a folded key (rollout batches keep the MXU busy
     per device), then the per-device bests are ``all_gather``-ed and the
     argmax is replicated — same collective shape as the GA islands'
-    global-best agreement (parallel/islands.py)."""
+    global-best agreement (parallel/islands.py). Works on flat (``i``) and
+    hybrid host x chip (``h x i``) meshes alike: the key is folded with
+    every mesh axis and the gather runs axis by axis."""
     from jax.sharding import PartitionSpec as P
 
+    axes = tuple(mesh.axis_names)
+
     def _local(key, trace, pairs, archive, failure_feats, hint_order):
-        idx = jax.lax.axis_index(axis)
-        res = mcts_search(jax.random.fold_in(key, idx), trace, pairs,
-                          archive, failure_feats, hint_order, H, cfg,
-                          weights)
-        all_fit = jax.lax.all_gather(res.best_fitness, axis)
-        all_d = jax.lax.all_gather(res.best_delays, axis)
-        all_f = jax.lax.all_gather(res.best_faults, axis)
+        for ax in axes:
+            key = jax.random.fold_in(key, jax.lax.axis_index(ax))
+        res = mcts_search(key, trace, pairs, archive, failure_feats,
+                          hint_order, H, cfg, weights)
+        all_fit, all_d, all_f = (res.best_fitness, res.best_delays,
+                                 res.best_faults)
+        for ax in reversed(axes):
+            all_fit = jax.lax.all_gather(all_fit, ax)
+            all_d = jax.lax.all_gather(all_d, ax)
+            all_f = jax.lax.all_gather(all_f, ax)
+        all_fit = all_fit.reshape(-1)
+        all_d = all_d.reshape(-1, all_d.shape[-1])
+        all_f = all_f.reshape(-1, all_f.shape[-1])
         g = jnp.argmax(all_fit)
         return all_fit[g], all_d[g], all_f[g]
 

@@ -157,15 +157,25 @@ class ScheduleSearch(SearchBase):
 
         super().__init__(cfg)
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
-        n_islands = self.mesh.shape["i"]
+        n_islands = 1
+        for s in self.mesh.shape.values():
+            n_islands *= s
         # population must divide evenly across islands
         per_island = max(1, cfg.population // n_islands)
         self.population = per_island * n_islands
 
         self._key = jax.random.PRNGKey(cfg.seed)
-        self._step = make_island_step(
-            self.mesh, cfg.ga, cfg.weights, migrate_k=cfg.migrate_k
-        )
+        if "h" in self.mesh.axis_names:
+            # hybrid host x chip mesh -> hierarchical ICI/DCN migration
+            from namazu_tpu.parallel.distributed import make_hier_island_step
+
+            self._step = make_hier_island_step(
+                self.mesh, cfg.ga, cfg.weights, migrate_k=cfg.migrate_k
+            )
+        else:
+            self._step = make_island_step(
+                self.mesh, cfg.ga, cfg.weights, migrate_k=cfg.migrate_k
+            )
         self._state = init_island_state(
             jax.random.PRNGKey(cfg.seed + 1), self.population, cfg.H, cfg.ga
         )

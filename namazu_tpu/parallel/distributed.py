@@ -1,0 +1,138 @@
+"""Multi-host (DCN) execution for the search plane.
+
+The reference's notion of "distributed" is one orchestrator plus N
+inspector processes over REST/TCP (SURVEY.md §2.9) — that control plane is
+host-side and already multi-process here. *This* module scales the search
+plane itself the TPU way: ``jax.distributed`` bootstraps one JAX process
+per host, the global device mesh gets two axes — ``h`` (hosts, DCN) and
+``i`` (chips within a host, ICI) — and the island GA becomes hierarchical:
+
+* every step: intra-host ring migration over ``i`` (cheap, rides ICI);
+* every step: a *small* inter-host elite exchange over ``h`` (a ppermute
+  of ``dcn_migrate_k`` genomes — a few KB — so DCN's lower bandwidth never
+  gates the step);
+* global best agreement: ``all_gather`` over both axes (one genome per
+  island, replicated everywhere).
+
+Single-process dry runs use the same code over a virtual mesh (the driver's
+``dryrun_multichip`` and tests/test_distributed.py reshape N CPU devices
+into ``h x i``), so the multi-host program is compile-checked without a
+pod.
+
+Launch (one command per host)::
+
+    NMZ_TPU_COORDINATOR=host0:8476 NMZ_TPU_NUM_PROCESSES=4 \
+    NMZ_TPU_PROCESS_ID=$RANK  python -m my_experiment ...
+
+or rely on the TPU environment's auto-detection (on Cloud TPU,
+``jax.distributed.initialize()`` discovers everything itself).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from namazu_tpu.models.ga import GAConfig
+from namazu_tpu.ops.schedule import ScoreWeights
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("parallel.distributed")
+
+_initialized = False
+
+
+def initialize_from_env(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Bootstrap ``jax.distributed`` for a multi-host run. Idempotent.
+
+    Explicit arguments win; otherwise ``NMZ_TPU_COORDINATOR`` /
+    ``NMZ_TPU_NUM_PROCESSES`` / ``NMZ_TPU_PROCESS_ID`` are read; if none
+    are present and we are not on a Cloud TPU environment that
+    auto-detects, this is a single-process run and returns False.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator = coordinator or os.environ.get("NMZ_TPU_COORDINATOR")
+    np_env = os.environ.get("NMZ_TPU_NUM_PROCESSES")
+    pid_env = os.environ.get("NMZ_TPU_PROCESS_ID")
+    num_processes = num_processes if num_processes is not None else (
+        int(np_env) if np_env else None
+    )
+    process_id = process_id if process_id is not None else (
+        int(pid_env) if pid_env else None
+    )
+    if coordinator is None and num_processes is None:
+        return False  # single-process
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log.info("jax.distributed up: process %d/%d, %d global devices",
+             jax.process_index(), jax.process_count(),
+             len(jax.devices()))
+    return True
+
+
+def make_hybrid_mesh(
+    n_hosts: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+    axes: tuple = ("h", "i"),
+) -> Mesh:
+    """2-D ``h x i`` mesh: hosts (DCN) x per-host chips (ICI).
+
+    In a real multi-process run ``n_hosts`` defaults to
+    ``jax.process_count()`` and devices are grouped so each row of the
+    mesh is one host's chips (collectives over ``i`` never leave a host).
+    Single-process (tests, dry runs): any ``n_hosts`` dividing the device
+    count reshapes the flat device list — same program, virtual hosts.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_hosts is None:
+        n_hosts = max(1, jax.process_count())
+    if len(devs) % n_hosts != 0:
+        raise ValueError(
+            f"{len(devs)} devices do not divide into {n_hosts} hosts"
+        )
+    per_host = len(devs) // n_hosts
+    if jax.process_count() > 1:
+        # group by owning process so the i-axis stays intra-host
+        devs = sorted(devs, key=lambda d: (d.process_index, d.id))
+    grid = np.asarray(devs, dtype=object).reshape(n_hosts, per_host)
+    return Mesh(grid, axes)
+
+
+def make_hier_island_step(
+    mesh: Mesh,
+    cfg: GAConfig,
+    weights: ScoreWeights = ScoreWeights(),
+    migrate_k: int = 8,
+    dcn_migrate_k: int = 2,
+    host_axis: str = "h",
+    chip_axis: str = "i",
+):
+    """Hierarchical island step for an ``h x i`` mesh: full-rate elite
+    ring over ICI (``migrate_k``), thin elite ring over DCN
+    (``dcn_migrate_k`` genomes — a few KB — landing just above the ICI
+    migrants so the rings never overwrite each other). State is the same
+    :class:`~namazu_tpu.parallel.islands.IslandState` (init with
+    ``init_island_state``), so drivers and checkpoints are identical for
+    flat and hierarchical meshes. One configuration of the general
+    ``islands.make_multiaxis_island_step``."""
+    from namazu_tpu.parallel.islands import make_multiaxis_island_step
+
+    return make_multiaxis_island_step(
+        mesh, cfg, weights,
+        rings=((chip_axis, migrate_k), (host_axis, dcn_migrate_k)),
+    )

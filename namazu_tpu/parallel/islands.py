@@ -4,20 +4,25 @@ Each device evolves an independent population shard ("island"); every step
 
 * scores its local genomes (vmap -> VPU/MXU),
 * evolves one GA generation locally,
-* migrates its elite genomes to the next island on a ring (``ppermute``
-  over ICI, replacing the neighbor's worst genomes),
+* migrates its elite genomes to the next island along one or more ring
+  axes (``ppermute`` — over ICI for the chip axis, over DCN for the host
+  axis of a hybrid mesh, replacing the neighbor's worst genomes),
 * and agrees on the global best via ``all_gather`` (tiny: one genome per
   island).
 
 Everything device-to-device rides XLA collectives; the host only sees the
 replicated global best. This is the TPU-native replacement for the
 reference's single-process random exploration (SURVEY.md section 2.9).
+
+``make_island_step`` builds the flat single-axis step;
+``make_multiaxis_island_step`` is the general form used for hybrid
+host x chip meshes (parallel/distributed.py) — the flat step is its
+one-ring special case.
 """
 
 from __future__ import annotations
 
-import functools
-from typing import NamedTuple
+from typing import NamedTuple, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,7 +37,7 @@ from namazu_tpu.ops.schedule import (
 
 
 class IslandState(NamedTuple):
-    pop: Population  # delays/faults f32[P, H], sharded over axis i
+    pop: Population  # delays/faults f32[P, H], sharded over the mesh
     gen: jax.Array  # int32 scalar, replicated
     best_fitness: jax.Array  # f32 scalar, replicated
     best_delays: jax.Array  # f32[H], replicated
@@ -51,21 +56,27 @@ def init_island_state(key: jax.Array, P_total: int, H: int,
     )
 
 
-def make_island_step(
+def make_multiaxis_island_step(
     mesh: Mesh,
     cfg: GAConfig,
     weights: ScoreWeights = ScoreWeights(),
-    migrate_k: int = 8,
-    axis: str = "i",
+    rings: Sequence[Tuple[str, int]] = (("i", 8),),
 ):
     """Build the jitted sharded step:
     (state, base_key, trace, pairs, archive, failure_feats) -> state.
+
+    ``rings`` is a sequence of ``(mesh_axis, migrate_k)``: each entry runs
+    an elite ring over that axis, landing its migrants in successive
+    slices of the island's worst genomes (so a later, thinner ring — e.g.
+    DCN — never overwrites an earlier ring's arrivals). Migration counts
+    clamp to the per-island population (shapes are static at trace time).
+    The global best is gathered over every mesh axis and replicated.
     """
-    n_islands = mesh.shape[axis]
+    axes = tuple(mesh.axis_names)
 
     def _local_step(key, pop, trace, pairs, archive, failure_feats):
-        idx = jax.lax.axis_index(axis)
-        key = jax.random.fold_in(key, idx)
+        for ax in axes:
+            key = jax.random.fold_in(key, jax.lax.axis_index(ax))
 
         fitness, _feats = score_population_multi(
             pop.delays, trace, pairs, archive, failure_feats, weights
@@ -78,41 +89,55 @@ def make_island_step(
 
         new_pop = ga_generation(key, pop, fitness, cfg)
 
-        # ring migration of the top-k genomes (replace neighbor's worst)
-        if n_islands > 1 and migrate_k > 0:
-            k = migrate_k
-            top_idx = jax.lax.top_k(fitness, k)[1]
-            perm = [(j, (j + 1) % n_islands) for j in range(n_islands)]
-            mig_d = jax.lax.ppermute(new_pop.delays[top_idx], axis, perm)
-            mig_f = jax.lax.ppermute(new_pop.faults[top_idx], axis, perm)
-            worst_idx = jax.lax.top_k(-fitness, k)[1]
-            new_pop = Population(
-                delays=new_pop.delays.at[worst_idx].set(mig_d),
-                faults=new_pop.faults.at[worst_idx].set(mig_f),
-            )
+        # clamp ring sizes cumulatively to the per-island population
+        rows = pop.delays.shape[0]
+        offset = 0
+        plan = []  # (axis, k, landing offset)
+        for ax, k in rings:
+            kk = min(k, max(0, rows - offset))
+            if mesh.shape[ax] > 1 and kk > 0:
+                plan.append((ax, kk, offset))
+                offset += kk
+        if plan:
+            worst = jax.lax.top_k(-fitness, offset)[1]
+            for ax, kk, off in plan:
+                n_ax = mesh.shape[ax]
+                top = jax.lax.top_k(fitness, kk)[1]
+                perm = [(j, (j + 1) % n_ax) for j in range(n_ax)]
+                mig_d = jax.lax.ppermute(new_pop.delays[top], ax, perm)
+                mig_f = jax.lax.ppermute(new_pop.faults[top], ax, perm)
+                dst = worst[off:off + kk]
+                new_pop = Population(
+                    delays=new_pop.delays.at[dst].set(mig_d),
+                    faults=new_pop.faults.at[dst].set(mig_f),
+                )
 
-        # replicated global best: gather one candidate per island
-        all_fit = jax.lax.all_gather(local_best_fit, axis)  # [nd]
-        all_d = jax.lax.all_gather(local_best_d, axis)  # [nd, H]
-        all_f = jax.lax.all_gather(local_best_f, axis)
+        # replicated global best: gather one candidate per island, axis by
+        # axis (innermost first, so ICI gathers before any DCN hop)
+        all_fit, all_d, all_f = local_best_fit, local_best_d, local_best_f
+        for ax in reversed(axes):
+            all_fit = jax.lax.all_gather(all_fit, ax)
+            all_d = jax.lax.all_gather(all_d, ax)
+            all_f = jax.lax.all_gather(all_f, ax)
+        all_fit = all_fit.reshape(-1)
+        all_d = all_d.reshape(-1, all_d.shape[-1])
+        all_f = all_f.reshape(-1, all_f.shape[-1])
         g = jnp.argmax(all_fit)
         return new_pop, all_fit[g], all_d[g], all_f[g]
 
+    pop_spec = Population(delays=P(axes, None), faults=P(axes, None))
     sharded = jax.shard_map(
         _local_step,
         mesh=mesh,
         in_specs=(
             P(),  # key
-            Population(delays=P(axis, None), faults=P(axis, None)),
+            pop_spec,
             TraceArrays(hint_ids=P(), arrival=P(), mask=P()),
             P(),  # pairs
             P(),  # archive
             P(),  # failure feats
         ),
-        out_specs=(
-            Population(delays=P(axis, None), faults=P(axis, None)),
-            P(), P(), P(),
-        ),
+        out_specs=(pop_spec, P(), P(), P()),
         check_vma=False,
     )
 
@@ -137,3 +162,15 @@ def make_island_step(
         )
 
     return step
+
+
+def make_island_step(
+    mesh: Mesh,
+    cfg: GAConfig,
+    weights: ScoreWeights = ScoreWeights(),
+    migrate_k: int = 8,
+    axis: str = "i",
+):
+    """Flat single-axis island step: one elite ring over ``axis``."""
+    return make_multiaxis_island_step(mesh, cfg, weights,
+                                      rings=((axis, migrate_k),))

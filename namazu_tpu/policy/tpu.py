@@ -55,6 +55,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.search_on_start = True
         self.max_fault = 0.0
         self.search_backend = "ga"  # "ga" (island GA) | "mcts" (config 5)
+        self.dcn_hosts = 0  # >1: hybrid host x chip mesh (multi-host DCN)
         self.mcts_simulations = 256
         self.mcts_tree_depth = 24
         self.mcts_levels = 8
@@ -104,6 +105,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
                                      self.mcts_tree_depth))
         self.mcts_levels = int(p("mcts_levels", self.mcts_levels))
         self.mcts_rollouts = int(p("mcts_rollouts", self.mcts_rollouts))
+        self.dcn_hosts = int(p("dcn_hosts", self.dcn_hosts))
         name = str(p("proc_policy", self.proc_policy_name))
         self.proc_policy_name = name
         self._proc_policy = create_proc_subpolicy(name, self._rng)
@@ -169,6 +171,23 @@ class TPUSearchPolicy(QueueBackedPolicy):
             ga=GAConfig(max_delay=self.max_interval,
                         max_fault=self.max_fault),
         )
+        mesh = None
+        if self.dcn_hosts > 1:
+            # multi-host: join the jax.distributed ring (no-op when the
+            # NMZ_TPU_COORDINATOR env triple is absent, e.g. virtual-host
+            # dry runs) and shard over a hybrid host x chip mesh
+            from namazu_tpu.parallel.distributed import (
+                initialize_from_env,
+                make_hybrid_mesh,
+            )
+
+            import jax
+
+            initialize_from_env()
+            # honor the `devices` knob (same subset the flat path uses)
+            devs = (jax.devices()[: self.n_devices]
+                    if self.n_devices is not None else None)
+            mesh = make_hybrid_mesh(n_hosts=self.dcn_hosts, devices=devs)
         if self.search_backend == "mcts":
             from namazu_tpu.models.mcts import MCTSConfig
 
@@ -180,14 +199,9 @@ class TPUSearchPolicy(QueueBackedPolicy):
                 max_delay=self.max_interval,
                 max_fault=self.max_fault,
             )
-            return MCTSSearch(cfg, mcts_cfg=mcts_cfg,
+            return MCTSSearch(cfg, mcts_cfg=mcts_cfg, mesh=mesh,
                               n_devices=self.n_devices)
-        if self.search_backend != "ga":
-            raise ValueError(
-                f"unknown search_backend {self.search_backend!r} "
-                "(expected 'ga' or 'mcts')"
-            )
-        return ScheduleSearch(cfg, n_devices=self.n_devices)
+        return ScheduleSearch(cfg, mesh=mesh, n_devices=self.n_devices)
 
     def _search_once(self) -> None:
         """Background: ingest history, evolve, install the best tables."""
