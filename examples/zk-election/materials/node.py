@@ -1,0 +1,188 @@
+"""Miniature fast-leader-election node speaking ZooKeeper's FLE wire
+format (QuorumCnxManager 3.4 handshake + length-framed notifications), so
+the proxy inspector's ZkStreamParser produces real semantic hints.
+
+The deliberately planted bug is the ZOOKEEPER-2212 class: a node decides
+as soon as *some* candidate holds a quorum at the close of its decision
+window and never re-evaluates afterwards — so when the highest-zxid
+node's notifications are delayed past the window, the cluster elects a
+stale leader (or splits). With no interception the exchange takes a few
+ms and the decision window comfortably covers the start stagger, so the
+healthy outcome (leader = the node with the newest zxid) is essentially
+deterministic.
+
+Usage: node.py SID ZXID LISTEN_PORT OUT_FILE PEER[,PEER...]
+       PEER = sid:host:port  (proxy-side address of that peer's listener)
+"""
+
+import socket
+import struct
+import sys
+import threading
+import time
+
+DECISION_WINDOW_S = 0.25  # must exceed start stagger + uninspected RTTs
+STATE_LOOKING = 0
+QUORUM = 2
+
+
+def note(sid, msg):
+    sys.stderr.write(f"[node{sid}] {msg}\n")
+    sys.stderr.flush()
+
+
+class Node:
+    def __init__(self, sid, zxid, listen_port, out_file, peers):
+        self.sid = sid
+        self.zxid = zxid
+        self.listen_port = listen_port
+        self.out_file = out_file
+        self.peers = peers  # {sid: (host, port)}
+        self.lock = threading.Lock()
+        # my current vote and everyone's last-heard votes: sid -> (zxid, sid)
+        self.vote = (zxid, sid)
+        self.votes = {sid: self.vote}
+        self.first_notif = threading.Event()
+        self.decided = None
+        self.socks = {}
+
+    # -- FLE wire ---------------------------------------------------------
+
+    def _notification(self):
+        z, leader = self.vote
+        body = struct.pack(">iqqqq", STATE_LOOKING, leader, z, 1, 1)
+        return struct.pack(">i", len(body)) + body
+
+    def _broadcast(self):
+        for psid, sock in list(self.socks.items()):
+            try:
+                sock.sendall(self._notification())
+            except OSError:
+                pass
+
+    def _dial(self, psid, addr):
+        """Keep one live outbound connection to a peer: the proxy accepts
+        and then dials the upstream, so a peer that is not up yet shows as
+        an immediately-closed socket — watch for EOF and reconnect."""
+        while self.decided is None:
+            try:
+                s = socket.create_connection(addr, timeout=1.0)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # 3.4-style initial: bare big-endian sid
+                s.sendall(struct.pack(">q", self.sid))
+                with self.lock:
+                    self.socks[psid] = s
+                    s.sendall(self._notification())
+                while s.recv(4096):  # peers never send on this direction
+                    pass
+            except OSError:
+                pass
+            with self.lock:
+                if self.socks.get(psid) is not None:
+                    try:
+                        self.socks.pop(psid).close()
+                    except OSError:
+                        pass
+            time.sleep(0.02)
+
+    # -- receive ----------------------------------------------------------
+
+    def _serve(self, srv):
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._recv, args=(conn,),
+                             daemon=True).start()
+
+    def _recv(self, conn):
+        buf = b""
+
+        def need(n):
+            nonlocal buf
+            while len(buf) < n:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise OSError("eof")
+                buf += chunk
+            out, buf = buf[:n], buf[n:]
+            return out
+
+        try:
+            (peer_sid,) = struct.unpack(">q", need(8))
+            while True:
+                (flen,) = struct.unpack(">i", need(4))
+                body = need(flen)
+                _state, leader, zxid, _e, _pe = struct.unpack(
+                    ">iqqqq", body[:36])
+                self._on_vote(peer_sid, (zxid, leader))
+        except OSError:
+            return
+
+    def _on_vote(self, peer_sid, vote):
+        with self.lock:
+            if self.decided is not None:
+                return  # THE BUG: no re-evaluation after deciding
+            self.votes[peer_sid] = vote
+            if vote > self.vote:  # (zxid, sid) lexicographic
+                self.vote = vote
+                self.votes[self.sid] = vote
+                self._broadcast()
+        self.first_notif.set()
+
+    # -- decision ---------------------------------------------------------
+
+    def _tally(self):
+        counts = {}
+        for v in self.votes.values():
+            counts[v] = counts.get(v, 0) + 1
+        winners = [v for v, c in counts.items() if c >= QUORUM]
+        return max(winners) if winners else None
+
+    def run(self):
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", self.listen_port))
+        srv.listen(8)
+        threading.Thread(target=self._serve, args=(srv,),
+                         daemon=True).start()
+        for psid, addr in self.peers.items():
+            threading.Thread(target=self._dial, args=(psid, addr),
+                             daemon=True).start()
+
+        self.first_notif.wait(timeout=20.0)
+        deadline = time.monotonic() + DECISION_WINDOW_S
+        while True:
+            time.sleep(0.02)
+            with self.lock:
+                winner = self._tally()
+                if winner is not None and time.monotonic() >= deadline:
+                    self.decided = winner
+                    break
+                if time.monotonic() > deadline + 20.0:
+                    self.decided = (0, 0)  # stuck: report no leader
+                    break
+        zxid, leader = self.decided
+        note(self.sid, f"elected leader={leader} zxid={zxid:#x}")
+        with open(self.out_file, "w") as f:
+            f.write(str(leader))
+        # linger so peers still dialing us don't see resets mid-decision
+        time.sleep(0.5)
+        srv.close()
+
+
+def main():
+    sid = int(sys.argv[1])
+    zxid = int(sys.argv[2], 0)
+    listen_port = int(sys.argv[3])
+    out_file = sys.argv[4]
+    peers = {}
+    for spec in sys.argv[5].split(","):
+        psid, host, port = spec.split(":")
+        peers[int(psid)] = (host, int(port))
+    Node(sid, zxid, listen_port, out_file, peers).run()
+
+
+if __name__ == "__main__":
+    main()

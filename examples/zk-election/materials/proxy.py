@@ -1,0 +1,45 @@
+"""One proxy-inspector process for the whole election mesh: six proxied
+links (every ordered node pair), one REST transceiver to the experiment's
+orchestrator, one shared FLE stream parser (per-connection parse state).
+
+Usage: proxy.py ORCHESTRATOR_URL LINK[,LINK...]
+       LINK = listenPort:upstreamPort:srcEntity:dstEntity
+"""
+
+import signal as _signal
+import sys
+import threading
+
+from namazu_tpu.inspector.ethernet import EthernetProxyInspector
+from namazu_tpu.inspector.transceiver import new_transceiver
+from namazu_tpu.inspector.zookeeper import ZkStreamParser
+
+
+def main():
+    url = sys.argv[1]
+    entity = "_nmz_zk_election_proxy"
+    trans = new_transceiver(url, entity)
+    # entity_id must match the transceiver's: the REST action queue is
+    # keyed by the event's entity and the transceiver polls its own
+    inspector = EthernetProxyInspector(
+        trans, entity_id=entity, parser=ZkStreamParser("fle"),
+        action_timeout=30.0,
+    )
+    for spec in sys.argv[2].split(","):
+        lport, uport, src, dst = spec.split(":")
+        inspector.add_link(f"127.0.0.1:{lport}", f"127.0.0.1:{uport}",
+                           src_entity=src, dst_entity=dst)
+    inspector.start()
+    print("proxy ready", flush=True)
+    stop = threading.Event()
+    _signal.signal(_signal.SIGTERM, lambda *_: stop.set())
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        inspector.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+#!/bin/sh
+# 3-node miniature FLE election through the proxy inspector.
+# Node listen ports 21281-21283; each ordered pair (src,dst) gets a
+# dedicated proxied link on 22000+10*src+dst -> dst's listener, so every
+# notification crosses the orchestrator exactly once.
+# PALLAS_AXON_POOL_IPS= skips this image's TPU plugin boot in the
+# short-lived interpreters (the control plane never needs a device).
+PORT="${NMZ_REST_PORT:-10982}"
+URL="http://127.0.0.1:${PORT}"
+OUT="$NMZ_WORKING_DIR"
+
+PALLAS_AXON_POOL_IPS= python "$NMZ_MATERIALS_DIR/proxy.py" "$URL" \
+  "22012:21282:zk1:zk2,22013:21283:zk1:zk3,22021:21281:zk2:zk1,22023:21283:zk2:zk3,22031:21281:zk3:zk1,22032:21282:zk3:zk2" \
+  > "$OUT/proxy.log" 2>&1 &
+proxy_pid=$!
+
+# wait for the six listeners
+i=0
+while [ $i -lt 100 ]; do
+  if grep -q "proxy ready" "$OUT/proxy.log" 2>/dev/null; then break; fi
+  i=$((i + 1)); sleep 0.1
+done
+
+# peers are addressed through the proxy ports; node 3 carries the newest
+# zxid and starts 120ms late (a restarting node)
+PALLAS_AXON_POOL_IPS= python "$NMZ_MATERIALS_DIR/node.py" 1 0x100 21281 \
+  "$OUT/leader1" "2:127.0.0.1:22012,3:127.0.0.1:22013" \
+  > "$OUT/node1.log" 2>&1 &
+n1=$!
+PALLAS_AXON_POOL_IPS= python "$NMZ_MATERIALS_DIR/node.py" 2 0x100 21282 \
+  "$OUT/leader2" "1:127.0.0.1:22021,3:127.0.0.1:22023" \
+  > "$OUT/node2.log" 2>&1 &
+n2=$!
+( sleep 0.12
+  PALLAS_AXON_POOL_IPS= python "$NMZ_MATERIALS_DIR/node.py" 3 0x300 21283 \
+    "$OUT/leader3" "1:127.0.0.1:22031,2:127.0.0.1:22032" \
+    > "$OUT/node3.log" 2>&1 ) &
+n3=$!
+
+wait "$n1" "$n2" "$n3"
+kill "$proxy_pid" 2>/dev/null
+wait "$proxy_pid" 2>/dev/null
+exit 0

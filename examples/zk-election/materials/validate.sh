@@ -1,0 +1,9 @@
+#!/bin/sh
+# Oracle: healthy iff every node elected node 3 — the only replica with
+# the newest zxid. A stale leader (2) or a split vote is the bug.
+for n in 1 2 3; do
+  f="$NMZ_WORKING_DIR/leader$n"
+  [ -f "$f" ] || exit 1
+  [ "$(cat "$f")" = "3" ] || exit 1
+done
+exit 0

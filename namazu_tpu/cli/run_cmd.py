@@ -31,9 +31,16 @@ def register(sub) -> None:
 
 def run(args) -> int:
     storage_dir = args.storage
-    cfg_path = os.path.join(storage_dir, "config.json")
+    # a user-editable config.toml wins over the init-time config.json
+    # snapshot, so swapping the policy between runs of one storage works
+    # (reference parity: run.go:55 reads storageDir/config.toml directly —
+    # e.g. record history under `random`, then re-run under `tpu_search`)
+    cfg_path = os.path.join(storage_dir, "config.toml")
     if not os.path.exists(cfg_path):
-        print(f"error: {storage_dir} is not initialized (no config.json)",
+        cfg_path = os.path.join(storage_dir, "config.json")
+    if not os.path.exists(cfg_path):
+        print(f"error: {storage_dir} is not initialized (no config.toml "
+              "or config.json; config.toml wins when both exist)",
               file=sys.stderr)
         return 1
     cfg = Config.from_file(cfg_path)

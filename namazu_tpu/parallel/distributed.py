@@ -105,6 +105,13 @@ def make_hybrid_mesh(
         raise ValueError(
             f"{len(devs)} devices do not divide into {n_hosts} hosts"
         )
+    if jax.process_count() > 1 and n_hosts % jax.process_count() != 0:
+        # each mesh row must stay within one physical host, otherwise the
+        # full-rate i-axis collectives silently cross DCN every step
+        raise ValueError(
+            f"n_hosts={n_hosts} must be a multiple of the process count "
+            f"({jax.process_count()}) so the chip axis stays intra-host"
+        )
     per_host = len(devs) // n_hosts
     if jax.process_count() > 1:
         # group by owning process so the i-axis stays intra-host

@@ -53,6 +53,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.n_devices: Optional[int] = None
         self.checkpoint_path = ""
         self.search_on_start = True
+        self.search_join_timeout = 120.0  # shutdown waits this long
         self.max_fault = 0.0
         self.search_backend = "ga"  # "ga" (island GA) | "mcts" (config 5)
         self.dcn_hosts = 0  # >1: hybrid host x chip mesh (multi-host DCN)
@@ -89,6 +90,8 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.n_devices = int(nd) if nd is not None else None
         self.checkpoint_path = str(p("checkpoint", "") or "")
         self.search_on_start = bool(p("search_on_start", True))
+        self.search_join_timeout = parse_duration(
+            p("search_join_timeout", self.search_join_timeout * 1000))
         self.max_fault = float(p("max_fault", 0.0))
         self.search_backend = str(p("search_backend", self.search_backend))
         if self.search_backend not in ("ga", "mcts"):
@@ -203,17 +206,26 @@ class TPUSearchPolicy(QueueBackedPolicy):
                               n_devices=self.n_devices)
         return ScheduleSearch(cfg, mesh=mesh, n_devices=self.n_devices)
 
+    def _checkpoint(self) -> str:
+        """Checkpoint path; a relative path anchors to the experiment's
+        storage dir (stable across `run` invocations from any cwd)."""
+        p = self.checkpoint_path
+        if (p and not os.path.isabs(p)
+                and getattr(self._storage, "dir", None)):
+            return os.path.join(self._storage.dir, p)
+        return p
+
     def _search_once(self) -> None:
         """Background: ingest history, evolve, install the best tables."""
         try:
+            ckpt = self._checkpoint()
             with self._search_lock:
                 if self._search is None:
                     self._search = self._build_search()
-                    if self.checkpoint_path and os.path.exists(self.checkpoint_path):
-                        self._search.load(self.checkpoint_path)
+                    if ckpt and os.path.exists(ckpt):
+                        self._search.load(ckpt)
                         log.info("loaded search checkpoint %s (gen %d)",
-                                 self.checkpoint_path,
-                                 self._search.generations_run)
+                                 ckpt, self._search.generations_run)
                 search = self._search
             references = self._ingest_history(search)
             if not references:
@@ -224,8 +236,8 @@ class TPUSearchPolicy(QueueBackedPolicy):
             self._faults = best.faults
             log.info("installed searched schedule (fitness %.4f, gen %d)",
                      best.fitness, search.generations_run)
-            if self.checkpoint_path:
-                search.save(self.checkpoint_path)
+            if ckpt:
+                search.save(ckpt)
         except Exception:
             log.exception("schedule search failed; hash-based delays remain")
 
@@ -261,6 +273,17 @@ class TPUSearchPolicy(QueueBackedPolicy):
                 successes.append(enc)
         refs = (failures[::-1] + successes[::-1])[: self.MAX_REFERENCE_TRACES]
         return refs
+
+    def shutdown(self) -> None:
+        """With a checkpoint configured, let an in-flight search finish
+        (bounded) before the run ends — the searched schedule + checkpoint
+        are the run's product for the next `run` invocation's policy to
+        pick up. Without one the result could not outlive the process, so
+        don't hold the shutdown."""
+        t = self._search_thread
+        if t is not None and self.checkpoint_path:
+            t.join(timeout=self.search_join_timeout)
+        super().shutdown()
 
     def wait_for_search(self, timeout: float = 120.0) -> bool:
         """Block until the background search installed a schedule (tests)."""
