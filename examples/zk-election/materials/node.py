@@ -67,6 +67,8 @@ class Node:
         while self.decided is None:
             try:
                 s = socket.create_connection(addr, timeout=1.0)
+                s.settimeout(None)  # connect timeout must not make the
+                # idle recv below churn healthy connections every second
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 # 3.4-style initial: bare big-endian sid
                 s.sendall(struct.pack(">q", self.sid))

@@ -14,12 +14,21 @@ PALLAS_AXON_POOL_IPS= python "$NMZ_MATERIALS_DIR/proxy.py" "$URL" \
   > "$OUT/proxy.log" 2>&1 &
 proxy_pid=$!
 
-# wait for the six listeners
+# wait for the six listeners; a dead proxy is an infra error, not a bug
+# repro — exit non-zero so the runner aborts without recording
+ready=0
 i=0
 while [ $i -lt 100 ]; do
-  if grep -q "proxy ready" "$OUT/proxy.log" 2>/dev/null; then break; fi
+  if grep -q "proxy ready" "$OUT/proxy.log" 2>/dev/null; then ready=1; break; fi
+  if ! kill -0 "$proxy_pid" 2>/dev/null; then break; fi
   i=$((i + 1)); sleep 0.1
 done
+if [ "$ready" != "1" ]; then
+  echo "proxy failed to start:" >&2
+  cat "$OUT/proxy.log" >&2
+  kill "$proxy_pid" 2>/dev/null
+  exit 1
+fi
 
 # peers are addressed through the proxy ports; node 3 carries the newest
 # zxid and starts 120ms late (a restarting node)
@@ -37,7 +46,16 @@ n2=$!
     > "$OUT/node3.log" 2>&1 ) &
 n3=$!
 
-wait "$n1" "$n2" "$n3"
+# a crashed node is an infra error, not a bug repro: propagate it so the
+# runner aborts without recording (same guard as the proxy above)
+rc=0
+wait "$n1" || rc=1
+wait "$n2" || rc=1
+wait "$n3" || rc=1
 kill "$proxy_pid" 2>/dev/null
 wait "$proxy_pid" 2>/dev/null
-exit 0
+if [ "$rc" != "0" ]; then
+  echo "a node process failed:" >&2
+  tail -5 "$OUT"/node*.log >&2
+fi
+exit "$rc"

@@ -38,10 +38,13 @@ def run(args) -> int:
     cfg_path = os.path.join(storage_dir, "config.toml")
     if not os.path.exists(cfg_path):
         cfg_path = os.path.join(storage_dir, "config.json")
-    if not os.path.exists(cfg_path):
-        print(f"error: {storage_dir} is not initialized (no config.toml "
-              "or config.json; config.toml wins when both exist)",
-              file=sys.stderr)
+    # config.json is only ever written by init, so its absence (even with
+    # a config.toml present, e.g. `run` pointed at an example source dir)
+    # means this is not an initialized storage
+    if not os.path.exists(os.path.join(storage_dir, "config.json")):
+        print(f"error: {storage_dir} is not initialized (no config.json; "
+              "run `init` first — an edited config.toml wins over it "
+              "afterwards)", file=sys.stderr)
         return 1
     cfg = Config.from_file(cfg_path)
 
@@ -67,7 +70,13 @@ def run(args) -> int:
             return 1
         res = factory.run(run_script)
         if res.returncode != 0:
-            print(f"run script exited {res.returncode}", file=sys.stderr)
+            # infra failure, not an experiment outcome: abort without
+            # recording so it cannot pollute repro-rate stats or the
+            # search plane's failure archive (parity: cli/run.go aborts
+            # when the run command errors)
+            print(f"error: run script exited {res.returncode}; "
+                  "not recording this run", file=sys.stderr)
+            return 1
     finally:
         trace = orchestrator.shutdown()
 

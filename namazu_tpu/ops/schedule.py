@@ -122,6 +122,16 @@ def min_sq_distance(feats: jax.Array, archive: jax.Array) -> jax.Array:
     return jnp.maximum(jnp.min(d2, axis=-1), 0.0)
 
 
+def _min_sq_distance_best(feats: jax.Array, archive: jax.Array) -> jax.Array:
+    """The Pallas fused-min kernel on TPU (~10% whole-scorer win at
+    production sizes, no [P,A] HBM round-trip), plain XLA elsewhere.
+    Dispatch lives in pallas_score; lazily imported because that module
+    imports this one."""
+    from namazu_tpu.ops.pallas_score import min_sq_distance_auto
+
+    return min_sq_distance_auto(feats, archive)
+
+
 def score_population(
     delays: jax.Array,  # [P, H]
     trace: TraceArrays,
@@ -134,8 +144,8 @@ def score_population(
     feats = jax.vmap(
         lambda d: schedule_features(d, trace, pairs, weights.tau)
     )(delays)
-    novelty = min_sq_distance(feats, archive)
-    bug = -min_sq_distance(feats, failure_feats)
+    novelty = _min_sq_distance_best(feats, archive)
+    bug = -_min_sq_distance_best(feats, failure_feats)
     delay_cost = jnp.mean(delays, axis=-1)
     fitness = (
         weights.novelty * novelty
@@ -181,8 +191,9 @@ def score_population_multi(
     feats = jnp.swapaxes(feats, 0, 1)  # [P, T, K]
     P, T, K = feats.shape
     flat = feats.reshape(P * T, K)
-    novelty = min_sq_distance(flat, archive).reshape(P, T).mean(axis=1)
-    bug = -min_sq_distance(flat, failure_feats).reshape(P, T).mean(axis=1)
+    novelty = _min_sq_distance_best(flat, archive).reshape(P, T).mean(axis=1)
+    bug = -_min_sq_distance_best(flat, failure_feats).reshape(P, T).mean(
+        axis=1)
     delay_cost = jnp.mean(delays, axis=-1)
     fitness = (
         weights.novelty * novelty
