@@ -52,11 +52,64 @@ class ScoreWeights(NamedTuple):
     bug: float = 1.0
     delay_cost: float = 0.01
     tau: float = 0.005  # precedence smoothing, seconds
+    # order mode (BASELINE config 3, "permutation+delay genomes"): the
+    # genome table is interpreted as per-hint *priorities* realized by the
+    # policy's reorder window, not as literal delays. Events are bucketed
+    # into arrival windows of order_window seconds (0 = one global
+    # window) and permuted by (priority, arrival) *within* each window —
+    # exactly the set of interleavings the control plane's windowed
+    # reorder buffer can realize, so scored schedules stay executable.
+    order_mode: bool = False
+    order_gap: float = 0.001  # seconds between consecutive releases
+    order_window: float = 0.0  # reorder-window size; 0 = whole trace
 
 
 def release_times(delays: jax.Array, trace: TraceArrays) -> jax.Array:
     """t[e] = arrival[e] + delays[hint_ids[e]] (masked -> BIG)."""
     t = trace.arrival + delays[trace.hint_ids]
+    return jnp.where(trace.mask, t, BIG)
+
+
+def order_release_times(prio: jax.Array, trace: TraceArrays,
+                        gap: float, window: float = 0.0) -> jax.Array:
+    """Counterfactual release times under *windowed permutation*
+    scheduling — what the policy's reorder buffer (policy/tpu.py
+    release_mode "reorder") actually realizes: events are batched into
+    arrival windows of ``window`` seconds and each batch is released in
+    ``(prio[hint], arrival)`` order, ``gap`` seconds apart, starting at
+    the window's end. ``window=0`` scores one global window (the upper
+    bound of reachable permutations). Only co-pending events can be
+    permuted, so scored interleavings stay executable.
+
+    1-D trace only (vmap over genomes; use score_population_multi for
+    stacked traces). Masked positions sort last and stay BIG.
+    """
+    if trace.hint_ids.ndim != 1:
+        raise ValueError(
+            "order_release_times takes a single [L] trace; got shape "
+            f"{trace.hint_ids.shape}"
+        )
+    L = trace.hint_ids.shape[0]
+    if window > 0:
+        win = jnp.floor(trace.arrival / window).astype(jnp.int32)
+    else:
+        win = jnp.zeros((L,), jnp.int32)
+    win = jnp.where(trace.mask, win, jnp.iinfo(jnp.int32).max)
+    key = jnp.where(trace.mask, prio[trace.hint_ids], jnp.inf)
+    # window-major, then priority, then arrival (stable within window)
+    order = jnp.lexsort((trace.arrival, key, win))  # [L] ids by rank
+    idx = jnp.arange(L, dtype=jnp.int32)
+    # within-window rank, computed in sorted order: position minus the
+    # start index of the event's window segment (cummax of segment
+    # starts — no bound on the number of windows)
+    sw = win[order]
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), sw[1:] != sw[:-1]])
+    seg_start = jax.lax.cummax(jnp.where(is_start, idx, 0))
+    within_sorted = idx - seg_start
+    within = jnp.zeros((L,), jnp.int32).at[order].set(within_sorted)
+    base = (win.astype(jnp.float32) + 1.0) * window  # window close time
+    t = base + within.astype(jnp.float32) * gap
     return jnp.where(trace.mask, t, BIG)
 
 
@@ -79,11 +132,18 @@ def precedence_features(
 
 
 def schedule_features(
-    delays: jax.Array, trace: TraceArrays, pairs: jax.Array, tau: float
+    delays: jax.Array, trace: TraceArrays, pairs: jax.Array, tau: float,
+    order_mode: bool = False, order_gap: float = 0.001,
+    order_window: float = 0.0,
 ) -> jax.Array:
-    """One genome -> feature vector f32[K]."""
+    """One genome -> feature vector f32[K]. In order mode the genome is a
+    priority table and tau should be of the order of order_gap so adjacent
+    ranks still produce saturated precedence features."""
     H = delays.shape[0]
-    t = release_times(delays, trace)
+    if order_mode:
+        t = order_release_times(delays, trace, order_gap, order_window)
+    else:
+        t = release_times(delays, trace)
     first = first_occurrence(t, trace, H)
     return precedence_features(first, pairs, tau)
 
@@ -142,7 +202,9 @@ def score_population(
 ) -> tuple[jax.Array, jax.Array]:
     """Fitness f32[P] and features f32[P,K] for a whole population."""
     feats = jax.vmap(
-        lambda d: schedule_features(d, trace, pairs, weights.tau)
+        lambda d: schedule_features(d, trace, pairs, weights.tau,
+                                    weights.order_mode, weights.order_gap,
+                                    weights.order_window)
     )(delays)
     novelty = _min_sq_distance_best(feats, archive)
     bug = -_min_sq_distance_best(feats, failure_feats)
@@ -182,7 +244,10 @@ def score_population_multi(
     """
     def per_trace(tr: TraceArrays):
         return jax.vmap(
-            lambda d: schedule_features(d, tr, pairs, weights.tau)
+            lambda d: schedule_features(d, tr, pairs, weights.tau,
+                                        weights.order_mode,
+                                        weights.order_gap,
+                                        weights.order_window)
         )(delays)  # [P, K]
 
     feats = jax.vmap(

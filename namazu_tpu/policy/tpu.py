@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Optional
 
 from namazu_tpu.policy.base import QueueBackedPolicy, register_policy
@@ -57,6 +58,19 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.max_fault = 0.0
         self.search_backend = "ga"  # "ga" (island GA) | "mcts" (config 5)
         self.dcn_hosts = 0  # >1: hybrid host x chip mesh (multi-host DCN)
+        # release modes (BASELINE config 3): "delay" replays the table as
+        # literal per-hint delays; "reorder" treats it as per-hint
+        # *priorities* — events buffered for reorder_window seconds are
+        # released in priority order, a true permutation even when delays
+        # could not invert the arrivals
+        self.release_mode = "delay"
+        self.reorder_window = 0.05
+        self.reorder_gap = 0.002
+        self._pending: list = []  # (prio, seq, event) under _pending_lock
+        self._pending_lock = threading.Lock()
+        self._pending_seq = 0
+        self._reorder_thread: Optional[threading.Thread] = None
+        self._stop_reorder = threading.Event()
         self.mcts_simulations = 256
         self.mcts_tree_depth = 24
         self.mcts_levels = 8
@@ -109,6 +123,16 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.mcts_levels = int(p("mcts_levels", self.mcts_levels))
         self.mcts_rollouts = int(p("mcts_rollouts", self.mcts_rollouts))
         self.dcn_hosts = int(p("dcn_hosts", self.dcn_hosts))
+        self.release_mode = str(p("release_mode", self.release_mode))
+        if self.release_mode not in ("delay", "reorder"):
+            raise ValueError(
+                f"unknown release_mode {self.release_mode!r} "
+                "(expected 'delay' or 'reorder')"
+            )
+        self.reorder_window = parse_duration(
+            p("reorder_window", self.reorder_window * 1000))
+        self.reorder_gap = parse_duration(
+            p("reorder_gap", self.reorder_gap * 1000))
         name = str(p("proc_policy", self.proc_policy_name))
         self.proc_policy_name = name
         self._proc_policy = create_proc_subpolicy(name, self._rng)
@@ -142,6 +166,23 @@ class TPUSearchPolicy(QueueBackedPolicy):
             attrs = self._proc_policy.attrs_for(event.pids)
             self._emit(ProcSetSchedAction.for_procset(event, attrs))
             return
+        if self.release_mode == "reorder":
+            # table value = priority (hash fallback until a search lands);
+            # the window thread releases pending events in priority order
+            if self._stop_reorder.is_set():
+                # raced with shutdown's final flush: release immediately
+                # so no transceiver hangs on a never-emitted action
+                self._emit(self._action_for(event))
+                return
+            prio = self._delay_for(event.replay_hint())
+            with self._pending_lock:
+                self._pending.append((prio, self._pending_seq, event))
+                self._pending_seq += 1
+            if self._stop_reorder.is_set():
+                # shutdown flushed between our check and the append —
+                # drain again (idempotent) so the event is not stranded
+                self._drain_pending(gap=0.0)
+            return
         self._queue.put_at(event, self._delay_for(event.replay_hint()))
 
     def _action_for(self, event: Event):
@@ -155,8 +196,31 @@ class TPUSearchPolicy(QueueBackedPolicy):
 
     def start(self) -> None:
         super().start()
-        if self.search_on_start and self._search_thread is None:
-            self._search_thread = self._spawn(self._search_once, "search")
+        # _start_lock makes the spawns idempotent under concurrent
+        # queue_event callers (the base class guards only its own thread)
+        with self._start_lock:
+            if (self.release_mode == "reorder"
+                    and self._reorder_thread is None):
+                self._reorder_thread = self._spawn(self._reorder_loop,
+                                                   "reorder")
+            if self.search_on_start and self._search_thread is None:
+                self._search_thread = self._spawn(self._search_once,
+                                                  "search")
+
+    # -- reorder window ---------------------------------------------------
+
+    def _drain_pending(self, gap: float) -> None:
+        with self._pending_lock:
+            batch, self._pending = self._pending, []
+        batch.sort()  # (priority, arrival seq) — the scored permutation
+        for i, (_prio, _seq, event) in enumerate(batch):
+            if i and gap > 0:
+                time.sleep(gap)
+            self._emit(self._action_for(event))
+
+    def _reorder_loop(self) -> None:
+        while not self._stop_reorder.wait(self.reorder_window):
+            self._drain_pending(self.reorder_gap)
 
     def _build_search(self):
         from namazu_tpu.models.ga import GAConfig
@@ -166,6 +230,26 @@ class TPUSearchPolicy(QueueBackedPolicy):
             SearchConfig,
         )
 
+        from namazu_tpu.ops.schedule import ScoreWeights
+
+        # scoring must model the same realization the control plane uses:
+        # order mode permutes within reorder_window batches by the table's
+        # priorities; delay mode adds the table to arrivals. delay_cost=0
+        # in order mode: uniform priority shifts don't change the
+        # permutation, so penalizing the table's mean would only drive
+        # priorities onto the 0 clip boundary (collapsing to arrival
+        # order via the tie-break).
+        if self.release_mode == "reorder":
+            gap = max(self.reorder_gap, 1e-4)
+            weights = ScoreWeights(
+                order_mode=True,
+                order_gap=gap,
+                order_window=max(self.reorder_window, 0.0),
+                tau=gap * 0.5,
+                delay_cost=0.0,
+            )
+        else:
+            weights = ScoreWeights()
         cfg = SearchConfig(
             H=self.H, L=self.L, K=self.K,
             population=self.population,
@@ -173,6 +257,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
             seed=self.seed,
             ga=GAConfig(max_delay=self.max_interval,
                         max_fault=self.max_fault),
+            weights=weights,
         )
         mesh = None
         if self.dcn_hosts > 1:
@@ -280,6 +365,10 @@ class TPUSearchPolicy(QueueBackedPolicy):
         are the run's product for the next `run` invocation's policy to
         pick up. Without one the result could not outlive the process, so
         don't hold the shutdown."""
+        if self._reorder_thread is not None:
+            self._stop_reorder.set()
+            self._reorder_thread.join(timeout=10)
+            self._drain_pending(gap=0.0)  # flush, loss-free shutdown
         t = self._search_thread
         if t is not None and self.checkpoint_path:
             t.join(timeout=self.search_join_timeout)
