@@ -30,6 +30,12 @@ class SearchConfig(NamedTuple):
     seed: int = 0
     ga: GAConfig = GAConfig()
     weights: ScoreWeights = ScoreWeights()
+    # learned surrogate (BASELINE config 5): when > 0, an online MLP
+    # P(reproduce | features) trained on executed runs re-ranks the top-k
+    # genomes of the evolved population, and run() returns the candidate
+    # with the highest predicted repro instead of the raw fitness argmax.
+    # 0 disables (fitness argmax, the pre-surrogate behavior).
+    surrogate_topk: int = 0
 
 
 class BestSchedule(NamedTuple):
@@ -50,6 +56,9 @@ class SearchBase:
         self.pairs = te.sample_pairs(cfg.K, cfg.H, cfg.seed)
         # neutral (0.5) features = "no information"; rings overwrite oldest
         self.archive = np.full((cfg.archive_size, cfg.K), 0.5, np.float32)
+        # label per archive slot: did that run reproduce the bug? (the
+        # surrogate's training target; slots beyond _archive_n are unused)
+        self.archive_labels = np.zeros((cfg.archive_size,), np.float32)
         self._archive_n = 0
         self.failures = np.full((cfg.failure_size, cfg.K), 0.5, np.float32)
         self._failure_n = 0
@@ -69,11 +78,13 @@ class SearchBase:
                            self.cfg.weights.tau, self.cfg.H)
         return np.asarray(f)
 
-    def add_executed_trace(self, encoded: te.EncodedTrace) -> None:
-        """Record an executed run's interleaving into the novelty archive."""
-        self.archive[self._archive_n % self.cfg.archive_size] = (
-            self._feats_of(encoded)
-        )
+    def add_executed_trace(self, encoded: te.EncodedTrace,
+                           reproduced: bool = False) -> None:
+        """Record an executed run's interleaving into the novelty archive,
+        labeled with whether it reproduced the bug (surrogate target)."""
+        slot = self._archive_n % self.cfg.archive_size
+        self.archive[slot] = self._feats_of(encoded)
+        self.archive_labels[slot] = 1.0 if reproduced else 0.0
         self._archive_n += 1
 
     def add_failure_trace(self, encoded: te.EncodedTrace) -> None:
@@ -82,6 +93,15 @@ class SearchBase:
             self._feats_of(encoded)
         )
         self._failure_n += 1
+
+    def labeled_archive(self):
+        """(feats [N,K], labels [N]) of the populated archive slots whose
+        outcome is known (NaN labels — pre-surrogate checkpoints — are
+        excluded)."""
+        n = min(self._archive_n, self.cfg.archive_size)
+        feats, labels = self.archive[:n], self.archive_labels[:n]
+        known = np.isfinite(labels)
+        return feats[known], labels[known]
 
     def _device_inputs(self, encoded):
         """(traces, pairs, archive, failures) as device arrays, from one
@@ -110,6 +130,7 @@ class SearchBase:
         flat = {
             "backend": np.asarray(self.BACKEND),
             "archive": self.archive,
+            "archive_labels": self.archive_labels,
             "archive_n": np.asarray(self._archive_n),
             "failures": self.failures,
             "failure_n": np.asarray(self._failure_n),
@@ -134,6 +155,15 @@ class SearchBase:
                     f"backend, not {self.BACKEND!r}"
                 )
             self.archive = z["archive"]
+            if "archive_labels" in z:
+                self.archive_labels = z["archive_labels"]
+            else:
+                # pre-surrogate checkpoint: outcomes of the archived runs
+                # are unknown — NaN marks the slots unusable as training
+                # data (a 0.0 default would teach the surrogate that the
+                # runs that DID reproduce predict no-repro)
+                self.archive_labels = np.full(
+                    (self.cfg.archive_size,), np.nan, np.float32)
             self._archive_n = int(z["archive_n"])
             self.failures = z["failures"]
             self._failure_n = int(z["failure_n"])
@@ -179,12 +209,18 @@ class ScheduleSearch(SearchBase):
         self._state = init_island_state(
             jax.random.PRNGKey(cfg.seed + 1), self.population, cfg.H, cfg.ga
         )
+        self._surrogate = None  # built lazily on first labeled training
 
     # -- search ----------------------------------------------------------
 
     def run(self, encoded, generations: int = 50) -> BestSchedule:
-        """Evolve against one or more reference traces for N generations;
-        returns the best schedule seen so far (monotonic across calls)."""
+        """Evolve against one or more reference traces for N generations.
+
+        Returns the best schedule seen so far (monotonic across calls) —
+        unless ``cfg.surrogate_topk > 0`` and the surrogate has trained on
+        both outcomes, in which case the evolved population's top-k by
+        fitness are re-ranked by predicted P(reproduce) and the winner is
+        returned (the candidate worth the next wall-clock replay)."""
         _encs, trace, pairs, archive, failures = self._device_inputs(encoded)
         state = self._state
         for _ in range(generations):
@@ -193,7 +229,58 @@ class ScheduleSearch(SearchBase):
         state.best_fitness.block_until_ready()
         self._state = state
         self.generations_run += generations
-        return self.best()
+        picked = self._surrogate_pick(trace, pairs, archive, failures)
+        return picked if picked is not None else self.best()
+
+    # -- surrogate (BASELINE config 5) ------------------------------------
+
+    def _train_surrogate(self):
+        """Fit the online MLP on the labeled archive; returns it, or None
+        when surrogate use is off or only one outcome class exists yet."""
+        if self.cfg.surrogate_topk <= 0:
+            return None
+        feats, labels = self.labeled_archive()
+        if len(feats) < 4 or labels.min() == labels.max():
+            return None  # nothing learnable yet
+        if self._surrogate is None:
+            from namazu_tpu.models.surrogate import RewardSurrogate
+
+            self._surrogate = RewardSurrogate(K=self.cfg.K,
+                                              seed=self.cfg.seed)
+        self._surrogate.train(feats, labels, epochs=4,
+                              seed=self.cfg.seed + self.generations_run)
+        return self._surrogate
+
+    def _surrogate_pick(self, trace, pairs, archive,
+                        failures) -> Optional[BestSchedule]:
+        """Re-rank the evolved population's fitness top-k by predicted
+        repro probability; return the winner (None = surrogate inactive)."""
+        surrogate = self._train_surrogate()
+        if surrogate is None:
+            return None
+        import jax.numpy as jnp
+
+        from namazu_tpu.ops.schedule import score_population_multi
+
+        k = min(self.cfg.surrogate_topk, self.population)
+        # de-shard the island population (a few MB) — this re-score runs
+        # outside shard_map, where scatter on an @i-sharded operand is
+        # ambiguous; trace arrives stacked [T, L] from _device_inputs
+        delays = jnp.asarray(np.asarray(self._state.pop.delays))
+        faults = np.asarray(self._state.pop.faults)
+        fitness, feats = score_population_multi(
+            delays, trace, pairs, archive, failures, self.cfg.weights,
+        )
+        top = np.asarray(jnp.argsort(-fitness)[:k])
+        # features averaged over the reference traces, like the fitness
+        cand_feats = np.asarray(feats[top].mean(axis=1))
+        order, probs = surrogate.rerank(cand_feats, top=1)
+        winner = int(top[order[0]])
+        return BestSchedule(
+            delays=np.asarray(delays[winner]),
+            faults=faults[winner],
+            fitness=float(fitness[winner]),
+        )
 
     def best(self) -> BestSchedule:
         return BestSchedule(
@@ -205,7 +292,7 @@ class ScheduleSearch(SearchBase):
     # -- persistence -----------------------------------------------------
 
     def _state_dict(self) -> dict:
-        return {
+        d = {
             "pop_delays": np.asarray(self._state.pop.delays),
             "pop_faults": np.asarray(self._state.pop.faults),
             "gen": np.asarray(self._state.gen),
@@ -213,6 +300,12 @@ class ScheduleSearch(SearchBase):
             "best_delays": np.asarray(self._state.best_delays),
             "best_faults": np.asarray(self._state.best_faults),
         }
+        if self._surrogate is not None:
+            from jax.flatten_util import ravel_pytree
+
+            vec, _ = ravel_pytree(self._surrogate.state.params)
+            d["surrogate_params"] = np.asarray(vec)
+        return d
 
     def _restore_state(self, z) -> None:
         import jax.numpy as jnp
@@ -230,6 +323,19 @@ class ScheduleSearch(SearchBase):
             best_delays=jnp.asarray(z["best_delays"]),
             best_faults=jnp.asarray(z["best_faults"]),
         )
+        if "surrogate_params" in z:
+            from jax.flatten_util import ravel_pytree
+
+            from namazu_tpu.models.surrogate import RewardSurrogate
+
+            # deterministic re-init yields the unravel structure; the
+            # optimizer restarts (momentum is not worth persisting)
+            self._surrogate = RewardSurrogate(K=self.cfg.K,
+                                              seed=self.cfg.seed)
+            _, unravel = ravel_pytree(self._surrogate.state.params)
+            self._surrogate.state = self._surrogate.state._replace(
+                params=unravel(jnp.asarray(z["surrogate_params"]))
+            )
 
 
 class MCTSSearch(SearchBase):

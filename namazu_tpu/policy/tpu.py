@@ -75,6 +75,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.mcts_tree_depth = 24
         self.mcts_levels = 8
         self.mcts_rollouts = 64
+        self.surrogate_topk = 16  # 0 = fitness argmax only (no surrogate)
         self.proc_policy_name = "mild"
         import random as _random
 
@@ -122,6 +123,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
                                      self.mcts_tree_depth))
         self.mcts_levels = int(p("mcts_levels", self.mcts_levels))
         self.mcts_rollouts = int(p("mcts_rollouts", self.mcts_rollouts))
+        self.surrogate_topk = int(p("surrogate_topk", self.surrogate_topk))
         self.dcn_hosts = int(p("dcn_hosts", self.dcn_hosts))
         self.release_mode = str(p("release_mode", self.release_mode))
         if self.release_mode not in ("delay", "reorder"):
@@ -214,7 +216,9 @@ class TPUSearchPolicy(QueueBackedPolicy):
             batch, self._pending = self._pending, []
         batch.sort()  # (priority, arrival seq) — the scored permutation
         for i, (_prio, _seq, event) in enumerate(batch):
-            if i and gap > 0:
+            # during shutdown, stop pacing so a large in-flight batch
+            # cannot outlive the join window and lose its tail
+            if i and gap > 0 and not self._stop_reorder.is_set():
                 time.sleep(gap)
             self._emit(self._action_for(event))
 
@@ -258,6 +262,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
             ga=GAConfig(max_delay=self.max_interval,
                         max_fault=self.max_fault),
             weights=weights,
+            surrogate_topk=self.surrogate_topk,
         )
         mesh = None
         if self.dcn_hosts > 1:
@@ -277,6 +282,11 @@ class TPUSearchPolicy(QueueBackedPolicy):
                     if self.n_devices is not None else None)
             mesh = make_hybrid_mesh(n_hosts=self.dcn_hosts, devices=devs)
         if self.search_backend == "mcts":
+            if self.surrogate_topk > 0:
+                log.warning(
+                    "surrogate re-ranking (surrogate_topk=%d) applies to "
+                    "the GA backend only; the mcts backend returns its "
+                    "fitness argmax", self.surrogate_topk)
             from namazu_tpu.models.mcts import MCTSConfig
 
             mcts_cfg = MCTSConfig(
@@ -349,8 +359,9 @@ class TPUSearchPolicy(QueueBackedPolicy):
             except Exception:
                 continue
             enc = te.encode_trace(trace, L=self.L, H=self.H)
-            search.add_executed_trace(enc)
-            # "failure" = the run reproduced the bug (validate failed)
+            # "failure" = the run reproduced the bug (validate failed);
+            # the label feeds the surrogate's training set
+            search.add_executed_trace(enc, reproduced=not ok)
             if not ok:
                 search.add_failure_trace(enc)
                 failures.append(enc)

@@ -1,0 +1,122 @@
+"""Surrogate-in-the-loop (BASELINE config 5 completion): the online
+P(reproduce) MLP trains on labeled executed runs and re-ranks the evolved
+population's elites before a wall-clock replay is paid for.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from namazu_tpu.models.ga import GAConfig
+from namazu_tpu.models.search import ScheduleSearch, SearchConfig
+from namazu_tpu.ops import trace_encoding as te
+
+H, L, K = 32, 64, 64
+
+
+def toy_encoded(n=40, n_hints=10, spacing=1e-3):
+    return te.encode_event_stream(
+        [f"hint{i % n_hints}" for i in range(n)],
+        arrivals=[i * spacing for i in range(n)],
+        L=L, H=H,
+    )
+
+
+def cfg(surrogate_topk=8, seed=3):
+    return SearchConfig(H=H, L=L, K=K, archive_size=64, failure_size=8,
+                        population=64, migrate_k=2, seed=seed,
+                        ga=GAConfig(max_delay=0.05),
+                        surrogate_topk=surrogate_topk)
+
+
+def test_surrogate_inactive_without_both_classes():
+    s = ScheduleSearch(cfg(), n_devices=2)
+    enc = toy_encoded()
+    # only successes recorded -> one class -> surrogate stays off
+    for _ in range(6):
+        s.add_executed_trace(enc, reproduced=False)
+    assert s._train_surrogate() is None
+    best = s.run(enc, generations=2)
+    assert np.isfinite(best.fitness)
+    assert s._surrogate is None
+
+
+def test_surrogate_trains_and_separates_planted_signal():
+    s = ScheduleSearch(cfg(), n_devices=2)
+    fast = toy_encoded(spacing=1e-3)
+    slow = toy_encoded(spacing=5e-3)  # different interleaving features
+    for _ in range(8):
+        s.add_executed_trace(fast, reproduced=True)
+        s.add_executed_trace(slow, reproduced=False)
+    surrogate = s._train_surrogate()
+    assert surrogate is not None
+    p_fast = surrogate.predict(s._feats_of(fast)[None])[0]
+    p_slow = surrogate.predict(s._feats_of(slow)[None])[0]
+    assert p_fast > p_slow  # learned the planted signal
+
+
+def test_run_returns_surrogate_reranked_elite():
+    s = ScheduleSearch(cfg(surrogate_topk=8), n_devices=2)
+    fast = toy_encoded(spacing=1e-3)
+    slow = toy_encoded(spacing=5e-3)
+    for _ in range(8):
+        s.add_executed_trace(fast, reproduced=True)
+        s.add_executed_trace(slow, reproduced=False)
+        s.add_failure_trace(fast)
+    best = s.run(fast, generations=3)
+    # the returned candidate is a member of the evolved population (not
+    # necessarily the historical best), with finite fitness
+    assert np.isfinite(best.fitness)
+    pop = np.asarray(s._state.pop.delays)
+    assert any(np.allclose(best.delays, row) for row in pop)
+
+
+def test_surrogate_off_keeps_monotonic_best():
+    s = ScheduleSearch(cfg(surrogate_topk=0), n_devices=2)
+    enc = toy_encoded()
+    for _ in range(4):
+        s.add_executed_trace(enc, reproduced=(_ % 2 == 0))
+    b1 = s.run(enc, generations=2)
+    b2 = s.run(enc, generations=2)
+    assert b2.fitness >= b1.fitness
+    assert s._surrogate is None
+
+
+def test_checkpoint_roundtrips_surrogate_and_labels(tmp_path):
+    s = ScheduleSearch(cfg(), n_devices=2)
+    fast = toy_encoded(spacing=1e-3)
+    slow = toy_encoded(spacing=5e-3)
+    for _ in range(8):
+        s.add_executed_trace(fast, reproduced=True)
+        s.add_executed_trace(slow, reproduced=False)
+    s.run(fast, generations=1)  # trains the surrogate
+    assert s._surrogate is not None
+    p_before = s._surrogate.predict(s._feats_of(fast)[None])[0]
+
+    path = str(tmp_path / "ck.npz")
+    s.save(path)
+    s2 = ScheduleSearch(cfg(), n_devices=2)
+    s2.load(path)
+    np.testing.assert_array_equal(s2.archive_labels, s.archive_labels)
+    assert s2._surrogate is not None
+    p_after = s2._surrogate.predict(s2._feats_of(fast)[None])[0]
+    assert p_after == pytest.approx(p_before, abs=1e-6)
+
+
+def test_policy_param_plumbing():
+    from namazu_tpu.policy import create_policy
+    from namazu_tpu.utils.config import Config
+
+    pol = create_policy("tpu_search")
+    pol.load_config(Config({
+        "explore_policy": "tpu_search",
+        "explore_policy_param": {
+            "surrogate_topk": 4, "search_on_start": False,
+            "hint_buckets": H, "trace_length": L, "feature_pairs": K,
+            "devices": 1, "population": 32,
+        },
+    }))
+    s = pol._build_search()
+    assert s.cfg.surrogate_topk == 4
