@@ -117,9 +117,13 @@ class _SearchCarry(NamedTuple):
 
 def _make_rollout(trace: TraceArrays, pairs, archive, failure_feats,
                   hint_order, level_values, H: int, cfg: MCTSConfig,
-                  weights: ScoreWeights):
+                  weights: ScoreWeights, coin=None):
     """Returns rollout(key, levels i32[tree_depth]) ->
-    (mean_fitness, best_fitness, best_delays, best_faults)."""
+    (mean_fitness, best_fitness, best_delays, best_faults).
+
+    When ``cfg.max_fault > 0`` (and a fault ``coin`` is given), the random
+    fault matrices participate in the counterfactual score — the returned
+    best fault table is *selected*, not an unselected random draw."""
 
     def rollout(key, levels):
         kd, kf = jax.random.split(key)
@@ -134,8 +138,11 @@ def _make_rollout(trace: TraceArrays, pairs, archive, failure_feats,
         pin_val = jnp.zeros((H,), jnp.float32).at[hint_order].set(val)
         pin_mask = jnp.zeros((H,), bool).at[hint_order].set(assigned)
         delays = jnp.where(pin_mask[None, :], pin_val[None, :], delays)
+        score_faults = faults if (cfg.max_fault > 0 and coin is not None) \
+            else None
         fitness, _ = score_population_multi(
-            delays, trace, pairs, archive, failure_feats, weights
+            delays, trace, pairs, archive, failure_feats, weights,
+            faults=score_faults, coin=coin,
         )  # f32[R]
         b = jnp.argmax(fitness)
         return fitness.mean(), fitness[b], delays[b], faults[b]
@@ -153,12 +160,14 @@ def mcts_search(
     H: int,
     cfg: MCTSConfig = MCTSConfig(),
     weights: ScoreWeights = ScoreWeights(),
+    coin: jax.Array | None = None,  # f32[H] deterministic fault coin
 ) -> MCTSResult:
     """Run one full MCTS; pure function of its inputs (jit-safe)."""
     D, Td = cfg.n_levels, cfg.tree_depth
     level_values = jnp.linspace(0.0, cfg.max_delay, D).astype(jnp.float32)
     rollout = _make_rollout(trace, pairs, archive, failure_feats,
-                            hint_order, level_values, H, cfg, weights)
+                            hint_order, level_values, H, cfg, weights,
+                            coin=coin)
 
     def simulate(i, carry: _SearchCarry) -> _SearchCarry:
         tree, key = carry.tree, carry.key
@@ -270,9 +279,10 @@ def mcts_search(
 @functools.partial(jax.jit, static_argnames=("H", "cfg", "weights"))
 def mcts_search_jit(key, trace, pairs, archive, failure_feats, hint_order,
                     H: int, cfg: MCTSConfig = MCTSConfig(),
-                    weights: ScoreWeights = ScoreWeights()) -> MCTSResult:
+                    weights: ScoreWeights = ScoreWeights(),
+                    coin=None) -> MCTSResult:
     return mcts_search(key, trace, pairs, archive, failure_feats,
-                       hint_order, H, cfg, weights)
+                       hint_order, H, cfg, weights, coin=coin)
 
 
 def make_parallel_mcts(mesh, H: int, cfg: MCTSConfig = MCTSConfig(),
@@ -288,11 +298,12 @@ def make_parallel_mcts(mesh, H: int, cfg: MCTSConfig = MCTSConfig(),
 
     axes = tuple(mesh.axis_names)
 
-    def _local(key, trace, pairs, archive, failure_feats, hint_order):
+    def _local(key, trace, pairs, archive, failure_feats, hint_order,
+               coin):
         for ax in axes:
             key = jax.random.fold_in(key, jax.lax.axis_index(ax))
         res = mcts_search(key, trace, pairs, archive, failure_feats,
-                          hint_order, H, cfg, weights)
+                          hint_order, H, cfg, weights, coin=coin)
         all_fit, all_d, all_f = (res.best_fitness, res.best_delays,
                                  res.best_faults)
         for ax in reversed(axes):
@@ -309,19 +320,30 @@ def make_parallel_mcts(mesh, H: int, cfg: MCTSConfig = MCTSConfig(),
         _local,
         mesh=mesh,
         in_specs=(P(), TraceArrays(hint_ids=P(), arrival=P(), mask=P()),
-                  P(), P(), P(), P()),
+                  P(), P(), P(), P(), P()),
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
 
     @jax.jit
     def run(key, trace: TraceArrays, pairs, archive, failure_feats,
-            hint_order):
+            hint_order, coin=None):
         if trace.hint_ids.ndim == 1:
             trace = TraceArrays(
                 trace.hint_ids[None], trace.arrival[None], trace.mask[None]
             )
+        if coin is None:
+            if cfg.max_fault > 0:
+                # without the coin the rollout fault tables would be
+                # returned unscored — the round-1 bug config 4 fixes
+                raise ValueError(
+                    "fault search is enabled (max_fault > 0) but no "
+                    "fault coin was passed; build one with "
+                    "trace_encoding.fault_coin(seed, H)"
+                )
+            # coin >= 1 never beats a fault probability in [0, 1]
+            coin = jnp.ones((H,), jnp.float32)
         return sharded(key, trace, pairs, archive, failure_feats,
-                       hint_order)
+                       hint_order, coin)
 
     return run

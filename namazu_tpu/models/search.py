@@ -63,6 +63,10 @@ class SearchBase:
         self.failures = np.full((cfg.failure_size, cfg.K), 0.5, np.float32)
         self._failure_n = 0
         self.generations_run = 0
+        # fault half of the genome is scored only when faults can be
+        # non-zero; coin=None keeps the pre-config-4 jit cache entry
+        self._coin = (te.fault_coin(cfg.seed, cfg.H)
+                      if cfg.ga.max_fault > 0 else None)
 
     def _feats_of(self, encoded: te.EncodedTrace) -> np.ndarray:
         import jax.numpy as jnp
@@ -222,15 +226,39 @@ class ScheduleSearch(SearchBase):
         fitness are re-ranked by predicted P(reproduce) and the winner is
         returned (the candidate worth the next wall-clock replay)."""
         _encs, trace, pairs, archive, failures = self._device_inputs(encoded)
+        import jax.numpy as jnp
+
+        coin = None if self._coin is None else jnp.asarray(self._coin)
         state = self._state
         for _ in range(generations):
             state = self._step(state, self._key, trace, pairs, archive,
-                               failures)
+                               failures, coin)
         state.best_fitness.block_until_ready()
         self._state = state
         self.generations_run += generations
         picked = self._surrogate_pick(trace, pairs, archive, failures)
         return picked if picked is not None else self.best()
+
+    def _fetch_population(self):
+        """Population as host numpy arrays (delays, faults).
+
+        On a multi-process mesh the population is sharded across hosts
+        and ``np.asarray`` on it raises "non-addressable devices"; gather
+        it explicitly so surrogate re-ranking and checkpointing work in
+        real DCN runs, not just virtual-host meshes."""
+        import jax
+
+        pop = self._state.pop
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            return (
+                np.asarray(multihost_utils.process_allgather(
+                    pop.delays, tiled=True)),
+                np.asarray(multihost_utils.process_allgather(
+                    pop.faults, tiled=True)),
+            )
+        return np.asarray(pop.delays), np.asarray(pop.faults)
 
     # -- surrogate (BASELINE config 5) ------------------------------------
 
@@ -266,10 +294,12 @@ class ScheduleSearch(SearchBase):
         # de-shard the island population (a few MB) — this re-score runs
         # outside shard_map, where scatter on an @i-sharded operand is
         # ambiguous; trace arrives stacked [T, L] from _device_inputs
-        delays = jnp.asarray(np.asarray(self._state.pop.delays))
-        faults = np.asarray(self._state.pop.faults)
+        delays_np, faults = self._fetch_population()
+        delays = jnp.asarray(delays_np)
         fitness, feats = score_population_multi(
             delays, trace, pairs, archive, failures, self.cfg.weights,
+            faults=None if self._coin is None else jnp.asarray(faults),
+            coin=None if self._coin is None else jnp.asarray(self._coin),
         )
         top = np.asarray(jnp.argsort(-fitness)[:k])
         # features averaged over the reference traces, like the fitness
@@ -292,9 +322,10 @@ class ScheduleSearch(SearchBase):
     # -- persistence -----------------------------------------------------
 
     def _state_dict(self) -> dict:
+        pop_delays, pop_faults = self._fetch_population()
         d = {
-            "pop_delays": np.asarray(self._state.pop.delays),
-            "pop_faults": np.asarray(self._state.pop.faults),
+            "pop_delays": pop_delays,
+            "pop_faults": pop_faults,
             "gen": np.asarray(self._state.gen),
             "best_fitness": np.asarray(self._state.best_fitness),
             "best_delays": np.asarray(self._state.best_delays),
@@ -357,6 +388,10 @@ class MCTSSearch(SearchBase):
         self.mcts_cfg = mcts_cfg if mcts_cfg is not None else MCTSConfig(
             max_delay=cfg.ga.max_delay, max_fault=cfg.ga.max_fault
         )
+        if self.mcts_cfg.max_fault > 0 and self._coin is None:
+            # an explicit mcts_cfg can enable fault search even when
+            # cfg.ga doesn't — the rollouts still need the fault coin
+            self._coin = te.fault_coin(cfg.seed, cfg.H)
         if self.mcts_cfg.tree_depth > cfg.H:
             # the tree cannot decide more buckets than the genome has
             self.mcts_cfg = self.mcts_cfg._replace(tree_depth=cfg.H)
@@ -388,12 +423,13 @@ class MCTSSearch(SearchBase):
 
         encs, trace, pairs, archive, failures = self._device_inputs(encoded)
         hint_order = jnp.asarray(self._hint_order(encs))
+        coin = None if self._coin is None else jnp.asarray(self._coin)
 
         searches = max(1, generations // 64)
         for _ in range(searches):
             self._key, sub = jax.random.split(self._key)
             fit, d, f = self._run(sub, trace, pairs, archive, failures,
-                                  hint_order)
+                                  hint_order, coin)
             fit = float(fit)
             if fit > self._best_fitness:
                 self._best_fitness = fit

@@ -31,7 +31,7 @@ wall-clock (SURVEY.md section 6, BASELINE.json north star).
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -52,6 +52,12 @@ class ScoreWeights(NamedTuple):
     bug: float = 1.0
     delay_cost: float = 0.01
     tau: float = 0.005  # precedence smoothing, seconds
+    # cost per dropped event (as a fraction of live events): dropping
+    # *everything* is maximally novel, so fault search needs a
+    # counterweight that scales with how much of the trace the genome
+    # erases (reference: faults are rare, faultActionProbability ~ 0.0,
+    # randompolicy.go:300-317)
+    fault_cost: float = 0.05
     # order mode (BASELINE config 3, "permutation+delay genomes"): the
     # genome table is interpreted as per-hint *priorities* realized by the
     # policy's reorder window, not as literal delays. Events are bucketed
@@ -68,6 +74,34 @@ def release_times(delays: jax.Array, trace: TraceArrays) -> jax.Array:
     """t[e] = arrival[e] + delays[hint_ids[e]] (masked -> BIG)."""
     t = trace.arrival + delays[trace.hint_ids]
     return jnp.where(trace.mask, t, BIG)
+
+
+def drop_mask(faults: jax.Array, coin: jax.Array,
+              trace: TraceArrays) -> jax.Array:
+    """bool[L]: events the genome's fault table removes from the
+    counterfactual interleaving.
+
+    The control plane's fault decision is a deterministic per-bucket coin
+    (policy/tpu.py _fault_for): event e is dropped iff
+    ``coin[hint_ids[e]] < faults[hint_ids[e]]``, so the scored
+    counterfactual and the replayed schedule agree by construction. A
+    dropped packet never arrives (PacketFaultAction, reference
+    action_fault_packet.go:29-46); EIO-style filesystem faults are
+    approximated the same way — the op's normal effect vanishes from the
+    interleaving.
+    """
+    return trace.mask & (coin[trace.hint_ids] < faults[trace.hint_ids])
+
+
+def apply_faults(trace: TraceArrays, faults: Optional[jax.Array],
+                 coin: Optional[jax.Array]) -> TraceArrays:
+    """Trace with fault-dropped events masked out (identity when the
+    genome has no fault half)."""
+    if faults is None:
+        return trace
+    dropped = drop_mask(faults, coin, trace)
+    return TraceArrays(trace.hint_ids, trace.arrival,
+                       trace.mask & ~dropped)
 
 
 def order_release_times(prio: jax.Array, trace: TraceArrays,
@@ -135,11 +169,17 @@ def schedule_features(
     delays: jax.Array, trace: TraceArrays, pairs: jax.Array, tau: float,
     order_mode: bool = False, order_gap: float = 0.001,
     order_window: float = 0.0,
+    faults: Optional[jax.Array] = None,
+    coin: Optional[jax.Array] = None,
 ) -> jax.Array:
     """One genome -> feature vector f32[K]. In order mode the genome is a
     priority table and tau should be of the order of order_gap so adjacent
-    ranks still produce saturated precedence features."""
+    ranks still produce saturated precedence features. When ``faults`` (and
+    the per-bucket ``coin``) are given, fault-dropped events vanish from
+    the counterfactual before first-occurrence — the fault half of the
+    genome shapes the features (BASELINE config 4)."""
     H = delays.shape[0]
+    trace = apply_faults(trace, faults, coin)
     if order_mode:
         t = order_release_times(delays, trace, order_gap, order_window)
     else:
@@ -199,13 +239,36 @@ def score_population(
     archive: jax.Array,  # [A, K] features of executed schedules
     failure_feats: jax.Array,  # [F, K] features of bug-reproducing runs
     weights: ScoreWeights = ScoreWeights(),
+    faults: Optional[jax.Array] = None,  # [P, H] fault probabilities
+    coin: Optional[jax.Array] = None,  # [H] deterministic fault coin
 ) -> tuple[jax.Array, jax.Array]:
-    """Fitness f32[P] and features f32[P,K] for a whole population."""
-    feats = jax.vmap(
-        lambda d: schedule_features(d, trace, pairs, weights.tau,
-                                    weights.order_mode, weights.order_gap,
-                                    weights.order_window)
-    )(delays)
+    """Fitness f32[P] and features f32[P,K] for a whole population.
+
+    With ``faults``/``coin``, the genome's fault half is part of the
+    counterfactual: dropped events reshape the features, and a
+    ``fault_cost`` per dropped event keeps "drop everything" from being
+    the novelty optimum."""
+    if faults is None:
+        feats = jax.vmap(
+            lambda d: schedule_features(d, trace, pairs, weights.tau,
+                                        weights.order_mode,
+                                        weights.order_gap,
+                                        weights.order_window)
+        )(delays)
+        fault_pen = 0.0
+    else:
+        feats = jax.vmap(
+            lambda d, f: schedule_features(d, trace, pairs, weights.tau,
+                                           weights.order_mode,
+                                           weights.order_gap,
+                                           weights.order_window,
+                                           faults=f, coin=coin)
+        )(delays, faults)
+        dropped = jax.vmap(lambda f: drop_mask(f, coin, trace))(faults)
+        live = jnp.maximum(jnp.sum(trace.mask), 1)
+        fault_pen = weights.fault_cost * (
+            jnp.sum(dropped, axis=-1) / live
+        )
     novelty = _min_sq_distance_best(feats, archive)
     bug = -_min_sq_distance_best(feats, failure_feats)
     delay_cost = jnp.mean(delays, axis=-1)
@@ -213,15 +276,17 @@ def score_population(
         weights.novelty * novelty
         + weights.bug * bug
         - weights.delay_cost * delay_cost
+        - fault_pen
     )
     return fitness, feats
 
 
 @functools.partial(jax.jit, static_argnames=("weights",))
 def score_population_jit(delays, trace, pairs, archive, failure_feats,
-                         weights: ScoreWeights = ScoreWeights()):
+                         weights: ScoreWeights = ScoreWeights(),
+                         faults=None, coin=None):
     return score_population(delays, trace, pairs, archive, failure_feats,
-                            weights)
+                            weights, faults=faults, coin=coin)
 
 
 # -- multi-trace scoring ----------------------------------------------------
@@ -234,6 +299,8 @@ def score_population_multi(
     archive: jax.Array,  # [A, K]
     failure_feats: jax.Array,  # [F, K]
     weights: ScoreWeights = ScoreWeights(),
+    faults: Optional[jax.Array] = None,  # [P, H]
+    coin: Optional[jax.Array] = None,  # [H]
 ) -> tuple[jax.Array, jax.Array]:
     """Fitness aggregated over T recorded traces.
 
@@ -243,12 +310,20 @@ def score_population_multi(
     transfers. Returns (fitness f32[P], feats f32[P, T, K]).
     """
     def per_trace(tr: TraceArrays):
+        if faults is None:
+            return jax.vmap(
+                lambda d: schedule_features(d, tr, pairs, weights.tau,
+                                            weights.order_mode,
+                                            weights.order_gap,
+                                            weights.order_window)
+            )(delays)  # [P, K]
         return jax.vmap(
-            lambda d: schedule_features(d, tr, pairs, weights.tau,
-                                        weights.order_mode,
-                                        weights.order_gap,
-                                        weights.order_window)
-        )(delays)  # [P, K]
+            lambda d, f: schedule_features(d, tr, pairs, weights.tau,
+                                           weights.order_mode,
+                                           weights.order_gap,
+                                           weights.order_window,
+                                           faults=f, coin=coin)
+        )(delays, faults)  # [P, K]
 
     feats = jax.vmap(
         lambda h, a, m: per_trace(TraceArrays(h, a, m))
@@ -260,10 +335,23 @@ def score_population_multi(
     bug = -_min_sq_distance_best(flat, failure_feats).reshape(P, T).mean(
         axis=1)
     delay_cost = jnp.mean(delays, axis=-1)
+    if faults is None:
+        fault_pen = 0.0
+    else:
+        def per_trace_drop(tr: TraceArrays):
+            dropped = jax.vmap(lambda f: drop_mask(f, coin, tr))(faults)
+            live = jnp.maximum(jnp.sum(tr.mask), 1)
+            return jnp.sum(dropped, axis=-1) / live  # [P]
+
+        frac = jax.vmap(
+            lambda h, a, m: per_trace_drop(TraceArrays(h, a, m))
+        )(traces.hint_ids, traces.arrival, traces.mask)  # [T, P]
+        fault_pen = weights.fault_cost * frac.mean(axis=0)
     fitness = (
         weights.novelty * novelty
         + weights.bug * bug
         - weights.delay_cost * delay_cost
+        - fault_pen
     )
     return fitness, feats
 

@@ -34,6 +34,21 @@ def hint_bucket(hint: str, n_buckets: int = DEFAULT_H) -> int:
     return fnv64a(hint.encode()) % n_buckets
 
 
+def fault_coin(seed: int, H: int = DEFAULT_H) -> np.ndarray:
+    """Deterministic per-bucket fault coin f32[H] in [0, 1).
+
+    The policy drops an event iff ``coin[bucket] < faults[bucket]``
+    (policy/tpu.py _fault_for) and the scorer removes exactly those events
+    from the counterfactual (ops/schedule.py drop_mask) — same formula,
+    same coin, so a searched fault table replays to the interleaving it
+    was scored as."""
+    return np.array(
+        [fnv64a(f"{seed}|fault|{h}".encode()) % 10_000 / 10_000.0
+         for h in range(H)],
+        np.float32,
+    )
+
+
 class EncodedTrace:
     """One trace in array form (plain numpy; converted to jnp at the device
     boundary)."""

@@ -117,6 +117,18 @@ def make_hybrid_mesh(
         # group by owning process so the i-axis stays intra-host
         devs = sorted(devs, key=lambda d: (d.process_index, d.id))
     grid = np.asarray(devs, dtype=object).reshape(n_hosts, per_host)
+    if jax.process_count() > 1:
+        # an unbalanced device subset (e.g. jax.devices()[:6] across two
+        # 4-chip hosts) can still produce rows spanning processes after
+        # the sort — refuse rather than let "ICI" collectives ride DCN
+        for row in grid:
+            procs = {d.process_index for d in row}
+            if len(procs) > 1:
+                raise ValueError(
+                    "mesh row spans processes "
+                    f"{sorted(procs)}; pass a per-process-balanced device "
+                    "subset so the chip axis stays intra-host"
+                )
     return Mesh(grid, axes)
 
 

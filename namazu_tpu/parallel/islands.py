@@ -4,9 +4,11 @@ Each device evolves an independent population shard ("island"); every step
 
 * scores its local genomes (vmap -> VPU/MXU),
 * evolves one GA generation locally,
-* migrates its elite genomes to the next island along one or more ring
-  axes (``ppermute`` — over ICI for the chip axis, over DCN for the host
-  axis of a hybrid mesh, replacing the neighbor's worst genomes),
+* migrates its elite genomes (the leading rows after ``ga_generation``)
+  to the next island along one or more ring axes (``ppermute`` — over ICI
+  for the chip axis, over DCN for the host axis of a hybrid mesh),
+  landing them in the neighbor's tail rows so the neighbor's own
+  preserved elites are never overwritten,
 * and agrees on the global best via ``all_gather`` (tiny: one genome per
   island).
 
@@ -66,20 +68,26 @@ def make_multiaxis_island_step(
     (state, base_key, trace, pairs, archive, failure_feats) -> state.
 
     ``rings`` is a sequence of ``(mesh_axis, migrate_k)``: each entry runs
-    an elite ring over that axis, landing its migrants in successive
-    slices of the island's worst genomes (so a later, thinner ring — e.g.
-    DCN — never overwrites an earlier ring's arrivals). Migration counts
-    clamp to the per-island population (shapes are static at trace time).
-    The global best is gathered over every mesh axis and replicated.
+    a ring over that axis migrating the island's *leading* rows of
+    ``new_pop`` (elites first — ``ga_generation`` sorts them into the
+    first ``n_elite`` slots — then best-effort tournament offspring when
+    ``migrate_k > n_elite``). Migrants land in successive *tail* slices of
+    the neighbor's population, so the neighbor's own preserved elites are
+    never overwritten and a later, thinner ring (e.g. DCN) never clobbers
+    an earlier ring's arrivals. Counts clamp so the landing region stays
+    clear of the elite rows (shapes are static at trace time). The global
+    best is gathered over every mesh axis and replicated.
     """
     axes = tuple(mesh.axis_names)
 
-    def _local_step(key, pop, trace, pairs, archive, failure_feats):
+    def _local_step(key, pop, trace, pairs, archive, failure_feats,
+                    coin=None):
         for ax in axes:
             key = jax.random.fold_in(key, jax.lax.axis_index(ax))
 
         fitness, _feats = score_population_multi(
-            pop.delays, trace, pairs, archive, failure_feats, weights
+            pop.delays, trace, pairs, archive, failure_feats, weights,
+            faults=None if coin is None else pop.faults, coin=coin,
         )
         # local best before evolution (elites survive anyway)
         best_i = jnp.argmax(fitness)
@@ -89,28 +97,32 @@ def make_multiaxis_island_step(
 
         new_pop = ga_generation(key, pop, fitness, cfg)
 
-        # clamp ring sizes cumulatively to the per-island population
+        # Migration: after ga_generation the island's elites occupy rows
+        # [0:n_elite) of new_pop (sorted best-first), so migrants are the
+        # leading rows (elites, then offspring if migrate_k > n_elite),
+        # and they land in the *tail* rows of the neighbor — successive
+        # rings take successive tail slices, so elites are transported
+        # verbatim and a later, thinner ring (e.g. DCN) never overwrites
+        # an earlier ring's arrivals or the neighbor's preserved elites.
         rows = pop.delays.shape[0]
+        n_elite = max(1, int(rows * cfg.elite_frac))
         offset = 0
-        plan = []  # (axis, k, landing offset)
+        plan = []  # (axis, k, landing offset from the tail)
         for ax, k in rings:
-            kk = min(k, max(0, rows - offset))
+            kk = min(k, max(0, rows - n_elite - offset))
             if mesh.shape[ax] > 1 and kk > 0:
                 plan.append((ax, kk, offset))
                 offset += kk
-        if plan:
-            worst = jax.lax.top_k(-fitness, offset)[1]
-            for ax, kk, off in plan:
-                n_ax = mesh.shape[ax]
-                top = jax.lax.top_k(fitness, kk)[1]
-                perm = [(j, (j + 1) % n_ax) for j in range(n_ax)]
-                mig_d = jax.lax.ppermute(new_pop.delays[top], ax, perm)
-                mig_f = jax.lax.ppermute(new_pop.faults[top], ax, perm)
-                dst = worst[off:off + kk]
-                new_pop = Population(
-                    delays=new_pop.delays.at[dst].set(mig_d),
-                    faults=new_pop.faults.at[dst].set(mig_f),
-                )
+        for ax, kk, off in plan:
+            n_ax = mesh.shape[ax]
+            perm = [(j, (j + 1) % n_ax) for j in range(n_ax)]
+            mig_d = jax.lax.ppermute(new_pop.delays[:kk], ax, perm)
+            mig_f = jax.lax.ppermute(new_pop.faults[:kk], ax, perm)
+            dst = rows - off - kk
+            new_pop = Population(
+                delays=new_pop.delays.at[dst:dst + kk].set(mig_d),
+                faults=new_pop.faults.at[dst:dst + kk].set(mig_f),
+            )
 
         # replicated global best: gather one candidate per island, axis by
         # axis (innermost first, so ICI gathers before any DCN hop)
@@ -126,32 +138,55 @@ def make_multiaxis_island_step(
         return new_pop, all_fit[g], all_d[g], all_f[g]
 
     pop_spec = Population(delays=P(axes, None), faults=P(axes, None))
-    sharded = jax.shard_map(
+    base_specs = (
+        P(),  # key
+        pop_spec,
+        TraceArrays(hint_ids=P(), arrival=P(), mask=P()),
+        P(),  # pairs
+        P(),  # archive
+        P(),  # failure feats
+    )
+    sharded_fault = jax.shard_map(
         _local_step,
         mesh=mesh,
-        in_specs=(
-            P(),  # key
-            pop_spec,
-            TraceArrays(hint_ids=P(), arrival=P(), mask=P()),
-            P(),  # pairs
-            P(),  # archive
-            P(),  # failure feats
-        ),
+        in_specs=base_specs + (P(),),  # + fault coin
+        out_specs=(pop_spec, P(), P(), P()),
+        check_vma=False,
+    )
+    sharded_nofault = jax.shard_map(
+        _local_step,
+        mesh=mesh,
+        in_specs=base_specs,
         out_specs=(pop_spec, P(), P(), P()),
         check_vma=False,
     )
 
     @jax.jit
     def step(state: IslandState, base_key, trace: TraceArrays, pairs,
-             archive, failure_feats) -> IslandState:
+             archive, failure_feats, coin=None) -> IslandState:
         if trace.hint_ids.ndim == 1:  # single trace -> batch of one
             trace = TraceArrays(
                 trace.hint_ids[None], trace.arrival[None], trace.mask[None]
             )
+        if coin is None and cfg.max_fault > 0:
+            # without the coin the fault half would evolve unscored —
+            # exactly the round-1 bug config 4 exists to fix
+            raise ValueError(
+                "fault search is enabled (max_fault > 0) but no fault "
+                "coin was passed to the island step; build one with "
+                "trace_encoding.fault_coin(seed, H)"
+            )
         key = jax.random.fold_in(base_key, state.gen)
-        new_pop, fit, bd, bf = sharded(
-            key, state.pop, trace, pairs, archive, failure_feats
-        )
+        if coin is None:
+            # static no-fault variant: the drop-mask/penalty branch is
+            # never compiled into the hot loop when faults are off
+            new_pop, fit, bd, bf = sharded_nofault(
+                key, state.pop, trace, pairs, archive, failure_feats
+            )
+        else:
+            new_pop, fit, bd, bf = sharded_fault(
+                key, state.pop, trace, pairs, archive, failure_feats, coin
+            )
         improved = fit > state.best_fitness
         return IslandState(
             pop=new_pop,

@@ -135,6 +135,15 @@ class TPUSearchPolicy(QueueBackedPolicy):
             p("reorder_window", self.reorder_window * 1000))
         self.reorder_gap = parse_duration(
             p("reorder_gap", self.reorder_gap * 1000))
+        if self.release_mode == "reorder" and self.reorder_window <= 0:
+            # window=0 would mean "one global window" to the scorer but a
+            # busy-spinning, continuously-draining loop to the control
+            # plane — maximal scored/executed disagreement plus a pegged
+            # CPU. Fail fast like the other enum knobs.
+            raise ValueError(
+                "reorder_window must be > 0 in reorder mode "
+                f"(got {self.reorder_window})"
+            )
         name = str(p("proc_policy", self.proc_policy_name))
         self.proc_policy_name = name
         self._proc_policy = create_proc_subpolicy(name, self._rng)
@@ -155,11 +164,15 @@ class TPUSearchPolicy(QueueBackedPolicy):
         faults = self._faults
         if faults is None or self.max_fault <= 0:
             return False
-        p = float(faults[self._bucket(hint)])
+        bucket = self._bucket(hint)
+        p = float(faults[bucket])
         if p <= 0:
             return False
-        # deterministic coin: same (seed, hint) => same decision
-        coin = fnv64a(f"{self.seed}|fault|{hint}".encode()) % 10_000 / 10_000.0
+        # deterministic per-BUCKET coin — the exact formula the scorer's
+        # drop_mask uses (ops/trace_encoding.py fault_coin), so the
+        # replayed drops are the drops the schedule was scored with
+        coin = (fnv64a(f"{self.seed}|fault|{bucket}".encode())
+                % 10_000 / 10_000.0)
         return coin < p
 
     def queue_event(self, event: Event) -> None:
@@ -277,9 +290,35 @@ class TPUSearchPolicy(QueueBackedPolicy):
             import jax
 
             initialize_from_env()
-            # honor the `devices` knob (same subset the flat path uses)
-            devs = (jax.devices()[: self.n_devices]
-                    if self.n_devices is not None else None)
+            # honor the `devices` knob (same subset the flat path uses);
+            # in a multi-process run slice per process — a flat
+            # jax.devices()[:n] can take 4 chips from host 0 and 2 from
+            # host 1, which make_hybrid_mesh would (rightly) reject
+            devs = None
+            if self.n_devices is not None:
+                pc = jax.process_count()
+                if pc > 1:
+                    if self.n_devices % pc != 0:
+                        raise ValueError(
+                            f"devices={self.n_devices} must divide evenly "
+                            f"across {pc} processes"
+                        )
+                    per = self.n_devices // pc
+                    by_proc: dict = {}
+                    for d in sorted(jax.devices(),
+                                    key=lambda d: (d.process_index, d.id)):
+                        by_proc.setdefault(d.process_index, []).append(d)
+                    short = {p: len(ds) for p, ds in by_proc.items()
+                             if len(ds) < per}
+                    if short:
+                        raise ValueError(
+                            f"devices={self.n_devices} needs {per} chips "
+                            f"per process but some have fewer: {short}"
+                        )
+                    devs = [d for p in sorted(by_proc)
+                            for d in by_proc[p][:per]]
+                else:
+                    devs = jax.devices()[: self.n_devices]
             mesh = make_hybrid_mesh(n_hosts=self.dcn_hosts, devices=devs)
         if self.search_backend == "mcts":
             if self.surrogate_topk > 0:

@@ -93,27 +93,34 @@ def test_dcn_migration_transports_elites():
     host 0's first island must appear on host 1's same-chip island via the
     DCN ring (mesh 4x2 -> islands are row-major, island 2 = (h=1, i=0)).
     With mutation/crossover off, island 0's offspring are all copies of
-    the marker, so the migrated payload is exact."""
+    the marker, so the migrated payload is exact. Migration sends the
+    island's elite rows (new_pop[:kk]) and lands them in the neighbor's
+    *tail* rows, so the neighbor's own preserved elites survive."""
     mesh = make_hybrid_mesh(n_hosts=4)
     cfg = GAConfig(max_delay=0.05, mutation_rate=0.0, crossover_rate=0.0)
     trace, pairs, archive, failures = inputs()
     step = make_hier_island_step(mesh, cfg, ScoreWeights(),
                                  migrate_k=0, dcn_migrate_k=2)
-    state = init_island_state(jax.random.PRNGKey(2), 64, H, cfg)
+    # 256 total / 8 islands = 32 rows per island -> n_elite = 2
+    state = init_island_state(jax.random.PRNGKey(2), 256, H, cfg)
     marker = 0.0123
-    pinned = state.pop.delays.at[:8].set(marker)
+    pinned = state.pop.delays.at[:32].set(marker)
     state = state._replace(pop=state.pop._replace(delays=pinned))
     state = step(state, jax.random.PRNGKey(3), trace, pairs, archive,
                  failures)
     d = np.asarray(state.pop.delays)
     is_marker = np.all(np.abs(d - marker) < 1e-7, axis=1)
-    # island 2 (rows 16..23) received dcn_migrate_k marker rows
-    assert is_marker[16:24].sum() == 2, (
+    # island 2 (rows 64..96) received dcn_migrate_k marker rows...
+    assert is_marker[64:96].sum() == 2, (
         f"expected 2 migrated marker rows on host 1, got "
-        f"{is_marker[16:24].sum()}"
+        f"{is_marker[64:96].sum()}"
     )
+    # ...landed in the island's tail rows (offspring region), leaving the
+    # island's own elite slots (local rows [0:2)) untouched
+    assert is_marker[94:96].all()
+    assert not is_marker[64:66].any()
     # no other host received markers in one step (ring topology)
-    assert is_marker[24:].sum() == 0
+    assert is_marker[96:].sum() == 0
 
 
 def test_migration_k_clamped_to_island_population():

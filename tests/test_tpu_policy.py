@@ -110,3 +110,19 @@ def test_delay_lookup_deterministic(tmp_path):
     assert 0 <= d1 < 0.03
     assert d1 != d3
     policy.shutdown()
+
+
+def test_reorder_window_zero_rejected(tmp_path):
+    """window=0 means 'one global window' to the scorer but a busy-spin
+    continuous drain to the control plane — must fail fast."""
+    policy = create_policy("tpu_search")
+    with pytest.raises(ValueError, match="reorder_window"):
+        policy.load_config(small_cfg(tmp_path, {
+            "release_mode": "reorder", "reorder_window": 0,
+        }))
+    # delay mode doesn't care about the window knob
+    policy2 = create_policy("tpu_search")
+    policy2.load_config(small_cfg(tmp_path, {
+        "release_mode": "delay", "reorder_window": 0,
+        "search_on_start": False,
+    }))
