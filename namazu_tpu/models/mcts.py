@@ -163,6 +163,14 @@ def mcts_search(
     coin: jax.Array | None = None,  # f32[H] deterministic fault coin
 ) -> MCTSResult:
     """Run one full MCTS; pure function of its inputs (jit-safe)."""
+    if coin is None and cfg.max_fault > 0:
+        # without the coin the rollout fault tables would be returned
+        # unscored — the round-1 bug config 4 fixes. Guarded here (not
+        # just in make_parallel_mcts) so every public entry enforces it.
+        raise ValueError(
+            "fault search is enabled (max_fault > 0) but no fault coin "
+            "was passed; build one with trace_encoding.fault_coin(seed, H)"
+        )
     D, Td = cfg.n_levels, cfg.tree_depth
     level_values = jnp.linspace(0.0, cfg.max_delay, D).astype(jnp.float32)
     rollout = _make_rollout(trace, pairs, archive, failure_feats,
@@ -334,8 +342,8 @@ def make_parallel_mcts(mesh, H: int, cfg: MCTSConfig = MCTSConfig(),
             )
         if coin is None:
             if cfg.max_fault > 0:
-                # without the coin the rollout fault tables would be
-                # returned unscored — the round-1 bug config 4 fixes
+                # mcts_search would raise the same error, but only after
+                # the ones-substitution below had masked it — check first
                 raise ValueError(
                     "fault search is enabled (max_fault > 0) but no "
                     "fault coin was passed; build one with "

@@ -165,6 +165,41 @@ def precedence_features(
     return jax.nn.sigmoid(z)
 
 
+def _genome_features(
+    delays: jax.Array, trace: TraceArrays, pairs: jax.Array, tau: float,
+    order_mode: bool = False, order_gap: float = 0.001,
+    order_window: float = 0.0,
+    faults: Optional[jax.Array] = None,
+    coin: Optional[jax.Array] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """(features f32[K], dropped-event count i32) for one genome.
+
+    Delay-mode traces longer than ``LONG_TRACE_THRESHOLD`` take the
+    blockwise scan (bounded memory under a population vmap — no [P, L]
+    intermediates); everything else takes the dense path. The dispatch is
+    on static shape, so each jit specialization compiles exactly one
+    branch."""
+    H = delays.shape[0]
+    L = trace.hint_ids.shape[-1]
+    if not order_mode and L > LONG_TRACE_THRESHOLD:
+        first, ndrop = first_occurrence_blockwise(
+            delays, trace.hint_ids, trace.arrival, trace.mask,
+            faults=faults, coin=coin,
+        )
+        return precedence_features(first, pairs, tau), ndrop
+    eff = apply_faults(trace, faults, coin)
+    if faults is None:
+        ndrop = jnp.zeros((), jnp.int32)
+    else:
+        ndrop = (jnp.sum(trace.mask) - jnp.sum(eff.mask)).astype(jnp.int32)
+    if order_mode:
+        t = order_release_times(delays, eff, order_gap, order_window)
+    else:
+        t = release_times(delays, eff)
+    first = first_occurrence(t, eff, H)
+    return precedence_features(first, pairs, tau), ndrop
+
+
 def schedule_features(
     delays: jax.Array, trace: TraceArrays, pairs: jax.Array, tau: float,
     order_mode: bool = False, order_gap: float = 0.001,
@@ -178,14 +213,9 @@ def schedule_features(
     the per-bucket ``coin``) are given, fault-dropped events vanish from
     the counterfactual before first-occurrence — the fault half of the
     genome shapes the features (BASELINE config 4)."""
-    H = delays.shape[0]
-    trace = apply_faults(trace, faults, coin)
-    if order_mode:
-        t = order_release_times(delays, trace, order_gap, order_window)
-    else:
-        t = release_times(delays, trace)
-    first = first_occurrence(t, trace, H)
-    return precedence_features(first, pairs, tau)
+    feats, _ = _genome_features(delays, trace, pairs, tau, order_mode,
+                                order_gap, order_window, faults, coin)
+    return feats
 
 
 def trace_features(
@@ -247,28 +277,26 @@ def score_population(
     With ``faults``/``coin``, the genome's fault half is part of the
     counterfactual: dropped events reshape the features, and a
     ``fault_cost`` per dropped event keeps "drop everything" from being
-    the novelty optimum."""
+    the novelty optimum. Long delay-mode traces score blockwise (see
+    :func:`_genome_features`)."""
     if faults is None:
-        feats = jax.vmap(
-            lambda d: schedule_features(d, trace, pairs, weights.tau,
-                                        weights.order_mode,
-                                        weights.order_gap,
-                                        weights.order_window)
+        feats, _ = jax.vmap(
+            lambda d: _genome_features(d, trace, pairs, weights.tau,
+                                       weights.order_mode,
+                                       weights.order_gap,
+                                       weights.order_window)
         )(delays)
         fault_pen = 0.0
     else:
-        feats = jax.vmap(
-            lambda d, f: schedule_features(d, trace, pairs, weights.tau,
-                                           weights.order_mode,
-                                           weights.order_gap,
-                                           weights.order_window,
-                                           faults=f, coin=coin)
+        feats, ndrop = jax.vmap(
+            lambda d, f: _genome_features(d, trace, pairs, weights.tau,
+                                          weights.order_mode,
+                                          weights.order_gap,
+                                          weights.order_window,
+                                          faults=f, coin=coin)
         )(delays, faults)
-        dropped = jax.vmap(lambda f: drop_mask(f, coin, trace))(faults)
         live = jnp.maximum(jnp.sum(trace.mask), 1)
-        fault_pen = weights.fault_cost * (
-            jnp.sum(dropped, axis=-1) / live
-        )
+        fault_pen = weights.fault_cost * ndrop / live
     novelty = _min_sq_distance_best(feats, archive)
     bug = -_min_sq_distance_best(feats, failure_feats)
     delay_cost = jnp.mean(delays, axis=-1)
@@ -310,24 +338,28 @@ def score_population_multi(
     transfers. Returns (fitness f32[P], feats f32[P, T, K]).
     """
     def per_trace(tr: TraceArrays):
+        """(feats [P, K], drop fraction [P]) against one trace."""
         if faults is None:
-            return jax.vmap(
-                lambda d: schedule_features(d, tr, pairs, weights.tau,
-                                            weights.order_mode,
-                                            weights.order_gap,
-                                            weights.order_window)
-            )(delays)  # [P, K]
-        return jax.vmap(
-            lambda d, f: schedule_features(d, tr, pairs, weights.tau,
+            f, _ = jax.vmap(
+                lambda d: _genome_features(d, tr, pairs, weights.tau,
+                                           weights.order_mode,
+                                           weights.order_gap,
+                                           weights.order_window)
+            )(delays)
+            return f, jnp.zeros((delays.shape[0],), jnp.float32)
+        f, ndrop = jax.vmap(
+            lambda d, ft: _genome_features(d, tr, pairs, weights.tau,
                                            weights.order_mode,
                                            weights.order_gap,
                                            weights.order_window,
-                                           faults=f, coin=coin)
-        )(delays, faults)  # [P, K]
+                                           faults=ft, coin=coin)
+        )(delays, faults)
+        live = jnp.maximum(jnp.sum(tr.mask), 1)
+        return f, ndrop / live
 
-    feats = jax.vmap(
+    feats, frac = jax.vmap(
         lambda h, a, m: per_trace(TraceArrays(h, a, m))
-    )(traces.hint_ids, traces.arrival, traces.mask)  # [T, P, K]
+    )(traces.hint_ids, traces.arrival, traces.mask)  # [T, P, K], [T, P]
     feats = jnp.swapaxes(feats, 0, 1)  # [P, T, K]
     P, T, K = feats.shape
     flat = feats.reshape(P * T, K)
@@ -335,18 +367,8 @@ def score_population_multi(
     bug = -_min_sq_distance_best(flat, failure_feats).reshape(P, T).mean(
         axis=1)
     delay_cost = jnp.mean(delays, axis=-1)
-    if faults is None:
-        fault_pen = 0.0
-    else:
-        def per_trace_drop(tr: TraceArrays):
-            dropped = jax.vmap(lambda f: drop_mask(f, coin, tr))(faults)
-            live = jnp.maximum(jnp.sum(tr.mask), 1)
-            return jnp.sum(dropped, axis=-1) / live  # [P]
-
-        frac = jax.vmap(
-            lambda h, a, m: per_trace_drop(TraceArrays(h, a, m))
-        )(traces.hint_ids, traces.arrival, traces.mask)  # [T, P]
-        fault_pen = weights.fault_cost * frac.mean(axis=0)
+    fault_pen = (0.0 if faults is None
+                 else weights.fault_cost * frac.mean(axis=0))
     fitness = (
         weights.novelty * novelty
         + weights.bug * bug
@@ -358,21 +380,32 @@ def score_population_multi(
 
 # -- long traces: blockwise first-occurrence --------------------------------
 
+# delay-mode traces longer than this are scored blockwise; below it the
+# dense path is cheaper (one fused gather + scatter-min). Order mode
+# always scores dense: a windowed permutation needs the whole trace in
+# one lexsort.
+LONG_TRACE_THRESHOLD = 1024
+LONG_TRACE_CHUNK = 512
+
 
 def first_occurrence_blockwise(
     delays: jax.Array,  # [H]
     hint_ids: jax.Array,  # [L], any length (padded internally)
     arrival: jax.Array,  # [L]
     mask: jax.Array,  # [L]
-    chunk: int = 512,
-) -> jax.Array:
-    """First-occurrence times over an arbitrarily long trace via lax.scan.
+    chunk: int = LONG_TRACE_CHUNK,
+    faults: Optional[jax.Array] = None,  # [H]
+    coin: Optional[jax.Array] = None,  # [H]
+) -> tuple[jax.Array, jax.Array]:
+    """(first-occurrence times f32[H], dropped-event count i32) over an
+    arbitrarily long trace via lax.scan.
 
     min is associative, so the [H] running minimum is a scan carry and the
     peak live buffer is one [chunk] block instead of the whole trace —
     the long-sequence analogue of blockwise attention for this workload
     (SURVEY.md section 5.7: schedule genomes over long event traces are
-    this framework's long sequences).
+    this framework's long sequences). Fault drops are applied per chunk so
+    a vmapped population never materialises a [P, L] drop mask.
     """
     H = delays.shape[0]
     L = hint_ids.shape[0]
@@ -382,14 +415,19 @@ def first_occurrence_blockwise(
     arrival = jnp.pad(arrival, (0, pad))
     mask = jnp.pad(mask, (0, pad))
 
-    def step(first, blk):
+    def step(carry, blk):
+        first, ndrop = carry
         h, a, m = blk
+        if faults is not None:
+            drop = m & (coin[h] < faults[h])
+            m = m & ~drop
+            ndrop = ndrop + jnp.sum(drop)
         t = jnp.where(m, a + delays[h], BIG)
         first = first.at[h].min(t)
-        return first, None
+        return (first, ndrop), None
 
-    init = jnp.full((H,), BIG, jnp.float32)
-    first, _ = jax.lax.scan(
+    init = (jnp.full((H,), BIG, jnp.float32), jnp.zeros((), jnp.int32))
+    (first, ndrop), _ = jax.lax.scan(
         step,
         init,
         (
@@ -398,16 +436,19 @@ def first_occurrence_blockwise(
             mask.reshape(n_chunks, chunk),
         ),
     )
-    return first
+    return first, ndrop
 
 
 def schedule_features_long(
     delays: jax.Array, trace: TraceArrays, pairs: jax.Array, tau: float,
-    chunk: int = 512,
+    chunk: int = LONG_TRACE_CHUNK,
+    faults: Optional[jax.Array] = None,
+    coin: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Feature vector for long traces (thousands of events) with bounded
     memory; numerically identical to :func:`schedule_features`."""
-    first = first_occurrence_blockwise(
-        delays, trace.hint_ids, trace.arrival, trace.mask, chunk
+    first, _ = first_occurrence_blockwise(
+        delays, trace.hint_ids, trace.arrival, trace.mask, chunk,
+        faults=faults, coin=coin,
     )
     return precedence_features(first, pairs, tau)

@@ -25,9 +25,21 @@ import numpy as np
 from namazu_tpu.policy.replayable import fnv64a
 from namazu_tpu.utils.trace import SingleTrace
 
-DEFAULT_L = 256  # max events per encoded trace
+DEFAULT_L = 256  # default length quantum for encoded traces
 DEFAULT_H = 256  # hint buckets (genome length)
 DEFAULT_K = 256  # precedence pairs (feature dimension)
+
+# encoded lengths are rounded up to a multiple of this so XLA sees a
+# handful of static shapes instead of one per run length
+L_QUANTUM = 128
+
+
+def _auto_length(n: int) -> int:
+    """Padded length for an n-event trace: next multiple of L_QUANTUM,
+    at least one quantum. No truncation — a real ZooKeeper run produces
+    thousands of packet events and the search must see all of them
+    (long traces score blockwise, ops/schedule.py)."""
+    return max(L_QUANTUM, -(-n // L_QUANTUM) * L_QUANTUM)
 
 
 def hint_bucket(hint: str, n_buckets: int = DEFAULT_H) -> int:
@@ -53,11 +65,12 @@ class EncodedTrace:
     """One trace in array form (plain numpy; converted to jnp at the device
     boundary)."""
 
-    def __init__(self, hint_ids, entity_ids, arrival, mask):
+    def __init__(self, hint_ids, entity_ids, arrival, mask, truncated=0):
         self.hint_ids = np.asarray(hint_ids, np.int32)
         self.entity_ids = np.asarray(entity_ids, np.int32)
         self.arrival = np.asarray(arrival, np.float32)
         self.mask = np.asarray(mask, bool)
+        self.truncated = int(truncated)  # events beyond an explicit L cap
 
     @property
     def length(self) -> int:
@@ -66,7 +79,7 @@ class EncodedTrace:
 
 def encode_trace(
     trace: SingleTrace,
-    L: int = DEFAULT_L,
+    L: Optional[int] = None,
     H: int = DEFAULT_H,
     entity_index: Optional[Dict[str, int]] = None,
 ) -> EncodedTrace:
@@ -76,8 +89,15 @@ def encode_trace(
     ``Action.for_event``) is the semantic identity; actions recorded
     without one (e.g. traces from before a semantic parser was attached)
     fall back to cause-event class + entity.
+
+    ``L=None`` (default) sizes the arrays to the whole trace — nothing is
+    ever silently dropped. An explicit ``L`` is a hard cap for callers
+    that want to bound device memory; events past it are truncated (the
+    returned ``EncodedTrace.truncated`` says how many).
     """
     entity_index = entity_index if entity_index is not None else {}
+    if L is None:
+        L = _auto_length(len(trace))
     hint_ids = np.zeros(L, np.int32)
     entity_ids = np.zeros(L, np.int32)
     arrival = np.zeros(L, np.float32)
@@ -100,18 +120,21 @@ def encode_trace(
         entity_ids[i] = entity_index[ent]
         arrival[i] = (times[i] - t0) if times[i] else i * 1e-3
         mask[i] = True
-    return EncodedTrace(hint_ids, entity_ids, arrival, mask)
+    return EncodedTrace(hint_ids, entity_ids, arrival, mask,
+                        truncated=max(0, len(trace) - L))
 
 
 def encode_event_stream(
     hints: Sequence[str],
     arrivals: Optional[Sequence[float]] = None,
     entities: Optional[Sequence[str]] = None,
-    L: int = DEFAULT_L,
+    L: Optional[int] = None,
     H: int = DEFAULT_H,
 ) -> EncodedTrace:
     """Encode a live event stream (the TPU policy's view of the current
-    run) from raw replay hints."""
+    run) from raw replay hints. ``L=None`` sizes to the whole stream."""
+    if L is None:
+        L = _auto_length(len(hints))
     n = min(len(hints), L)
     hint_ids = np.zeros(L, np.int32)
     entity_ids = np.zeros(L, np.int32)
@@ -127,7 +150,8 @@ def encode_event_stream(
             entity_ids[i] = ent_index[e]
         arrival[i] = arrivals[i] if arrivals is not None else i * 1e-3
         mask[i] = True
-    return EncodedTrace(hint_ids, entity_ids, arrival, mask)
+    return EncodedTrace(hint_ids, entity_ids, arrival, mask,
+                        truncated=max(0, len(hints) - L))
 
 
 def sample_pairs(
@@ -144,10 +168,20 @@ def sample_pairs(
 
 
 def stack_traces(traces: Sequence[EncodedTrace]) -> Tuple[np.ndarray, ...]:
-    """Stack encoded traces into batched arrays [T, L]."""
+    """Stack encoded traces into batched arrays [T, L], right-padding
+    ragged lengths to the longest (auto-length encodes make ragged
+    batches the normal case)."""
+    L = max(t.hint_ids.shape[0] for t in traces)
+
+    def pad(a, fill=0):
+        n = L - a.shape[0]
+        if n == 0:
+            return a
+        return np.concatenate([a, np.full((n,), fill, a.dtype)])
+
     return (
-        np.stack([t.hint_ids for t in traces]),
-        np.stack([t.entity_ids for t in traces]),
-        np.stack([t.arrival for t in traces]),
-        np.stack([t.mask for t in traces]),
+        np.stack([pad(t.hint_ids) for t in traces]),
+        np.stack([pad(t.entity_ids) for t in traces]),
+        np.stack([pad(t.arrival) for t in traces]),
+        np.stack([pad(t.mask, False) for t in traces]),
     )

@@ -48,7 +48,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.generations = 64
         self.population = 4096
         self.H = 256
-        self.L = 256
+        self.L = 0  # trace-length cap; 0 = encode full traces (no drop)
         self.K = 256
         self.migrate_k = 8
         self.n_devices: Optional[int] = None
@@ -84,6 +84,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
         # installed schedule tables (numpy arrays; rebinding is atomic)
         self._delays = None
         self._faults = None
+        self._fault_coin = None  # cached per-(seed, H), see _coin_table
         self._search = None
         self._search_thread: Optional[threading.Thread] = None
         self._search_lock = threading.Lock()
@@ -94,6 +95,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
         p = config.policy_param
         self.seed = int(p("seed", 0))
         self._rng.seed(self.seed)
+        self._fault_coin = None  # seed/H may change below
         self.max_interval = parse_duration(p("max_interval", 100))
         self.generations = int(p("generations", self.generations))
         self.population = int(p("population", self.population))
@@ -160,6 +162,19 @@ class TPUSearchPolicy(QueueBackedPolicy):
             return hint_delay(str(self.seed), hint, self.max_interval)
         return float(delays[self._bucket(hint)])
 
+    def _coin_table(self):
+        """Per-bucket fault coin, computed once per (seed, H) — the SAME
+        array the scorer's drop_mask uses (one source of truth in
+        ops/trace_encoding.fault_coin), so the replayed drops are the
+        drops the schedule was scored with, and the hot path pays one
+        table lookup instead of a string hash per event."""
+        cached = self._fault_coin
+        if cached is None or cached.shape[0] != self.H:
+            from namazu_tpu.ops.trace_encoding import fault_coin
+
+            cached = self._fault_coin = fault_coin(self.seed, self.H)
+        return cached
+
     def _fault_for(self, hint: str) -> bool:
         faults = self._faults
         if faults is None or self.max_fault <= 0:
@@ -168,12 +183,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
         p = float(faults[bucket])
         if p <= 0:
             return False
-        # deterministic per-BUCKET coin — the exact formula the scorer's
-        # drop_mask uses (ops/trace_encoding.py fault_coin), so the
-        # replayed drops are the drops the schedule was scored with
-        coin = (fnv64a(f"{self.seed}|fault|{bucket}".encode())
-                % 10_000 / 10_000.0)
-        return coin < p
+        return float(self._coin_table()[bucket]) < p
 
     def queue_event(self, event: Event) -> None:
         self.start()
@@ -376,6 +386,11 @@ class TPUSearchPolicy(QueueBackedPolicy):
             log.exception("schedule search failed; hash-based delays remain")
 
     MAX_REFERENCE_TRACES = 4
+    # order mode scores dense (a windowed permutation needs the whole
+    # trace in one lexsort — ops/schedule.py), so uncapped encoding would
+    # materialize [population, L] intermediates per generation; cap the
+    # encoded length in reorder mode unless the user set one explicitly
+    ORDER_MODE_MAX_L = 4096
 
     def _ingest_history(self, search):
         """Feed stored traces into the archives; return the reference
@@ -397,7 +412,20 @@ class TPUSearchPolicy(QueueBackedPolicy):
                 ok = storage.is_successful(i)
             except Exception:
                 continue
-            enc = te.encode_trace(trace, L=self.L, H=self.H)
+            if self.L > 0:
+                cap = self.L
+            elif self.release_mode == "reorder":
+                cap = self.ORDER_MODE_MAX_L
+            else:
+                cap = None  # delay mode scores long traces blockwise
+            enc = te.encode_trace(trace, L=cap, H=self.H)
+            if enc.truncated:
+                log.warning(
+                    "trace %d truncated: %d events beyond the L=%d cap "
+                    "were dropped from scoring (%s)",
+                    i, enc.truncated, cap,
+                    "configured trace_length" if self.L > 0
+                    else "order-mode memory bound")
             # "failure" = the run reproduced the bug (validate failed);
             # the label feeds the surrogate's training set
             search.add_executed_trace(enc, reproduced=not ok)

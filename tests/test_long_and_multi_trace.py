@@ -66,9 +66,10 @@ def test_blockwise_first_occurrence_matches_dense():
     delays = jnp.asarray(
         np.random.RandomState(3).rand(H).astype(np.float32) * 0.05)
     dense = first_occurrence(release_times(delays, tr), tr, H)
-    block = first_occurrence_blockwise(
+    block, ndrop = first_occurrence_blockwise(
         delays, tr.hint_ids, tr.arrival, tr.mask, chunk=64)
     assert np.allclose(np.asarray(dense), np.asarray(block))
+    assert int(ndrop) == 0
 
 
 def test_long_trace_features_match_dense_and_scale():
@@ -110,3 +111,114 @@ def test_search_driver_accepts_trace_list(tmp_path):
     search.add_failure_trace(t1)
     best = search.run([t1, t2], generations=3)
     assert np.isfinite(best.fitness)
+
+
+def test_encode_auto_length_no_truncation():
+    """L=None (the new default) sizes arrays to the whole stream; an
+    explicit cap truncates and reports how much it dropped."""
+    hints = [f"h{i % 7}" for i in range(3000)]
+    e = te.encode_event_stream(hints, H=H)
+    assert e.length == 3000
+    assert e.truncated == 0
+    assert e.hint_ids.shape[0] >= 3000
+    assert e.hint_ids.shape[0] % te.L_QUANTUM == 0
+    e2 = te.encode_event_stream(hints, L=256, H=H)
+    assert e2.length == 256
+    assert e2.truncated == 3000 - 256
+
+
+def test_stack_traces_pads_ragged():
+    a = te.encode_event_stream([f"a{i}" for i in range(100)], H=H)
+    b = te.encode_event_stream([f"b{i}" for i in range(300)], H=H)
+    h, _, arr, m = te.stack_traces([a, b])
+    assert h.shape == m.shape == (2, max(a.hint_ids.shape[0],
+                                         b.hint_ids.shape[0]))
+    assert m[0].sum() == 100 and m[1].sum() == 300
+
+
+def test_long_trace_population_scoring_matches_dense():
+    """score_population's automatic blockwise branch (L > threshold) is
+    numerically identical to the dense scatter-min reference."""
+    from namazu_tpu.ops.schedule import LONG_TRACE_THRESHOLD
+    n = LONG_TRACE_THRESHOLD + 600
+    e = te.encode_event_stream([f"h{i % 19}" for i in range(n)], H=H)
+    tr = as_arrays(e)
+    assert tr.hint_ids.shape[0] > LONG_TRACE_THRESHOLD
+    pairs = jnp.asarray(te.sample_pairs(K, H, 0))
+    archive = jnp.asarray(
+        np.random.RandomState(0).rand(8, K).astype(np.float32))
+    fails = jnp.asarray(
+        np.random.RandomState(1).rand(4, K).astype(np.float32))
+    delays = jnp.asarray(
+        np.random.RandomState(2).rand(8, H).astype(np.float32) * 0.05)
+    fit, feats = score_population(delays, tr, pairs, archive, fails,
+                                  ScoreWeights())
+    # dense reference, genome by genome
+    from namazu_tpu.ops.schedule import precedence_features
+    for p in range(8):
+        dense_first = first_occurrence(
+            release_times(delays[p], tr), tr, H)
+        ref = precedence_features(dense_first, pairs, 0.005)
+        assert np.allclose(np.asarray(feats[p]), np.asarray(ref),
+                           atol=1e-6)
+
+
+def test_blockwise_applies_faults_per_chunk():
+    n = 1500
+    e = te.encode_event_stream([f"h{i % 11}" for i in range(n)], H=H)
+    tr = as_arrays(e)
+    coin = jnp.asarray(te.fault_coin(0, H))
+    bucket = te.hint_bucket("h3", H)
+    faults = jnp.zeros(H).at[bucket].set(float(coin[bucket]) + 1e-3)
+    delays = jnp.zeros(H)
+    block, ndrop = first_occurrence_blockwise(
+        delays, tr.hint_ids, tr.arrival, tr.mask, chunk=256,
+        faults=faults, coin=coin)
+    n_bucket = int((np.asarray(tr.hint_ids)[np.asarray(tr.mask)]
+                    == bucket).sum())
+    assert int(ndrop) == n_bucket > 0
+    assert float(block[bucket]) > 1e8  # dropped bucket never occurs
+
+
+def test_bug_planted_past_event_256_is_visible_and_findable():
+    """Regression for the round-1 silent truncation at L=256: a decisive
+    hint that first occurs around event ~1500 must still steer the
+    search."""
+    n = 2000
+    hints = [f"h{i % 9}" for i in range(n)]
+    for j in range(1500, 1520):
+        hints[j] = "late-bug"
+    e = te.encode_event_stream(hints, H=H)
+    assert e.truncated == 0
+    tr = as_arrays(e)
+    pairs = jnp.asarray(te.sample_pairs(K, H, 0))
+    late = te.hint_bucket("late-bug", H)
+
+    # visibility: delaying only the late bucket must change the features
+    f0 = schedule_features(jnp.zeros(H), tr, pairs, 0.005)
+    f1 = schedule_features(jnp.zeros(H).at[late].set(0.5), tr, pairs,
+                           0.005)
+    assert not np.allclose(np.asarray(f0), np.asarray(f1))
+
+    # findability: target reachable only by delaying the late bucket
+    from namazu_tpu.models.ga import ga_generation, init_population
+    target = schedule_features(jnp.zeros(H).at[late].set(0.5), tr, pairs,
+                               0.005)[None]
+    archive = jnp.full((1, K), 0.5)
+    w = ScoreWeights(novelty=0.0, bug=1.0, delay_cost=0.0)
+    cfg = GAConfig(max_delay=0.5, mutation_sigma=0.05)
+    pop = init_population(jax.random.PRNGKey(1), 128, H, cfg)
+    key = jax.random.PRNGKey(2)
+    fit0 = None
+    for _ in range(12):
+        fit, _ = score_population(pop.delays, tr, pairs, archive, target,
+                                  w)
+        if fit0 is None:
+            fit0 = float(fit.max())
+        key, k = jax.random.split(key)
+        pop = ga_generation(k, pop, fit, cfg)
+    fit, _ = score_population(pop.delays, tr, pairs, archive, target, w)
+    assert float(fit.max()) > fit0 + 1e-3
+    best = np.asarray(pop.delays[int(jnp.argmax(fit))])
+    # the winning genome delays the late bucket substantially
+    assert best[late] > 0.1
