@@ -21,7 +21,8 @@ from namazu_tpu.ops.schedule import ScoreWeights
 
 class SearchConfig(NamedTuple):
     H: int = te.DEFAULT_H  # hint buckets (genome length)
-    L: int = te.DEFAULT_L  # max trace length
+    L: int = te.DEFAULT_L  # encode-length cap hint; 0 = uncapped (the
+    # driver encodes before calling run(), so this field is informational)
     K: int = te.DEFAULT_K  # feature pairs
     archive_size: int = 512  # novelty archive capacity
     failure_size: int = 64  # failure archive capacity

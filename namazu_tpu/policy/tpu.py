@@ -66,11 +66,18 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.release_mode = "delay"
         self.reorder_window = 0.05
         self.reorder_gap = 0.002
-        self._pending: list = []  # (prio, seq, event) under _pending_lock
+        # (prio, seq, t_arrive, event) under _pending_lock
+        self._pending: list = []
         self._pending_lock = threading.Lock()
         self._pending_seq = 0
         self._reorder_thread: Optional[threading.Thread] = None
         self._stop_reorder = threading.Event()
+        # window clock anchor = monotonic arrival of the FIRST queued
+        # event; the scorer anchors windows at the trace's first arrival
+        # (ops/schedule.py order_release_times), so both planes cut
+        # window boundaries at the same offsets
+        self._anchor: Optional[float] = None
+        self._anchor_set = threading.Event()
         self.mcts_simulations = 256
         self.mcts_tree_depth = 24
         self.mcts_levels = 8
@@ -200,8 +207,12 @@ class TPUSearchPolicy(QueueBackedPolicy):
                 self._emit(self._action_for(event))
                 return
             prio = self._delay_for(event.replay_hint())
+            now = time.monotonic()
             with self._pending_lock:
-                self._pending.append((prio, self._pending_seq, event))
+                if self._anchor is None:
+                    self._anchor = now
+                    self._anchor_set.set()
+                self._pending.append((prio, self._pending_seq, now, event))
                 self._pending_seq += 1
             if self._stop_reorder.is_set():
                 # shutdown flushed between our check and the append —
@@ -234,11 +245,32 @@ class TPUSearchPolicy(QueueBackedPolicy):
 
     # -- reorder window ---------------------------------------------------
 
-    def _drain_pending(self, gap: float) -> None:
+    def _drain_pending(self, gap: float,
+                       boundary: Optional[float] = None) -> None:
+        """Release pending events whose window has closed.
+
+        ``boundary`` (monotonic time) limits the drain to events that
+        arrived before it — i.e. to *closed* windows only; ``None`` takes
+        everything (shutdown flush). The batch is released in
+        (window, priority, arrival) order: exactly the permutation the
+        scorer's ``order_release_times`` assigns to these arrivals, so the
+        realized interleaving IS the scored one."""
+        anchor, w = self._anchor, self.reorder_window
         with self._pending_lock:
-            batch, self._pending = self._pending, []
-        batch.sort()  # (priority, arrival seq) — the scored permutation
-        for i, (_prio, _seq, event) in enumerate(batch):
+            if boundary is None:
+                batch, self._pending = self._pending, []
+            else:
+                batch = [p for p in self._pending if p[2] < boundary]
+                self._pending = [p for p in self._pending
+                                 if p[2] >= boundary]
+
+        def win(t: float) -> int:
+            if anchor is None or w <= 0:
+                return 0
+            return int((t - anchor) // w)
+
+        batch.sort(key=lambda p: (win(p[2]), p[0], p[1]))
+        for i, (_prio, _seq, _t, event) in enumerate(batch):
             # during shutdown, stop pacing so a large in-flight batch
             # cannot outlive the join window and lose its tail
             if i and gap > 0 and not self._stop_reorder.is_set():
@@ -246,8 +278,24 @@ class TPUSearchPolicy(QueueBackedPolicy):
             self._emit(self._action_for(event))
 
     def _reorder_loop(self) -> None:
-        while not self._stop_reorder.wait(self.reorder_window):
-            self._drain_pending(self.reorder_gap)
+        """Tick at absolute window boundaries ``anchor + k*window`` and
+        release only the windows that closed — not whatever happens to be
+        pending at wake-up, which would batch events across the scorer's
+        window boundaries."""
+        w = self.reorder_window
+        # phase 1: wait for the first event to anchor the window clock
+        while not self._stop_reorder.is_set():
+            if self._anchor_set.wait(timeout=0.05):
+                break
+        # phase 2: aligned ticks
+        while not self._stop_reorder.is_set():
+            anchor = self._anchor
+            now = time.monotonic()
+            k = int((now - anchor) // w) + 1
+            if self._stop_reorder.wait(max(0.0, anchor + k * w - now)):
+                break
+            self._drain_pending(self.reorder_gap,
+                                boundary=anchor + k * w)
 
     def _build_search(self):
         from namazu_tpu.models.ga import GAConfig

@@ -225,3 +225,64 @@ def test_release_mode_validation():
             "explore_policy": "tpu_search",
             "explore_policy_param": {"release_mode": "bogus"},
         }))
+
+
+def test_policy_realized_order_equals_scored_order():
+    """Crafted arrival pattern through a real orchestrator: the realized
+    release order must equal the permutation order_release_times scores
+    for the same arrivals — including the window boundary (co-window
+    events permute, cross-window events do not)."""
+    from namazu_tpu.orchestrator import Orchestrator
+    from namazu_tpu.inspector.transceiver import new_transceiver
+    from namazu_tpu.policy import create_policy
+    from namazu_tpu.signal import PacketEvent
+    from namazu_tpu.utils.config import Config
+    from namazu_tpu.policy.replayable import fnv64a
+
+    window = 0.4  # generous CI margins: sends are ≥150 ms from any boundary
+    cfg = Config({
+        "explore_policy": "tpu_search",
+        "explore_policy_param": {
+            "seed": 3, "release_mode": "reorder",
+            "reorder_window": int(window * 1000), "reorder_gap": 2,
+            "search_on_start": False, "hint_buckets": H,
+        },
+    })
+    pol = create_policy("tpu_search")
+    pol.load_config(cfg)
+    # priorities invert arrival order inside a window
+    hints = ["pA", "pB", "pC", "pD"]
+    prios = {"pA": 3.0, "pB": 2.0, "pC": 1.0, "pD": 0.0}
+    table = np.full((H,), 10.0, np.float32)
+    for h, p in prios.items():
+        table[fnv64a(h.encode()) % H] = p
+    pol._delays = table
+
+    orc = Orchestrator(cfg, pol, collect_trace=True)
+    orc.start()
+    tr = new_transceiver("local://", "n0", orc.local_endpoint)
+    tr.start()
+    # A, B, C inside window 0; D well into window 1 — despite D having
+    # the lowest priority it must stay last
+    offsets = [0.0, 0.05, 0.1, 0.55]
+    chans = []
+    t0 = time.monotonic()
+    for hint, off in zip(hints, offsets):
+        dt = t0 + off - time.monotonic()
+        if dt > 0:
+            time.sleep(dt)
+        chans.append((hint, tr.send_event(
+            PacketEvent.create("n0", "a", "b", hint=hint))))
+    acts = [(h, ch.get(timeout=10)) for h, ch in chans]
+    orc.shutdown()
+    realized = [h for h, a in sorted(acts,
+                                     key=lambda x: x[1].triggered_time)]
+
+    # scored permutation for the same arrivals
+    trace, enc = trace_of(hints, offsets)
+    prio_vec = jnp.asarray(table)
+    t = np.asarray(order_release_times(prio_vec, trace, gap=0.002,
+                                       window=window))
+    scored = [hints[i] for i in np.argsort(t[:4], kind="stable")]
+    assert realized == scored == ["pC", "pB", "pA", "pD"], (
+        realized, scored)
