@@ -167,6 +167,43 @@ def sample_pairs(
     return np.stack([u, v], axis=1)  # [K, 2]
 
 
+def envelope_trace(encs: Sequence[EncodedTrace]) -> EncodedTrace:
+    """Per-bucket minimum-arrival envelope of several encoded traces.
+
+    The scorer's features depend only on each hint bucket's FIRST
+    occurrence (ops/schedule.py first_occurrence), so a synthetic trace
+    with one event per observed bucket at its minimum arrival over the
+    inputs is feature-equivalent to the tightest lower envelope of those
+    runs. Used as the counterfactual anchor for repro-rate search:
+    recorded arrivals include whatever delays the recording policy
+    injected, and the min over several runs is the best available proxy
+    for the *natural* (uninspected) arrival the next run will produce —
+    so a delay table evolved against the envelope transfers."""
+    firsts: Dict[int, float] = {}
+    ents: Dict[int, int] = {}
+    for e in encs:
+        hid = e.hint_ids[e.mask]
+        arr = e.arrival[e.mask]
+        ent = e.entity_ids[e.mask]
+        for b, t, en in zip(hid, arr, ent):
+            b = int(b)
+            if b not in firsts or t < firsts[b]:
+                firsts[b] = float(t)
+                ents[b] = int(en)
+    items = sorted(firsts.items(), key=lambda kv: kv[1])
+    L = _auto_length(len(items))
+    hint_ids = np.zeros(L, np.int32)
+    entity_ids = np.zeros(L, np.int32)
+    arrival = np.zeros(L, np.float32)
+    mask = np.zeros(L, bool)
+    for i, (b, t) in enumerate(items):
+        hint_ids[i] = b
+        entity_ids[i] = ents[b]
+        arrival[i] = t
+        mask[i] = True
+    return EncodedTrace(hint_ids, entity_ids, arrival, mask)
+
+
 def stack_traces(traces: Sequence[EncodedTrace]) -> Tuple[np.ndarray, ...]:
     """Stack encoded traces into batched arrays [T, L], right-padding
     ragged lengths to the longest (auto-length encodes make ragged

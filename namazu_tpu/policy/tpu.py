@@ -83,6 +83,25 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.mcts_levels = 8
         self.mcts_rollouts = 64
         self.surrogate_topk = 16  # 0 = fitness argmax only (no surrogate)
+        # fitness weights (ops/schedule.py ScoreWeights). For pure
+        # repro-rate maximization set w_novelty=0 so the search chases
+        # the failure signature alone; the defaults balance exploration
+        # (novel interleavings) against exploitation (bug affinity).
+        self.w_novelty = 1.0
+        self.w_bug = 1.0
+        self.w_delay_cost = 0.01
+        self.w_fault_cost = 0.05
+        # precedence smoothing (seconds): the temporal resolution of the
+        # feature embedding. Match it to the bug class's timing scale —
+        # ms-level tau saturates on any ordering match, so the search
+        # feels no pressure to reproduce the failure's timing MAGNITUDES
+        # (a leader-election window is hundreds of ms, not an RTT)
+        self.tau = 0.005
+        # counterfactual anchor: "recent" = most recent success traces
+        # (multi-trace averaging, good for novelty search); "envelope" =
+        # per-bucket min-arrival envelope over successes (tightest proxy
+        # for natural arrivals, best for repro-rate maximization)
+        self.reference_mode = "recent"
         self.proc_policy_name = "mild"
         import random as _random
 
@@ -134,6 +153,17 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.mcts_rollouts = int(p("mcts_rollouts", self.mcts_rollouts))
         self.surrogate_topk = int(p("surrogate_topk", self.surrogate_topk))
         self.dcn_hosts = int(p("dcn_hosts", self.dcn_hosts))
+        self.w_novelty = float(p("w_novelty", self.w_novelty))
+        self.w_bug = float(p("w_bug", self.w_bug))
+        self.w_delay_cost = float(p("w_delay_cost", self.w_delay_cost))
+        self.w_fault_cost = float(p("w_fault_cost", self.w_fault_cost))
+        self.tau = parse_duration(p("tau", self.tau * 1000))
+        self.reference_mode = str(p("reference_mode", self.reference_mode))
+        if self.reference_mode not in ("recent", "envelope"):
+            raise ValueError(
+                f"unknown reference_mode {self.reference_mode!r} "
+                "(expected 'recent' or 'envelope')"
+            )
         self.release_mode = str(p("release_mode", self.release_mode))
         if self.release_mode not in ("delay", "reorder"):
             raise ValueError(
@@ -317,6 +347,9 @@ class TPUSearchPolicy(QueueBackedPolicy):
         if self.release_mode == "reorder":
             gap = max(self.reorder_gap, 1e-4)
             weights = ScoreWeights(
+                novelty=self.w_novelty,
+                bug=self.w_bug,
+                fault_cost=self.w_fault_cost,
                 order_mode=True,
                 order_gap=gap,
                 order_window=max(self.reorder_window, 0.0),
@@ -324,7 +357,13 @@ class TPUSearchPolicy(QueueBackedPolicy):
                 delay_cost=0.0,
             )
         else:
-            weights = ScoreWeights()
+            weights = ScoreWeights(
+                novelty=self.w_novelty,
+                bug=self.w_bug,
+                delay_cost=self.w_delay_cost,
+                fault_cost=self.w_fault_cost,
+                tau=self.tau,
+            )
         cfg = SearchConfig(
             H=self.H, L=self.L, K=self.K,
             population=self.population,
@@ -419,6 +458,22 @@ class TPUSearchPolicy(QueueBackedPolicy):
                         log.info("loaded search checkpoint %s (gen %d)",
                                  ckpt, self._search.generations_run)
                 search = self._search
+            if search.generations_run > 0 and self._delays is None:
+                # install the checkpointed best NOW: the testee's decisive
+                # window (e.g. a leader election) is typically over within
+                # the first second of the run, long before this thread's
+                # own evolution finishes — so each run replays the
+                # schedule found by the end of the *previous* run, and
+                # this run's evolution product ships in the checkpoint
+                import numpy as _np
+
+                b = search.best()
+                if _np.isfinite(b.fitness):
+                    self._delays = b.delays
+                    self._faults = b.faults
+                    log.info(
+                        "installed checkpointed schedule (fitness %.4f) "
+                        "before this run's search", b.fitness)
             references = self._ingest_history(search)
             if not references:
                 log.info("no stored history yet; keeping hash-based delays")
@@ -442,8 +497,18 @@ class TPUSearchPolicy(QueueBackedPolicy):
 
     def _ingest_history(self, search):
         """Feed stored traces into the archives; return the reference
-        traces to evolve against (most recent failures, padded with the
-        most recent successes, newest first, up to MAX_REFERENCE_TRACES)."""
+        traces to evolve against.
+
+        References are the most recent SUCCESSFUL runs (padded with
+        failures only when no success exists yet): the counterfactual
+        asks "what would delaying bucket X do to the interleaving the
+        next run will naturally produce", so it must be anchored on
+        arrivals close to what an ordinary run records. A failure trace's
+        arrivals already CONTAIN the delays that induced the bug — scored
+        against itself, the zero-delay genome trivially matches the
+        failure signature and the search would install a no-op. The
+        failure traces instead supply the *target* features through the
+        failure archive (bug-affinity term)."""
         from namazu_tpu.ops import trace_encoding as te
 
         storage = self._storage
@@ -482,7 +547,9 @@ class TPUSearchPolicy(QueueBackedPolicy):
                 failures.append(enc)
             else:
                 successes.append(enc)
-        refs = (failures[::-1] + successes[::-1])[: self.MAX_REFERENCE_TRACES]
+        if self.reference_mode == "envelope" and successes:
+            return [te.envelope_trace(successes)]
+        refs = (successes[::-1] + failures[::-1])[: self.MAX_REFERENCE_TRACES]
         return refs
 
     def shutdown(self) -> None:
