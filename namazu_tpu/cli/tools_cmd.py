@@ -9,8 +9,12 @@ curve with optional partial-order reduction, visualize.go:81-168).
 from __future__ import annotations
 
 import json
+import os
+import shutil
+import sys
 
 from namazu_tpu.storage import load_storage
+from namazu_tpu.utils.config import Config
 
 
 def register(sub) -> None:
@@ -43,6 +47,24 @@ def register(sub) -> None:
     pa.add_argument("storage")
     pa.add_argument("--top", type=int, default=20)
     pa.set_defaults(func=analyze)
+
+    pab = tsub.add_parser(
+        "ab",
+        help="A/B repro-rate measurement: N runs per policy on one "
+             "example, searched policy trained on the baseline's "
+             "recorded history (the BASELINE.md north-star loop)",
+    )
+    pab.add_argument("example", help="example dir with configs + materials")
+    pab.add_argument("storage", help="storage dir to create (must not exist)")
+    pab.add_argument("--runs", type=int, default=10,
+                     help="runs per policy (default 10)")
+    pab.add_argument("--baseline-config", default="config.toml",
+                     help="config file (in EXAMPLE) for phase A")
+    pab.add_argument("--search-config", default="config_tpu.toml",
+                     help="config file (in EXAMPLE) swapped in for phase B")
+    pab.add_argument("--json-out", default="",
+                     help="also write the result JSON to this path")
+    pab.set_defaults(func=ab)
 
 
 def analyze(args) -> int:
@@ -126,4 +148,83 @@ def visualize(args) -> int:
             print(f"runs={x} unique_traces={y}")
         if curve:
             print(f"exploration saturation: {curve[-1][1]}/{curve[-1][0]} unique")
+    return 0
+
+
+def _phase_stats(storage, start: int, n: int, wall_s: float) -> dict:
+    """Repro stats over runs [start, start+n) of a storage."""
+    repros = sum(1 for i in range(start, start + n)
+                 if not storage.is_successful(i))
+    rate = repros / n if n else 0.0
+    per_hour = repros / (wall_s / 3600.0) if wall_s > 0 else 0.0
+    return {
+        "runs": n,
+        "repros": repros,
+        "repro_rate": round(rate, 4),
+        "wall_s": round(wall_s, 2),
+        "repros_per_hour": round(per_hour, 1),
+    }
+
+
+def ab(args) -> int:
+    """The north-star loop (BASELINE.md): phase A records N runs under the
+    baseline config (the reference's ``for i in $(seq N); do nmz run``,
+    SURVEY.md 3.1); phase B swaps in the search config — whose policy
+    trains on phase A's recorded history — and runs N more. Reports
+    repro-rate and repros/hour per policy and their ratio.
+    """
+    import time as _time
+
+    from namazu_tpu.cli import cli_main
+
+    base_cfg = os.path.join(args.example, args.baseline_config)
+    search_cfg = os.path.join(args.example, args.search_config)
+    materials = os.path.join(args.example, "materials")
+    for path in (base_cfg, search_cfg, materials):
+        if not os.path.exists(path):
+            print(f"error: {path} not found", file=sys.stderr)
+            return 1
+
+    if cli_main(["init", base_cfg, materials, args.storage]) != 0:
+        return 1
+
+    def phase(n: int) -> float:
+        t0 = _time.monotonic()
+        for _ in range(n):
+            if cli_main(["run", args.storage]) != 0:
+                raise RuntimeError("run failed (infra error)")
+        return _time.monotonic() - t0
+
+    baseline_name = Config.from_file(base_cfg).get("explore_policy")
+    search_name = Config.from_file(search_cfg).get("explore_policy")
+    if search_name == baseline_name:  # self-vs-self A/B: keep keys distinct
+        search_name += "_b"
+
+    wall_a = phase(args.runs)
+    shutil.copy(search_cfg, os.path.join(args.storage, "config.toml"))
+    wall_b = phase(args.runs)
+
+    st = load_storage(args.storage)
+    res_a = _phase_stats(st, 0, args.runs, wall_a)
+    res_b = _phase_stats(st, args.runs, args.runs, wall_b)
+    ra, rb = res_a["repros_per_hour"], res_b["repros_per_hour"]
+    result = {
+        "example": os.path.basename(os.path.abspath(args.example)),
+        "runs_per_policy": args.runs,
+        baseline_name: res_a,
+        search_name: res_b,
+        # the BASELINE.md target is >= 10x baseline repros/hour
+        "repros_per_hour_ratio": round(rb / ra, 2) if ra > 0 else None,
+    }
+    for name, res in ((baseline_name, res_a), (search_name, res_b)):
+        print(f"{name:>12}: {res['repros']}/{res['runs']} repros "
+              f"({100 * res['repro_rate']:.0f}%), {res['wall_s']}s, "
+              f"{res['repros_per_hour']}/h")
+    if result["repros_per_hour_ratio"] is not None:
+        print(f"ratio: {result['repros_per_hour_ratio']}x repros/hour")
+    line = json.dumps(result, sort_keys=True)
+    print(line)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(line + "\n")
     return 0

@@ -119,6 +119,51 @@ class ProxyLink:
 
     def _pump(self, src: socket.socket, dst: socket.socket,
               src_entity: str, dst_entity: str, conn_id: int = 0) -> None:
+        """One direction of one connection: reader thread (this) parses
+        chunks into message segments and posts their events immediately;
+        a writer thread releases segments **in arrival order** as their
+        actions come back (drops skip the send).
+
+        Per-direction FIFO mirrors what kernel-level interception gives
+        the reference (a delayed NFQUEUE segment holds back the bytes
+        behind it — TCP delivers in order), so delaying one message
+        delays the rest of its direction, never corrupts the stream; the
+        *policy-visible* interleaving across directions/links is where
+        reordering happens. Posting every pending message's event before
+        the first action returns lets the policy see true arrival times
+        for all of them (a blocking per-message loop would serialize
+        arrivals behind releases)."""
+        rel_q: _queue.Queue = _queue.Queue()
+        insp = self.inspector
+
+        def writer() -> None:
+            while True:
+                item = rel_q.get()
+                if item is None:
+                    break
+                data, ch, event = item
+                if ch is not None:
+                    try:
+                        action = ch.get(timeout=insp.action_timeout)
+                    except _queue.Empty:
+                        insp.trans.forget(event)
+                        log.warning(
+                            "packet %s->%s: no action in %ss; releasing",
+                            src_entity, dst_entity, insp.action_timeout)
+                        action = None
+                    if isinstance(action, PacketFaultAction):
+                        insp.drop_count += 1
+                        continue  # the fault: message never forwarded
+                if data:
+                    try:
+                        dst.sendall(data)
+                    except OSError:
+                        break
+
+        wt = threading.Thread(
+            target=writer, daemon=True,
+            name=f"proxy-write-{src_entity}->{dst_entity}")
+        wt.start()
         try:
             while not self._stop.is_set():
                 try:
@@ -127,14 +172,12 @@ class ProxyLink:
                     break
                 if not chunk:
                     break
-                if self.inspector.allow(chunk, src_entity, dst_entity,
-                                        conn_id):
-                    try:
-                        dst.sendall(chunk)
-                    except OSError:
-                        break
-                # dropped chunks are simply not forwarded (the fault)
+                for data, ch, event in insp.intercept(
+                        chunk, src_entity, dst_entity, conn_id):
+                    rel_q.put((data, ch, event))
         finally:
+            rel_q.put(None)  # writer drains pending releases, loss-free
+            wt.join(timeout=60)
             for s in (src, dst):
                 try:
                     s.shutdown(socket.SHUT_RDWR)
@@ -197,36 +240,44 @@ class EthernetProxyInspector:
         for link in self.links:
             link.stop()
 
-    # -- the per-chunk hook (parity: onPacket, ethernet_nfq.go:95-109) ---
+    # -- the per-message hook (parity: onPacket, ethernet_nfq.go:95-109) --
 
-    def allow(self, chunk: bytes, src_entity: str, dst_entity: str,
-              conn_id: int = 0) -> bool:
-        """Defer ``chunk``; returns False when the policy drops it."""
-        self.packet_count += 1
+    def intercept(self, chunk: bytes, src_entity: str, dst_entity: str,
+                  conn_id: int = 0):
+        """Split ``chunk`` into message segments and post one deferred
+        ``PacketEvent`` per segment; returns ``[(bytes, ch, event)]`` in
+        stream order for the caller's writer to release (``ch is None``
+        = forward without deferring: keepalives and non-semantic
+        passthrough).
+
+        Semantic parsers (``StreamParser`` subclasses) segment at message
+        boundaries so replay hints are timing-independent; chunk-level
+        parsers and raw links defer whole chunks (their hints have no
+        sub-chunk structure to preserve)."""
         if self.parser is None:
-            hint = ""
+            segments = [(chunk, "")]
+        elif hasattr(self.parser, "segment"):
+            segments = self.parser.segment(chunk, src_entity, dst_entity,
+                                           conn_id)
         elif self._parser_takes_conn:
-            hint = self.parser(chunk, src_entity, dst_entity, conn_id)
+            segments = [(chunk, self.parser(chunk, src_entity, dst_entity,
+                                            conn_id))]
         else:
-            hint = self.parser(chunk, src_entity, dst_entity)
-        if hint is None:
-            return True
-        event = PacketEvent.create(
-            self.entity_id, src_entity, dst_entity,
-            payload=chunk[:128], hint=hint,
-        )
-        ch = self.trans.send_event(event)
-        try:
-            action = ch.get(timeout=self.action_timeout)
-        except _queue.Empty:
-            self.trans.forget(event)
-            log.warning("packet %s->%s: no action in %ss; releasing",
-                        src_entity, dst_entity, self.action_timeout)
-            return True
-        if isinstance(action, PacketFaultAction):
-            self.drop_count += 1
-            return False
-        return True
+            segments = [(chunk, self.parser(chunk, src_entity,
+                                            dst_entity))]
+        out = []
+        for data, hint in segments:
+            if hint is None:
+                out.append((data, None, None))
+                continue
+            self.packet_count += 1
+            event = PacketEvent.create(
+                self.entity_id, src_entity, dst_entity,
+                payload=data[:128], hint=hint,
+            )
+            ch = self.trans.send_event(event)
+            out.append((data, ch, event))
+        return out
 
 
 def serve_proxy_inspector(

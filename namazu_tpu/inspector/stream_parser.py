@@ -108,5 +108,68 @@ class StreamParser:
             if len(d.buf) == before:  # no progress: need more bytes
                 return msgs
 
+    # -- message-boundary segmentation (the per-message defer path) ------
+
+    def segment(self, chunk: bytes, src: str, dst: str,
+                conn_id: int = 0) -> List[Tuple[bytes, Optional[str]]]:
+        """Split ``chunk`` at message boundaries: one ``(bytes, hint)``
+        entry per complete protocol message, in stream order.
+
+        This is what makes replay hints *timing-independent*: a per-chunk
+        hint is the join of whatever messages happened to coalesce in one
+        TCP read, so the same logical message gets a different identity
+        depending on arrival timing — exactly the instability SURVEY.md
+        section 7 warns breaks deterministic replay. Per-message events
+        give each message its own stable hint regardless of coalescing.
+
+        ``hint is None`` means forward without deferring (keepalive).
+        Bytes of an incomplete trailing frame are HELD in the direction
+        buffer until later chunks complete them — the caller forwards
+        only what is returned. A broken direction (overflow / desync)
+        passes chunks through whole with no identity.
+        """
+        with self._lock:
+            key = (src, dst, conn_id)
+            d = self._dirs.get(key)
+            if d is None:
+                first = self._first_dir.setdefault(conn_id, (src, dst))
+                d = self._dirs[key] = DirState(
+                    is_request=first == (src, dst))
+            if d.broken:
+                return [(chunk, "")]
+            d.buf.extend(chunk)
+            if len(d.buf) > MAX_BUFFER:
+                log.warning(
+                    "%s parser buffer overflow %s->%s; passthrough",
+                    type(self).__name__, src, dst)
+                d.broken = True
+                held = bytes(d.buf)
+                d.buf.clear()
+                return [(held, "")]
+            segs: List[Tuple[bytes, Optional[str]]] = []
+            while True:
+                pre = bytes(d.buf)
+                try:
+                    m = self._step(d)
+                except Exception as e:  # defensive: keep traffic flowing
+                    log.warning(
+                        "%s parser desync %s->%s: %s; passthrough",
+                        type(self).__name__, src, dst, e)
+                    d.broken = True
+                    d.buf.clear()
+                    segs.append((pre, ""))
+                    return segs
+                consumed = len(pre) - len(d.buf)
+                if consumed == 0:
+                    if m:  # hint with no byte progress: emit, then stop
+                        segs.append((b"", m))
+                    return segs
+                hint: Optional[str] = m or ""
+                if (self.ignore_keepalive and m
+                        and self.NOISE_PREFIXES
+                        and m.startswith(self.NOISE_PREFIXES)):
+                    hint = None  # keepalive: forward without deferring
+                segs.append((pre[:consumed], hint))
+
     def _step(self, d: DirState) -> Optional[str]:
         raise NotImplementedError

@@ -35,6 +35,8 @@ import jax.numpy as jnp
 from namazu_tpu.ops.schedule import (
     ScoreWeights,
     TraceArrays,
+    normalize_fault_trace,
+    replicated_trace_specs,
     score_population_multi,
 )
 
@@ -324,23 +326,27 @@ def make_parallel_mcts(mesh, H: int, cfg: MCTSConfig = MCTSConfig(),
         g = jnp.argmax(all_fit)
         return all_fit[g], all_d[g], all_f[g]
 
-    sharded = jax.shard_map(
-        _local,
-        mesh=mesh,
-        in_specs=(P(), TraceArrays(hint_ids=P(), arrival=P(), mask=P()),
-                  P(), P(), P(), P(), P()),
-        out_specs=(P(), P(), P()),
-        check_vma=False,
-    )
+    def make_sharded(trace_spec):
+        return jax.shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=(P(), trace_spec, P(), P(), P(), P(), P()),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+
+    fault_trace_spec, nofault_trace_spec = replicated_trace_specs()
+    sharded_fault = make_sharded(fault_trace_spec)
+    sharded_nofault = make_sharded(nofault_trace_spec)
 
     @jax.jit
     def run(key, trace: TraceArrays, pairs, archive, failure_feats,
             hint_order, coin=None):
         if trace.hint_ids.ndim == 1:
-            trace = TraceArrays(
-                trace.hint_ids[None], trace.arrival[None], trace.mask[None]
-            )
-        if coin is None:
+            trace = jax.tree.map(lambda x: x[None], trace)
+        had_coin = coin is not None
+        trace = normalize_fault_trace(trace, coin)
+        if not had_coin:
             if cfg.max_fault > 0:
                 # mcts_search would raise the same error, but only after
                 # the ones-substitution below had masked it — check first
@@ -351,7 +357,9 @@ def make_parallel_mcts(mesh, H: int, cfg: MCTSConfig = MCTSConfig(),
                 )
             # coin >= 1 never beats a fault probability in [0, 1]
             coin = jnp.ones((H,), jnp.float32)
-        return sharded(key, trace, pairs, archive, failure_feats,
-                       hint_order, coin)
+            return sharded_nofault(key, trace, pairs, archive,
+                                   failure_feats, hint_order, coin)
+        return sharded_fault(key, trace, pairs, archive, failure_feats,
+                             hint_order, coin)
 
     return run

@@ -69,6 +69,34 @@ class SearchBase:
         self._coin = (te.fault_coin(cfg.seed, cfg.H)
                       if cfg.ga.max_fault > 0 else None)
 
+    def set_occupied_buckets(self, occupied) -> None:
+        """Refit the precedence-pair sample to the hint buckets actually
+        observed in the recorded traces (``te.informative_pairs``) so the
+        feature space resolves realizable precedences instead of mostly
+        absent-vs-absent neutral pairs.
+
+        When the pairs actually change, every stored feature is in the
+        OLD space: the archives are cleared (the caller re-ingests the
+        full history right after, ``policy/tpu.py _ingest_history``) and
+        the best-so-far fitness is reset. Checkpoints persist the pairs,
+        so a stable hint population across runs keeps archives and best
+        intact."""
+        new = te.informative_pairs(occupied, self.cfg.K, self.cfg.H,
+                                   self.cfg.seed)
+        if np.array_equal(new, self.pairs):
+            return
+        self.pairs = new
+        self.archive[:] = 0.5
+        self.archive_labels[:] = 0.0
+        self._archive_n = 0
+        self.failures[:] = 0.5
+        self._failure_n = 0
+        self._reset_best()
+
+    def _reset_best(self) -> None:
+        """Invalidate the best-so-far record (feature space changed)."""
+        raise NotImplementedError
+
     def _feats_of(self, encoded: te.EncodedTrace) -> np.ndarray:
         import jax.numpy as jnp
 
@@ -116,8 +144,13 @@ class SearchBase:
         from namazu_tpu.ops.schedule import TraceArrays
 
         encs = encoded if isinstance(encoded, (list, tuple)) else [encoded]
-        h, _, a, m = te.stack_traces(encs)
-        trace = TraceArrays(jnp.asarray(h), jnp.asarray(a), jnp.asarray(m))
+        h, _, a, m, fb = te.stack_traces(encs)
+        # the faultable flag only matters when the fault half is scored;
+        # leaving it None otherwise keeps the fault-off jit cache entry
+        trace = TraceArrays(
+            jnp.asarray(h), jnp.asarray(a), jnp.asarray(m),
+            jnp.asarray(fb) if self._coin is not None else None,
+        )
         return encs, trace, jnp.asarray(self.pairs), \
             jnp.asarray(self.archive), jnp.asarray(self.failures)
 
@@ -134,6 +167,7 @@ class SearchBase:
 
         flat = {
             "backend": np.asarray(self.BACKEND),
+            "pairs": self.pairs,
             "archive": self.archive,
             "archive_labels": self.archive_labels,
             "archive_n": np.asarray(self._archive_n),
@@ -159,6 +193,8 @@ class SearchBase:
                     f"checkpoint {path} was written by the {saved!r} "
                     f"backend, not {self.BACKEND!r}"
                 )
+            if "pairs" in z:  # pre-informative-pairs checkpoints lack it
+                self.pairs = z["pairs"]
             self.archive = z["archive"]
             if "archive_labels" in z:
                 self.archive_labels = z["archive_labels"]
@@ -215,6 +251,12 @@ class ScheduleSearch(SearchBase):
             jax.random.PRNGKey(cfg.seed + 1), self.population, cfg.H, cfg.ga
         )
         self._surrogate = None  # built lazily on first labeled training
+
+    def _reset_best(self) -> None:
+        import jax.numpy as jnp
+
+        self._state = self._state._replace(
+            best_fitness=jnp.full((), -jnp.inf, jnp.float32))
 
     # -- search ----------------------------------------------------------
 
@@ -402,6 +444,9 @@ class MCTSSearch(SearchBase):
         self._best_fitness = float("-inf")
         self._best_delays = np.zeros((cfg.H,), np.float32)
         self._best_faults = np.zeros((cfg.H,), np.float32)
+
+    def _reset_best(self) -> None:
+        self._best_fitness = float("-inf")
 
     def _hint_order(self, encs) -> np.ndarray:
         """Bucket ids ordered by frequency across the reference traces —

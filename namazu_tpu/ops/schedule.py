@@ -40,11 +40,17 @@ BIG = 1e9  # "never happens" release time
 
 
 class TraceArrays(NamedTuple):
-    """Static-shape view of one encoded trace on device."""
+    """Static-shape view of one encoded trace on device.
+
+    ``faultable`` marks events whose cause class supports a fault action
+    (packet drop / EIO); ``None`` means "treat everything as faultable"
+    (pre-faultable encodes, and fault-off scoring where it is unused).
+    """
 
     hint_ids: jax.Array  # int32[L]
     arrival: jax.Array  # float32[L]
     mask: jax.Array  # bool[L]
+    faultable: Optional[jax.Array] = None  # bool[L] or None
 
 
 class ScoreWeights(NamedTuple):
@@ -70,6 +76,31 @@ class ScoreWeights(NamedTuple):
     order_window: float = 0.0  # reorder-window size; 0 = whole trace
 
 
+def normalize_fault_trace(trace: TraceArrays,
+                          coin: Optional[jax.Array]) -> TraceArrays:
+    """One home for the faultable-flag contract at scoring entry points:
+    without a fault coin the flag is never consumed, so it is stripped
+    (keeps the fault-off pytree and jit cache entry flag-free); with a
+    coin but no flag, everything is faultable (pre-flag behavior)."""
+    if coin is None:
+        return trace._replace(faultable=None)
+    if trace.faultable is None:
+        return trace._replace(faultable=jnp.ones_like(trace.mask))
+    return trace
+
+
+def replicated_trace_specs():
+    """(fault, nofault) TraceArrays PartitionSpec pytrees for shard_map
+    entry points that replicate the trace: the fault variant ships the
+    per-event faultable flag, the fault-off variant never does."""
+    from jax.sharding import PartitionSpec as P
+
+    return (
+        TraceArrays(hint_ids=P(), arrival=P(), mask=P(), faultable=P()),
+        TraceArrays(hint_ids=P(), arrival=P(), mask=P()),
+    )
+
+
 def release_times(delays: jax.Array, trace: TraceArrays) -> jax.Array:
     """t[e] = arrival[e] + delays[hint_ids[e]] (masked -> BIG)."""
     t = trace.arrival + delays[trace.hint_ids]
@@ -89,8 +120,17 @@ def drop_mask(faults: jax.Array, coin: jax.Array,
     action_fault_packet.go:29-46); EIO-style filesystem faults are
     approximated the same way — the op's normal effect vanishes from the
     interleaving.
+
+    The control plane only realizes a drop when the event supports a
+    fault action (``default_fault_action() is not None``); a hint-bucket
+    hash collision between a faultable and a non-faultable hint must not
+    produce scored drops that never replay, so non-faultable events are
+    masked out of the drop set when the trace carries the flag.
     """
-    return trace.mask & (coin[trace.hint_ids] < faults[trace.hint_ids])
+    d = trace.mask & (coin[trace.hint_ids] < faults[trace.hint_ids])
+    if trace.faultable is not None:
+        d = d & trace.faultable
+    return d
 
 
 def apply_faults(trace: TraceArrays, faults: Optional[jax.Array],
@@ -101,7 +141,7 @@ def apply_faults(trace: TraceArrays, faults: Optional[jax.Array],
         return trace
     dropped = drop_mask(faults, coin, trace)
     return TraceArrays(trace.hint_ids, trace.arrival,
-                       trace.mask & ~dropped)
+                       trace.mask & ~dropped, trace.faultable)
 
 
 def order_release_times(prio: jax.Array, trace: TraceArrays,
@@ -184,7 +224,7 @@ def _genome_features(
     if not order_mode and L > LONG_TRACE_THRESHOLD:
         first, ndrop = first_occurrence_blockwise(
             delays, trace.hint_ids, trace.arrival, trace.mask,
-            faults=faults, coin=coin,
+            faults=faults, coin=coin, faultable=trace.faultable,
         )
         return precedence_features(first, pairs, tau), ndrop
     eff = apply_faults(trace, faults, coin)
@@ -357,9 +397,7 @@ def score_population_multi(
         live = jnp.maximum(jnp.sum(tr.mask), 1)
         return f, ndrop / live
 
-    feats, frac = jax.vmap(
-        lambda h, a, m: per_trace(TraceArrays(h, a, m))
-    )(traces.hint_ids, traces.arrival, traces.mask)  # [T, P, K], [T, P]
+    feats, frac = jax.vmap(per_trace)(traces)  # [T, P, K], [T, P]
     feats = jnp.swapaxes(feats, 0, 1)  # [P, T, K]
     P, T, K = feats.shape
     flat = feats.reshape(P * T, K)
@@ -396,6 +434,7 @@ def first_occurrence_blockwise(
     chunk: int = LONG_TRACE_CHUNK,
     faults: Optional[jax.Array] = None,  # [H]
     coin: Optional[jax.Array] = None,  # [H]
+    faultable: Optional[jax.Array] = None,  # [L]
 ) -> tuple[jax.Array, jax.Array]:
     """(first-occurrence times f32[H], dropped-event count i32) over an
     arbitrarily long trace via lax.scan.
@@ -414,12 +453,18 @@ def first_occurrence_blockwise(
     hint_ids = jnp.pad(hint_ids, (0, pad))
     arrival = jnp.pad(arrival, (0, pad))
     mask = jnp.pad(mask, (0, pad))
+    if faultable is None:
+        faultable = jnp.ones_like(mask)
+    else:
+        faultable = jnp.pad(faultable, (0, pad))
 
     def step(carry, blk):
         first, ndrop = carry
-        h, a, m = blk
+        h, a, m, fb = blk
         if faults is not None:
-            drop = m & (coin[h] < faults[h])
+            # one home for the "non-faultable events never drop"
+            # invariant: the same drop_mask the dense path uses
+            drop = drop_mask(faults, coin, TraceArrays(h, a, m, fb))
             m = m & ~drop
             ndrop = ndrop + jnp.sum(drop)
         t = jnp.where(m, a + delays[h], BIG)
@@ -434,6 +479,7 @@ def first_occurrence_blockwise(
             hint_ids.reshape(n_chunks, chunk),
             arrival.reshape(n_chunks, chunk),
             mask.reshape(n_chunks, chunk),
+            faultable.reshape(n_chunks, chunk),
         ),
     )
     return first, ndrop
@@ -449,6 +495,6 @@ def schedule_features_long(
     memory; numerically identical to :func:`schedule_features`."""
     first, _ = first_occurrence_blockwise(
         delays, trace.hint_ids, trace.arrival, trace.mask, chunk,
-        faults=faults, coin=coin,
+        faults=faults, coin=coin, faultable=trace.faultable,
     )
     return precedence_features(first, pairs, tau)

@@ -61,16 +61,50 @@ def fault_coin(seed: int, H: int = DEFAULT_H) -> np.ndarray:
     )
 
 
+def class_supports_fault(class_name: str) -> bool:
+    """Whether events of this signal class carry a fault action (packet
+    drop / EIO) — i.e. whether the control plane can actually realize a
+    drop for them (policy/tpu.py _action_for checks
+    ``default_fault_action() is not None``). Unknown or unrecorded
+    classes are treated as faultable (the pre-flag behavior)."""
+    if not class_name:
+        return True
+    cached = _FAULTABLE_CACHE.get(class_name)
+    if cached is not None:
+        return cached
+    from namazu_tpu.signal.base import SignalError, get_signal_class
+    from namazu_tpu.signal.event import Event
+
+    try:
+        cls = get_signal_class(class_name)
+    except SignalError:
+        result = True
+    else:
+        result = (isinstance(cls, type) and issubclass(cls, Event)
+                  and cls.default_fault_action
+                  is not Event.default_fault_action)
+    _FAULTABLE_CACHE[class_name] = result
+    return result
+
+
+_FAULTABLE_CACHE: Dict[str, bool] = {}
+
+
 class EncodedTrace:
     """One trace in array form (plain numpy; converted to jnp at the device
     boundary)."""
 
-    def __init__(self, hint_ids, entity_ids, arrival, mask, truncated=0):
+    def __init__(self, hint_ids, entity_ids, arrival, mask, truncated=0,
+                 faultable=None):
         self.hint_ids = np.asarray(hint_ids, np.int32)
         self.entity_ids = np.asarray(entity_ids, np.int32)
         self.arrival = np.asarray(arrival, np.float32)
         self.mask = np.asarray(mask, bool)
         self.truncated = int(truncated)  # events beyond an explicit L cap
+        # events whose cause class supports a fault action; defaults to
+        # all-faultable (pre-flag encodes score exactly as before)
+        self.faultable = (np.ones_like(self.mask) if faultable is None
+                          else np.asarray(faultable, bool))
 
     @property
     def length(self) -> int:
@@ -102,10 +136,21 @@ def encode_trace(
     entity_ids = np.zeros(L, np.int32)
     arrival = np.zeros(L, np.float32)
     mask = np.zeros(L, bool)
+    faultable = np.ones(L, bool)
 
+    # anchor on the cause event's ARRIVAL at the orchestrator when the
+    # trace recorded it (Action.event_arrived, round-3 field; reference
+    # semantics: BasicSignal.Arrived, signal.go:75-191): triggered_time
+    # is the moment the recording policy RELEASED the action, so it
+    # contains that policy's own injected delay — a counterfactual
+    # anchored on it would evolve against the recorder's jitter instead
+    # of the system's natural interleaving. Pre-round-3 traces fall back
+    # to triggered_time.
     times: List[float] = []
     for a in trace:
-        times.append(a.triggered_time if a.triggered_time else 0.0)
+        arrived = getattr(a, "event_arrived", None)
+        t = arrived if arrived else (a.triggered_time or 0.0)
+        times.append(t if t else 0.0)
     t0 = min((t for t in times if t), default=0.0)
 
     for i, action in enumerate(trace):
@@ -120,8 +165,11 @@ def encode_trace(
         entity_ids[i] = entity_index[ent]
         arrival[i] = (times[i] - t0) if times[i] else i * 1e-3
         mask[i] = True
+        faultable[i] = class_supports_fault(
+            getattr(action, "event_class", ""))
     return EncodedTrace(hint_ids, entity_ids, arrival, mask,
-                        truncated=max(0, len(trace) - L))
+                        truncated=max(0, len(trace) - L),
+                        faultable=faultable)
 
 
 def encode_event_stream(
@@ -167,6 +215,34 @@ def sample_pairs(
     return np.stack([u, v], axis=1)  # [K, 2]
 
 
+def informative_pairs(
+    occupied: Sequence[int],
+    K: int = DEFAULT_K,
+    H: int = DEFAULT_H,
+    seed: int = 0,
+) -> np.ndarray:
+    """K ordered hint-bucket pairs concentrated on the buckets that
+    actually occur in the recorded traces.
+
+    ``sample_pairs`` draws uniformly over all H buckets; with H=64 and
+    ~8 occupied buckets the expected number of informative pairs (both
+    ends occupied) is < 1, making the failure signature invisible in
+    feature space. Enumerating the occupied-bucket pairs first makes
+    every realizable precedence a feature dimension; the remainder (if
+    any) is filled with uniform pairs so future, unseen buckets still
+    project somewhere."""
+    occ = sorted({int(b) for b in occupied})
+    pairs = [(u, v) for u in occ for v in occ if u != v]
+    rng = np.random.RandomState(seed)
+    if len(pairs) >= K:
+        idx = rng.choice(len(pairs), size=K, replace=False)
+        return np.array([pairs[i] for i in sorted(idx)], np.int32)
+    fill = sample_pairs(K - len(pairs), H, seed)
+    if not pairs:
+        return fill
+    return np.concatenate([np.array(pairs, np.int32), fill])
+
+
 def envelope_trace(encs: Sequence[EncodedTrace]) -> EncodedTrace:
     """Per-bucket minimum-arrival envelope of several encoded traces.
 
@@ -181,31 +257,38 @@ def envelope_trace(encs: Sequence[EncodedTrace]) -> EncodedTrace:
     so a delay table evolved against the envelope transfers."""
     firsts: Dict[int, float] = {}
     ents: Dict[int, int] = {}
+    flts: Dict[int, bool] = {}
     for e in encs:
         hid = e.hint_ids[e.mask]
         arr = e.arrival[e.mask]
         ent = e.entity_ids[e.mask]
-        for b, t, en in zip(hid, arr, ent):
+        flt = e.faultable[e.mask]
+        for b, t, en, fb in zip(hid, arr, ent, flt):
             b = int(b)
             if b not in firsts or t < firsts[b]:
                 firsts[b] = float(t)
                 ents[b] = int(en)
+                flts[b] = bool(fb)
     items = sorted(firsts.items(), key=lambda kv: kv[1])
     L = _auto_length(len(items))
     hint_ids = np.zeros(L, np.int32)
     entity_ids = np.zeros(L, np.int32)
     arrival = np.zeros(L, np.float32)
     mask = np.zeros(L, bool)
+    faultable = np.ones(L, bool)
     for i, (b, t) in enumerate(items):
         hint_ids[i] = b
         entity_ids[i] = ents[b]
         arrival[i] = t
         mask[i] = True
-    return EncodedTrace(hint_ids, entity_ids, arrival, mask)
+        faultable[i] = flts[b]
+    return EncodedTrace(hint_ids, entity_ids, arrival, mask,
+                        faultable=faultable)
 
 
 def stack_traces(traces: Sequence[EncodedTrace]) -> Tuple[np.ndarray, ...]:
-    """Stack encoded traces into batched arrays [T, L], right-padding
+    """Stack encoded traces into batched arrays [T, L]
+    ``(hint_ids, entity_ids, arrival, mask, faultable)``, right-padding
     ragged lengths to the longest (auto-length encodes make ragged
     batches the normal case)."""
     L = max(t.hint_ids.shape[0] for t in traces)
@@ -221,4 +304,5 @@ def stack_traces(traces: Sequence[EncodedTrace]) -> Tuple[np.ndarray, ...]:
         np.stack([pad(t.entity_ids) for t in traces]),
         np.stack([pad(t.arrival) for t in traces]),
         np.stack([pad(t.mask, False) for t in traces]),
+        np.stack([pad(t.faultable, False) for t in traces]),
     )

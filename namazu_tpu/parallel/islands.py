@@ -34,6 +34,8 @@ from namazu_tpu.models.ga import GAConfig, Population, ga_generation, init_popul
 from namazu_tpu.ops.schedule import (
     ScoreWeights,
     TraceArrays,
+    normalize_fault_trace,
+    replicated_trace_specs,
     score_population_multi,
 )
 
@@ -138,25 +140,29 @@ def make_multiaxis_island_step(
         return new_pop, all_fit[g], all_d[g], all_f[g]
 
     pop_spec = Population(delays=P(axes, None), faults=P(axes, None))
-    base_specs = (
-        P(),  # key
-        pop_spec,
-        TraceArrays(hint_ids=P(), arrival=P(), mask=P()),
-        P(),  # pairs
-        P(),  # archive
-        P(),  # failure feats
-    )
+    fault_trace_spec, nofault_trace_spec = replicated_trace_specs()
+
+    def base_specs(trace_spec):
+        return (
+            P(),  # key
+            pop_spec,
+            trace_spec,
+            P(),  # pairs
+            P(),  # archive
+            P(),  # failure feats
+        )
+
     sharded_fault = jax.shard_map(
         _local_step,
         mesh=mesh,
-        in_specs=base_specs + (P(),),  # + fault coin
+        in_specs=base_specs(fault_trace_spec) + (P(),),  # + fault coin
         out_specs=(pop_spec, P(), P(), P()),
         check_vma=False,
     )
     sharded_nofault = jax.shard_map(
         _local_step,
         mesh=mesh,
-        in_specs=base_specs,
+        in_specs=base_specs(nofault_trace_spec),
         out_specs=(pop_spec, P(), P(), P()),
         check_vma=False,
     )
@@ -165,9 +171,8 @@ def make_multiaxis_island_step(
     def step(state: IslandState, base_key, trace: TraceArrays, pairs,
              archive, failure_feats, coin=None) -> IslandState:
         if trace.hint_ids.ndim == 1:  # single trace -> batch of one
-            trace = TraceArrays(
-                trace.hint_ids[None], trace.arrival[None], trace.mask[None]
-            )
+            trace = jax.tree.map(lambda x: x[None], trace)
+        trace = normalize_fault_trace(trace, coin)
         if coin is None and cfg.max_fault > 0:
             # without the coin the fault half would evolve unscored —
             # exactly the round-1 bug config 4 exists to fix

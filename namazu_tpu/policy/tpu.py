@@ -99,8 +99,11 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.tau = 0.005
         # counterfactual anchor: "recent" = most recent success traces
         # (multi-trace averaging, good for novelty search); "envelope" =
-        # per-bucket min-arrival envelope over successes (tightest proxy
-        # for natural arrivals, best for repro-rate maximization)
+        # per-bucket min-arrival envelope over successes. Traces now
+        # record true event ARRIVALS (Action.event_arrived), so either
+        # mode anchors on the system's interleaving, not the recording
+        # policy's jitter; envelope remains useful as the tightest
+        # cross-run lower bound for repro-rate maximization.
         self.reference_mode = "recent"
         self.proc_policy_name = "mild"
         import random as _random
@@ -518,7 +521,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
             n = storage.nr_stored_histories()
         except Exception:
             return []
-        failures, successes = [], []
+        encoded = []
         for i in range(n):
             try:
                 trace = storage.get_stored_history(i)
@@ -539,6 +542,15 @@ class TPUSearchPolicy(QueueBackedPolicy):
                     i, enc.truncated, cap,
                     "configured trace_length" if self.L > 0
                     else "order-mode memory bound")
+            encoded.append((enc, ok))
+        # concentrate the feature pairs on the buckets the experiment
+        # actually produces BEFORE embedding anything (a pair change
+        # clears the archives; this loop repopulates them in full)
+        occupied = sorted({int(b) for enc, _ in encoded
+                           for b in enc.hint_ids[enc.mask]})
+        search.set_occupied_buckets(occupied)
+        failures, successes = [], []
+        for enc, ok in encoded:
             # "failure" = the run reproduced the bug (validate failed);
             # the label feeds the surrogate's training set
             search.add_executed_trace(enc, reproduced=not ok)
@@ -549,8 +561,8 @@ class TPUSearchPolicy(QueueBackedPolicy):
                 successes.append(enc)
         if self.reference_mode == "envelope" and successes:
             return [te.envelope_trace(successes)]
-        refs = (successes[::-1] + failures[::-1])[: self.MAX_REFERENCE_TRACES]
-        return refs
+        pool = successes if successes else failures
+        return pool[::-1][: self.MAX_REFERENCE_TRACES]
 
     def shutdown(self) -> None:
         """With a checkpoint configured, let an in-flight search finish

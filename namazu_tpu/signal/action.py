@@ -39,6 +39,7 @@ class Action(Signal):
         event_uuid: str = "",
         event_class: str = "",
         event_hint: str = "",
+        event_arrived: Optional[float] = None,
     ):
         super().__init__(entity_id=entity_id, option=option, uuid=uuid)
         self.event_uuid = event_uuid
@@ -47,6 +48,13 @@ class Action(Signal):
         # traces keep the identity the search plane / replay keys on (the
         # reference loses this: its traces are action-only gobs)
         self.event_hint = event_hint
+        # when the cause event ARRIVED at the orchestrator (reference:
+        # BasicSignal.Arrived, /root/reference/nmz/signal/signal.go:75-191)
+        # — unlike triggered_time this excludes the policy's own injected
+        # delay, so the search plane's counterfactual anchors on the
+        # interleaving the system produced, not on the recording policy's
+        # jitter (ops/trace_encoding.encode_trace prefers it)
+        self.event_arrived = event_arrived
         self.triggered_time: Optional[float] = None
 
     @classmethod
@@ -62,6 +70,7 @@ class Action(Signal):
             event_uuid=event.uuid,
             event_class=event.class_name(),
             event_hint=event.replay_hint(),
+            event_arrived=event.arrived,
         )
 
     def mark_triggered(self, now: Optional[float] = None) -> None:
@@ -93,6 +102,8 @@ class Action(Signal):
             d["event_class"] = self.event_class
         if self.event_hint:
             d["event_hint"] = self.event_hint
+        if self.event_arrived is not None:
+            d["event_arrived"] = self.event_arrived
         return d
 
     @classmethod
@@ -104,6 +115,7 @@ class Action(Signal):
             event_uuid=d.get("event_uuid", ""),
             event_class=d.get("event_class", ""),
             event_hint=d.get("event_hint", ""),
+            event_arrived=d.get("event_arrived"),
         )
 
 

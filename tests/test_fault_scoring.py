@@ -185,7 +185,7 @@ def test_ga_learns_drop_requiring_bug():
 
 def test_score_population_multi_with_faults():
     full, skipped = stream(), stream(skip_hint="hint3")
-    h, _, a, m = te.stack_traces([full, full])
+    h, _, a, m, _fb = te.stack_traces([full, full])
     traces = TraceArrays(jnp.asarray(h), jnp.asarray(a), jnp.asarray(m))
     pairs = jnp.asarray(te.sample_pairs(K, H, 0))
     coin = jnp.asarray(te.fault_coin(0, H))
@@ -231,3 +231,79 @@ def test_policy_replays_fault_table():
     pol._faults = faults
     action = pol._action_for(ev)
     assert not isinstance(action, PacketFaultAction)
+
+
+def test_drop_mask_respects_faultable_flag():
+    """A hint-bucket collision between a faultable and a non-faultable
+    event must not produce scored drops the control plane never
+    realizes: only events whose class supports a fault action drop
+    (advisor finding, round 2)."""
+    hint_ids = jnp.zeros((4,), jnp.int32)  # all collide in bucket 0
+    trace = TraceArrays(
+        hint_ids,
+        jnp.arange(4, dtype=jnp.float32) * 1e-3,
+        jnp.ones((4,), bool),
+        faultable=jnp.asarray([True, False, True, False]),
+    )
+    faults = jnp.ones((H,), jnp.float32)  # drop everything possible
+    coin = jnp.zeros((H,), jnp.float32)  # coin < faults everywhere
+    d = np.asarray(drop_mask(faults, coin, trace))
+    assert d.tolist() == [True, False, True, False]
+    eff = apply_faults(trace, faults, coin)
+    assert np.asarray(eff.mask).tolist() == [False, True, False, True]
+
+
+def test_encode_trace_marks_faultable_classes():
+    from namazu_tpu.signal.action import EventAcceptanceAction, NopAction
+    from namazu_tpu.signal.event import (
+        LogEvent,
+        PacketEvent,
+        FilesystemEvent,
+        FilesystemOp,
+    )
+    from namazu_tpu.utils.trace import SingleTrace
+
+    pkt = PacketEvent.create(entity_id="a", src_entity="a",
+                             dst_entity="b", payload=b"x")
+    fs = FilesystemEvent.create(entity_id="a", op=FilesystemOp.PRE_WRITE,
+                                path="/tmp/f")
+    log = LogEvent.create(entity_id="a", line="observed")
+    trace = SingleTrace([
+        EventAcceptanceAction.for_event(pkt),
+        EventAcceptanceAction.for_event(fs),
+        NopAction.for_event(log),
+    ])
+    for i, a in enumerate(trace):
+        a.mark_triggered(100.0 + i)
+    enc = te.encode_trace(trace, H=H)
+    assert enc.faultable[:3].tolist() == [True, True, False]
+    assert te.class_supports_fault("PacketEvent")
+    assert te.class_supports_fault("FilesystemEvent")
+    assert not te.class_supports_fault("LogEvent")
+    assert not te.class_supports_fault("ProcSetEvent")
+    assert te.class_supports_fault("")  # unrecorded: conservative
+    assert te.class_supports_fault("NoSuchClass")
+
+
+def test_blockwise_fault_drop_respects_faultable():
+    """The long-trace scan path applies the same faultable gate as the
+    dense path."""
+    from namazu_tpu.ops.schedule import first_occurrence_blockwise
+
+    n = 2048  # > LONG_TRACE_THRESHOLD
+    hint_ids = np.zeros((n,), np.int32)
+    arrival = np.arange(n, dtype=np.float32) * 1e-3
+    mask = np.ones((n,), bool)
+    faultable = np.zeros((n,), bool)
+    faultable[0] = True  # only the first event may drop
+    delays = jnp.zeros((H,), jnp.float32)
+    faults = jnp.ones((H,), jnp.float32)
+    coin = jnp.zeros((H,), jnp.float32)
+    first, ndrop = first_occurrence_blockwise(
+        delays, jnp.asarray(hint_ids), jnp.asarray(arrival),
+        jnp.asarray(mask), faults=faults, coin=coin,
+        faultable=jnp.asarray(faultable),
+    )
+    assert int(ndrop) == 1
+    # bucket 0's first occurrence is now the SECOND event's arrival
+    assert np.isclose(float(first[0]), arrival[1])

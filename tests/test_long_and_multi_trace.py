@@ -91,7 +91,7 @@ def test_island_step_accepts_trace_batch():
     step = make_island_step(mesh, cfg, ScoreWeights(), migrate_k=2)
     t1 = enc([f"a{i % 7}" for i in range(40)])
     t2 = enc([f"b{i % 5}" for i in range(30)])
-    h, _, a, m = te.stack_traces([t1, t2])
+    h, _, a, m, _fb = te.stack_traces([t1, t2])
     batch = TraceArrays(jnp.asarray(h), jnp.asarray(a), jnp.asarray(m))
     pairs = jnp.asarray(te.sample_pairs(K, H, 0))
     archive = jnp.full((8, K), 0.5)
@@ -130,7 +130,7 @@ def test_encode_auto_length_no_truncation():
 def test_stack_traces_pads_ragged():
     a = te.encode_event_stream([f"a{i}" for i in range(100)], H=H)
     b = te.encode_event_stream([f"b{i}" for i in range(300)], H=H)
-    h, _, arr, m = te.stack_traces([a, b])
+    h, _, arr, m, _fb = te.stack_traces([a, b])
     assert h.shape == m.shape == (2, max(a.hint_ids.shape[0],
                                          b.hint_ids.shape[0]))
     assert m[0].sum() == 100 and m[1].sum() == 300

@@ -239,7 +239,11 @@ def test_policy_realized_order_equals_scored_order():
     from namazu_tpu.utils.config import Config
     from namazu_tpu.policy.replayable import fnv64a
 
-    window = 0.4  # generous CI margins: sends are ≥150 ms from any boundary
+    # generous CI margins: sends are ≥500 ms from any window boundary, so
+    # a scheduling stall between time.sleep and the policy's queue_event
+    # timestamp would need to exceed half a second to flip the window
+    # assignment (advisor finding, round 2: 150 ms margins were flakable)
+    window = 1.2
     cfg = Config({
         "explore_policy": "tpu_search",
         "explore_policy_param": {
@@ -264,7 +268,7 @@ def test_policy_realized_order_equals_scored_order():
     tr.start()
     # A, B, C inside window 0; D well into window 1 — despite D having
     # the lowest priority it must stay last
-    offsets = [0.0, 0.05, 0.1, 0.55]
+    offsets = [0.0, 0.15, 0.3, 1.7]
     chans = []
     t0 = time.monotonic()
     for hint, off in zip(hints, offsets):

@@ -126,3 +126,65 @@ def test_reorder_window_zero_rejected(tmp_path):
         "release_mode": "delay", "reorder_window": 0,
         "search_on_start": False,
     }))
+
+
+class _RecordingSearch:
+    """Stub search backend: records what _ingest_history feeds it."""
+
+    def __init__(self):
+        self.executed = []
+        self.failures = []
+        self.occupied = None
+
+    def set_occupied_buckets(self, occupied):
+        self.occupied = list(occupied)
+
+    def add_executed_trace(self, enc, reproduced=False):
+        self.executed.append((enc, reproduced))
+
+    def add_failure_trace(self, enc):
+        self.failures.append(enc)
+
+
+def _policy_with_storage(storage):
+    pol = create_policy("tpu_search")
+    pol.load_config(Config({"explore_policy_param": {
+        "search_on_start": False, "hint_buckets": 32,
+        "reference_mode": "recent",
+    }}))
+    pol.set_history_storage(storage)
+    return pol
+
+
+def test_ingest_history_refs_are_successes_only(tmp_path):
+    """References for the counterfactual are SUCCESS traces whenever any
+    exist — a failure trace's arrivals already contain the bug-inducing
+    delays, so scoring against it lets a no-op genome match the failure
+    signature (advisor finding, round 2)."""
+    st = new_storage("naive", str(tmp_path / "st"))
+    st.create()
+    record_run(st, ["a", "b", "a"], successful=True)
+    record_run(st, ["b", "a", "c"], successful=False)
+    record_run(st, ["c", "b", "a"], successful=True)
+    record_run(st, ["a", "c", "b"], successful=False)
+    record_run(st, ["b", "c", "a"], successful=False)
+    pol = _policy_with_storage(st)
+    search = _RecordingSearch()
+    refs = pol._ingest_history(search)
+    # 2 successes exist -> refs are exactly those (latest first), never
+    # padded with failures
+    assert len(refs) == 2
+    # all five runs still feed the archives
+    assert len(search.executed) == 5
+    assert len(search.failures) == 3
+
+
+def test_ingest_history_refs_fall_back_to_failures(tmp_path):
+    st = new_storage("naive", str(tmp_path / "st"))
+    st.create()
+    record_run(st, ["a", "b", "a"], successful=False)
+    record_run(st, ["b", "a", "c"], successful=False)
+    pol = _policy_with_storage(st)
+    search = _RecordingSearch()
+    refs = pol._ingest_history(search)
+    assert len(refs) == 2  # no success yet: failures anchor the search
