@@ -18,9 +18,18 @@ from __future__ import annotations
 
 import json
 import os
+import statistics
 import subprocess
 import sys
 import time
+
+# Pin BLAS/OMP to one thread BEFORE numpy loads: the baseline is defined
+# as single-thread numpy, and an unpinned pool makes vs_baseline swing
+# >2x between otherwise identical runs (it hid a suspected regression
+# across rounds 1-3).
+for _v in ("OMP_NUM_THREADS", "OPENBLAS_NUM_THREADS", "MKL_NUM_THREADS",
+           "NUMEXPR_NUM_THREADS"):
+    os.environ.setdefault(_v, "1")
 
 import numpy as np
 
@@ -105,28 +114,33 @@ def main() -> None:
                           GAConfig(max_delay=0.1))
     weights = ScoreWeights()
 
+    iters = 50
+
     @jax.jit
-    def score(delays):
-        fit, _ = score_population(delays, trace, pairs, archive, failures,
-                                  weights)
-        return fit
+    def score_chain(delays):
+        # The production pattern: the search loop chains generations
+        # on-device and only synchronises when a run's schedule is
+        # extracted (models/search.py run()). One fori_loop = ONE
+        # dispatch for all `iters` scoring passes, so the host->device
+        # round trip through this image's TPU tunnel (~65 ms, and it
+        # stalls whole dispatch bursts unpredictably — it made identical
+        # benches read 10.0M and 4.7M back to back) is paid once, not
+        # per call. Each pass perturbs the population by its own fitness
+        # (what GA mutation does), which also keeps XLA from collapsing
+        # the loop.
+        def step(_, d):
+            fit, _f = score_population(d, trace, pairs, archive, failures,
+                                       weights)
+            return d + 1e-9 * fit[:, None]
+        return jax.lax.fori_loop(0, iters, step, delays)
 
     # warmup/compile
-    score(pop.delays).block_until_ready()
+    score_chain(pop.delays).block_until_ready()
 
-    # Pipelined dispatch, one sync at the end — the production pattern:
-    # the search loop chains generations on-device and only synchronises
-    # when a run's schedule is extracted (models/search.py run()), so
-    # per-call host->device round-trip latency (~65 ms through this
-    # image's TPU tunnel) is NOT part of the steady-state cost.
-    # best of 3 repetitions: the tunnel occasionally stalls a dispatch
-    # burst, which would otherwise punish the steady-state number
-    iters = 50
     best_dt = float("inf")
-    for _ in range(3):
+    for _ in range(5):
         t0 = time.perf_counter()
-        results = [score(pop.delays) for _ in range(iters)]
-        jax.block_until_ready(results)
+        score_chain(pop.delays).block_until_ready()
         best_dt = min(best_dt, time.perf_counter() - t0)
     device_rate = P * iters / best_dt  # schedules scored per second
 
@@ -138,10 +152,12 @@ def main() -> None:
         np.asarray(pairs), np.asarray(archive), np.asarray(failures),
     )
     numpy_score(*np_args)  # warm cache
-    t0 = time.perf_counter()
-    numpy_score(*np_args)
-    np_dt = time.perf_counter() - t0
-    baseline_rate = nb / np_dt
+    np_dts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        numpy_score(*np_args)
+        np_dts.append(time.perf_counter() - t0)
+    baseline_rate = nb / statistics.median(np_dts)
 
     print(json.dumps({
         "metric": "interleavings_scored_per_sec_per_chip",
