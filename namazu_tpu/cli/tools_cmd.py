@@ -66,6 +66,25 @@ def register(sub) -> None:
                      help="also write the result JSON to this path")
     pab.set_defaults(func=ab)
 
+    pi = tsub.add_parser(
+        "import-reference-trace",
+        help="convert a reference-format experiment dir (per-action JSON "
+             "pairs + gob results, e.g. the recorded ZOOKEEPER-2212 hunt "
+             "shipped under example/zk-found-2212.ryu/example-result.*) "
+             "into a native storage",
+    )
+    pi.add_argument("source", help="reference experiment dir with %%08x runs")
+    pi.add_argument("storage", help="storage dir to create (must not exist)")
+    pi.set_defaults(func=import_reference_trace)
+
+
+def import_reference_trace(args) -> int:
+    from namazu_tpu.storage.reference_import import import_experiment
+
+    summary = import_experiment(args.source, args.storage)
+    print(json.dumps(summary, sort_keys=True))
+    return 0
+
 
 def analyze(args) -> int:
     from namazu_tpu.analyzer import analyze_storage, print_report

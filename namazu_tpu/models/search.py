@@ -45,6 +45,25 @@ class BestSchedule(NamedTuple):
     fitness: float
 
 
+def _enable_persistent_compile_cache() -> None:
+    """Point XLA's persistent compilation cache at a stable user dir.
+
+    Policy searches run inside short-lived ``run`` processes (SURVEY.md
+    3.1 — the repro loop is many processes); without the cache every run
+    re-pays the scorer's compile, which dwarfs the actual search at
+    config-2 sizes. Idempotent and best-effort (older jax versions or
+    read-only homes just skip it)."""
+    import jax
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.expanduser("~/.cache/namazu_tpu/xla"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # pragma: no cover - config name drift
+        pass
+
+
 class SearchBase:
     """Shared host-side state of every search backend: the precedence-pair
     sample, the novelty/failure feature archives (ring buffers), and the
@@ -53,6 +72,7 @@ class SearchBase:
     BACKEND = "base"
 
     def __init__(self, cfg: SearchConfig):
+        _enable_persistent_compile_cache()
         self.cfg = cfg
         self.pairs = te.sample_pairs(cfg.K, cfg.H, cfg.seed)
         # neutral (0.5) features = "no information"; rings overwrite oldest
@@ -111,6 +131,11 @@ class SearchBase:
                            self.cfg.weights.tau, self.cfg.H)
         return np.asarray(f)
 
+    def seed_population(self, delay_tables) -> None:
+        """Inject imitation genomes before evolving; backends without an
+        explicit population (MCTS builds its tree from scratch each run)
+        ignore seeds."""
+
     def add_executed_trace(self, encoded: te.EncodedTrace,
                            reproduced: bool = False) -> None:
         """Record an executed run's interleaving into the novelty archive,
@@ -167,6 +192,7 @@ class SearchBase:
 
         flat = {
             "backend": np.asarray(self.BACKEND),
+            "hint_space": np.asarray(te.HINT_SPACE),
             "pairs": self.pairs,
             "archive": self.archive,
             "archive_labels": self.archive_labels,
@@ -192,6 +218,24 @@ class SearchBase:
                 raise ValueError(
                     f"checkpoint {path} was written by the {saved!r} "
                     f"backend, not {self.BACKEND!r}"
+                )
+            if ("best_delays" in z
+                    and z["best_delays"].shape != (self.cfg.H,)):
+                # a mismatched genome length would load silently and
+                # IndexError later on the policy's event hot path
+                raise ValueError(
+                    f"checkpoint {path} has H={z['best_delays'].shape[0]} "
+                    f"delay buckets, config has H={self.cfg.H}"
+                )
+            space = te.checkpoint_hint_space(z)
+            if space != te.HINT_SPACE:
+                # every archived feature and evolved delay table keys
+                # buckets in the old hint space; resuming from it would
+                # deliver arbitrary delays under a "searched schedule" log
+                raise ValueError(
+                    f"checkpoint {path} was built in hint space {space!r}; "
+                    f"this build hashes {te.HINT_SPACE!r} — delete it and "
+                    "re-record"
                 )
             if "pairs" in z:  # pre-informative-pairs checkpoints lack it
                 self.pairs = z["pairs"]
@@ -257,6 +301,43 @@ class ScheduleSearch(SearchBase):
 
         self._state = self._state._replace(
             best_fitness=jnp.full((), -jnp.inf, jnp.float32))
+
+    def seed_population(self, delay_tables) -> None:
+        """Inject imitation genomes into the population before evolving.
+
+        The GA's objective (match the failure archive in feature space)
+        has local optima the mutation kernel rarely escapes — e.g. the
+        asymmetric early/late delivery split that decides a leader
+        election. But the control plane already *has* near-reproducing
+        genomes: each recorded failure's per-bucket injected delays
+        (release - arrival) form a delay table that, replayed against
+        similar arrivals, re-enacts that failure's interleaving up to the
+        system's reactions. Those tables are spread across the islands
+        (one per stride) so every island refines from a demonstration
+        instead of from noise; crossover/migration then mix them with the
+        evolved material."""
+        import jax
+        import jax.numpy as jnp
+
+        if len(delay_tables) == 0:
+            return
+        if jax.process_count() > 1:  # pragma: no cover - DCN runs
+            # per-process seeding would diverge island contents between
+            # hosts; skip rather than corrupt the sharded population
+            return
+        seeds = np.clip(
+            np.stack([np.asarray(t, np.float32) for t in delay_tables]),
+            0.0, self.cfg.ga.max_delay)
+        n = min(seeds.shape[0], self.population)
+        delays = np.array(jax.device_get(self._state.pop.delays))
+        stride = max(1, self.population // n)
+        idx = [min(i * stride, self.population - 1) for i in range(n)]
+        delays[idx] = seeds[:n]
+        # uncommitted on purpose: the island step's shard_map shards its
+        # inputs itself; a device_put-committed array would pin the
+        # population to one device and fail on a multi-device mesh
+        self._state = self._state._replace(
+            pop=self._state.pop._replace(delays=jnp.asarray(delays)))
 
     # -- search ----------------------------------------------------------
 

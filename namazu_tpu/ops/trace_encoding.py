@@ -29,6 +29,23 @@ DEFAULT_L = 256  # default length quantum for encoded traces
 DEFAULT_H = 256  # hint buckets (genome length)
 DEFAULT_K = 256  # precedence pairs (feature dimension)
 
+# Version tag of the replay-hint format whose fnv64a hashes the bucket
+# space is built from. Bump whenever hint derivation changes in a way
+# that re-buckets events (it invalidates every delay table, archive
+# feature, and checkpoint): "flow-v2" = packet hints are flow-qualified
+# ("src->dst:<content>", signal/event.py PacketEvent.replay_hint);
+# checkpoints from other spaces are rejected at load
+# (models/search.py) rather than silently delivering arbitrary delays.
+HINT_SPACE = "flow-v2"
+
+
+def checkpoint_hint_space(z) -> str:
+    """Hint-space tag of a checkpoint npz mapping; checkpoints predating
+    the tag were built from bare content hints ("content-v1"). One home
+    for the default so the fast-install path (policy/tpu.py) and the
+    full load (models/search.py) can never disagree on compatibility."""
+    return str(z["hint_space"]) if "hint_space" in z else "content-v1"
+
 # encoded lengths are rounded up to a multiple of this so XLA sees a
 # handful of static shapes instead of one per run length
 L_QUANTUM = 128
@@ -116,6 +133,7 @@ def encode_trace(
     L: Optional[int] = None,
     H: int = DEFAULT_H,
     entity_index: Optional[Dict[str, int]] = None,
+    realized: bool = False,
 ) -> EncodedTrace:
     """Encode a recorded action trace.
 
@@ -124,10 +142,43 @@ def encode_trace(
     without one (e.g. traces from before a semantic parser was attached)
     fall back to cause-event class + entity.
 
+    ``realized=True`` timestamps each event at its RELEASE
+    (``triggered_time`` — where the recording policy actually placed it in
+    the interleaving) instead of its arrival. This is the right view for
+    *embedding* executed runs into feature space: a failure induced by
+    injected delays carries its signature in the release times, while its
+    arrivals look like any healthy run's — arrival-anchored failure
+    features would let the zero-delay genome sit at distance ~0 from the
+    failure archive and the search would feel no pressure to inject
+    anything. Counterfactual *reference* traces keep the default
+    (arrival) anchoring: candidate release times are
+    ``arrival + delay``, so both sides of the feature distance live in
+    release-time space.
+
     ``L=None`` (default) sizes the arrays to the whole trace — nothing is
     ever silently dropped. An explicit ``L`` is a hard cap for callers
     that want to bound device memory; events past it are truncated (the
     returned ``EncodedTrace.truncated`` says how many).
+    """
+    views = encode_trace_views(trace, L=L, H=H, entity_index=entity_index)
+    return views[1] if realized else views[0]
+
+
+def encode_trace_views(
+    trace: SingleTrace,
+    L: Optional[int] = None,
+    H: int = DEFAULT_H,
+    entity_index: Optional[Dict[str, int]] = None,
+) -> Tuple[EncodedTrace, EncodedTrace]:
+    """Both time views of one trace in a single pass:
+    ``(arrival_view, realized_view)``.
+
+    Identity arrays (hint buckets, entities, mask, faultable flags) are
+    computed once and SHARED between the two EncodedTraces; only the
+    time vectors differ. Callers that need both views (the policy's
+    history ingest encodes the counterfactual reference from arrivals
+    and the archive embedding from releases) pay one encode instead of
+    two.
     """
     entity_index = entity_index if entity_index is not None else {}
     if L is None:
@@ -135,23 +186,29 @@ def encode_trace(
     hint_ids = np.zeros(L, np.int32)
     entity_ids = np.zeros(L, np.int32)
     arrival = np.zeros(L, np.float32)
+    released = np.zeros(L, np.float32)
     mask = np.zeros(L, bool)
     faultable = np.ones(L, bool)
 
-    # anchor on the cause event's ARRIVAL at the orchestrator when the
-    # trace recorded it (Action.event_arrived, round-3 field; reference
-    # semantics: BasicSignal.Arrived, signal.go:75-191): triggered_time
-    # is the moment the recording policy RELEASED the action, so it
-    # contains that policy's own injected delay — a counterfactual
-    # anchored on it would evolve against the recorder's jitter instead
-    # of the system's natural interleaving. Pre-round-3 traces fall back
-    # to triggered_time.
-    times: List[float] = []
+    # Arrival view: anchor on the cause event's ARRIVAL at the
+    # orchestrator when the trace recorded it (Action.event_arrived,
+    # round-3 field; reference semantics: BasicSignal.Arrived,
+    # signal.go:75-191) — triggered_time contains the recording
+    # policy's own injected delay, so a counterfactual anchored on it
+    # would evolve against the recorder's jitter instead of the
+    # system's natural interleaving. Realized view: the opposite
+    # preference — release times ARE the interleaving the run executed.
+    # Either view falls back to the other's timestamp when one was not
+    # recorded.
+    arr_times: List[float] = []
+    rel_times: List[float] = []
     for a in trace:
-        arrived = getattr(a, "event_arrived", None)
-        t = arrived if arrived else (a.triggered_time or 0.0)
-        times.append(t if t else 0.0)
-    t0 = min((t for t in times if t), default=0.0)
+        arrived = getattr(a, "event_arrived", None) or 0.0
+        rel = a.triggered_time or 0.0
+        arr_times.append(arrived if arrived else rel)
+        rel_times.append(rel if rel else arrived)
+    a0 = min((t for t in arr_times if t), default=0.0)
+    r0 = min((t for t in rel_times if t), default=0.0)
 
     for i, action in enumerate(trace):
         if i >= L:
@@ -163,13 +220,18 @@ def encode_trace(
             f"{action.event_class or action.class_name()}:{ent}"
         hint_ids[i] = hint_bucket(hint, H)
         entity_ids[i] = entity_index[ent]
-        arrival[i] = (times[i] - t0) if times[i] else i * 1e-3
+        arrival[i] = (arr_times[i] - a0) if arr_times[i] else i * 1e-3
+        released[i] = (rel_times[i] - r0) if rel_times[i] else i * 1e-3
         mask[i] = True
         faultable[i] = class_supports_fault(
             getattr(action, "event_class", ""))
-    return EncodedTrace(hint_ids, entity_ids, arrival, mask,
-                        truncated=max(0, len(trace) - L),
-                        faultable=faultable)
+    truncated = max(0, len(trace) - L)
+    return (
+        EncodedTrace(hint_ids, entity_ids, arrival, mask,
+                     truncated=truncated, faultable=faultable),
+        EncodedTrace(hint_ids, entity_ids, released, mask,
+                     truncated=truncated, faultable=faultable),
+    )
 
 
 def encode_event_stream(

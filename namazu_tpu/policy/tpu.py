@@ -55,8 +55,22 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.checkpoint_path = ""
         self.search_on_start = True
         self.search_join_timeout = 120.0  # shutdown waits this long
+        # evolve every Nth run (1 = every run). The installed schedule
+        # always comes from the checkpoint (cheap np.load), but the
+        # evolve+ingest+save cycle costs seconds of wall-clock per `run`
+        # process; on experiments whose runs last ~2 s that overhead
+        # halves repros/hour. N>1 amortizes it: N-1 install-only runs,
+        # then one evolution over the batch of new outcomes.
+        self.search_every = 1
         self.max_fault = 0.0
         self.search_backend = "ga"  # "ga" (island GA) | "mcts" (config 5)
+        # JAX platform for the search plane ("" = inherit the process
+        # default). Policy searches run inside short-lived `run`
+        # processes; on images where claiming the TPU can wedge for
+        # minutes (see bench.py's init probe) a config-2-sized search is
+        # far better off on CPU — set platform = "cpu" there and keep
+        # the TPU for big standalone searches.
+        self.platform = ""
         self.dcn_hosts = 0  # >1: hybrid host x chip mesh (multi-host DCN)
         # release modes (BASELINE config 3): "delay" replays the table as
         # literal per-hint delays; "reorder" treats it as per-hint
@@ -138,7 +152,9 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.search_on_start = bool(p("search_on_start", True))
         self.search_join_timeout = parse_duration(
             p("search_join_timeout", self.search_join_timeout * 1000))
+        self.search_every = max(1, int(p("search_every", self.search_every)))
         self.max_fault = float(p("max_fault", 0.0))
+        self.platform = str(p("platform", self.platform))
         self.search_backend = str(p("search_backend", self.search_backend))
         if self.search_backend not in ("ga", "mcts"):
             # fail fast: an exception inside the background search thread
@@ -331,6 +347,23 @@ class TPUSearchPolicy(QueueBackedPolicy):
                                 boundary=anchor + k * w)
 
     def _build_search(self):
+        if self.platform:
+            # env alone is NOT enough: this image's sitecustomize imports
+            # jax at interpreter start, and jax snapshots JAX_PLATFORMS
+            # into its config defaults at import time. config.update is
+            # the post-import lever; it must run before the first backend
+            # initialization (which is exactly why this sits at the top
+            # of _build_search — nothing in the control plane touches a
+            # backend). Probing the current backend here would itself
+            # trigger initialization, i.e. the wedge we are avoiding.
+            os.environ["JAX_PLATFORMS"] = self.platform  # child processes
+            import jax
+
+            try:
+                jax.config.update("jax_platforms", self.platform)
+            except Exception as e:  # backend already up: keep it
+                log.warning("could not switch jax platform to %r: %s",
+                            self.platform, e)
         from namazu_tpu.models.ga import GAConfig
         from namazu_tpu.models.search import (
             MCTSSearch,
@@ -449,17 +482,91 @@ class TPUSearchPolicy(QueueBackedPolicy):
             return os.path.join(self._storage.dir, p)
         return p
 
+    def _install_from_checkpoint(self, ckpt: str) -> bool:
+        """Install the checkpointed best tables from the raw npz, without
+        touching any jax machinery. The testee's decisive window (a
+        leader election, a reader's grace period) is typically over
+        within the first few hundred ms of the run; building the search
+        object first (imports, mesh, jit setup) loses that race and the
+        whole run silently executes hash-fallback delays."""
+        import numpy as _np
+
+        from namazu_tpu.ops.trace_encoding import (
+            HINT_SPACE,
+            checkpoint_hint_space,
+        )
+
+        try:
+            with _np.load(ckpt) as z:
+                if "best_delays" not in z or "generations_run" not in z:
+                    return False
+                if int(z["generations_run"]) <= 0:
+                    return False
+                space = checkpoint_hint_space(z)
+                if space != HINT_SPACE:
+                    log.warning(
+                        "checkpoint %s is from hint space %r (this build: "
+                        "%r); not installing its schedule", ckpt, space,
+                        HINT_SPACE)
+                    return False
+                fit = (float(z["best_fitness"])
+                       if "best_fitness" in z else float("nan"))
+                if not _np.isfinite(fit):
+                    return False
+                delays = _np.array(z["best_delays"])
+                if delays.shape != (self.H,):
+                    log.warning(
+                        "checkpoint %s has best_delays of shape %s but "
+                        "hint_buckets=%d; not installing", ckpt,
+                        delays.shape, self.H)
+                    return False
+                faults = (_np.array(z["best_faults"])
+                          if "best_faults" in z else None)
+        except Exception:
+            log.exception("unreadable checkpoint %s", ckpt)
+            return False
+        self._delays = delays
+        self._faults = faults
+        log.info("installed checkpointed schedule (fitness %.4f) from %s",
+                 fit, ckpt)
+        return True
+
     def _search_once(self) -> None:
         """Background: ingest history, evolve, install the best tables."""
         try:
             ckpt = self._checkpoint()
+            installed = False
+            if ckpt and os.path.exists(ckpt) and self._delays is None:
+                # cheap install FIRST (np.load only), then the heavy build
+                installed = self._install_from_checkpoint(ckpt)
+            if installed and self.search_every > 1:
+                storage = self._storage
+                try:
+                    n = storage.nr_stored_histories() if storage else 0
+                except Exception:
+                    n = 0
+                if n % self.search_every != 0:
+                    log.info(
+                        "install-only run (search_every=%d, %d stored "
+                        "runs); next evolution at %d",
+                        self.search_every, n,
+                        -(-n // self.search_every) * self.search_every)
+                    return
             with self._search_lock:
                 if self._search is None:
                     self._search = self._build_search()
                     if ckpt and os.path.exists(ckpt):
-                        self._search.load(ckpt)
-                        log.info("loaded search checkpoint %s (gen %d)",
-                                 ckpt, self._search.generations_run)
+                        try:
+                            self._search.load(ckpt)
+                            log.info("loaded search checkpoint %s (gen %d)",
+                                     ckpt, self._search.generations_run)
+                        except Exception:
+                            # incompatible (hint space, backend, shape) or
+                            # corrupt: evolve fresh rather than abort the
+                            # whole search; the save below replaces it
+                            log.exception(
+                                "checkpoint %s not loadable; starting a "
+                                "fresh search", ckpt)
                 search = self._search
             if search.generations_run > 0 and self._delays is None:
                 # install the checkpointed best NOW: the testee's decisive
@@ -492,6 +599,35 @@ class TPUSearchPolicy(QueueBackedPolicy):
             log.exception("schedule search failed; hash-based delays remain")
 
     MAX_REFERENCE_TRACES = 4
+    MAX_SEED_GENOMES = 16
+
+    def _failure_seed(self, trace):
+        """Per-bucket delay table replaying this failure's injected
+        delays: for the first released event of each bucket,
+        ``release - arrival`` IS the delay the recording policy injected
+        on it (absolute times — no anchor needed). Replayed against
+        similar arrivals, the table re-enacts the failure's interleaving
+        up to the system's reactions; it seeds the GA population as a
+        demonstration (models/search.py seed_population)."""
+        import numpy as np
+
+        seed = np.zeros((self.H,), np.float32)
+        seen = set()
+        got = False
+        for a in trace:
+            arr = getattr(a, "event_arrived", None)
+            rel = a.triggered_time
+            if not arr or not rel:
+                continue
+            hint = getattr(a, "event_hint", "") or \
+                f"{a.event_class or a.class_name()}:{a.entity_id}"
+            b = self._bucket(hint)
+            if b in seen:
+                continue
+            seen.add(b)
+            seed[b] = min(max(rel - arr, 0.0), self.max_interval)
+            got = True
+        return seed if got else None
     # order mode scores dense (a windowed permutation needs the whole
     # trace in one lexsort — ops/schedule.py), so uncapped encoding would
     # materialize [population, L] intermediates per generation; cap the
@@ -521,6 +657,8 @@ class TPUSearchPolicy(QueueBackedPolicy):
             n = storage.nr_stored_histories()
         except Exception:
             return []
+        from namazu_tpu.ops.trace_encoding import HINT_SPACE
+
         encoded = []
         for i in range(n):
             try:
@@ -528,13 +666,36 @@ class TPUSearchPolicy(QueueBackedPolicy):
                 ok = storage.is_successful(i)
             except Exception:
                 continue
+            # runs recorded under a different replay-hint format hash
+            # into a different bucket space — training on them would
+            # deliver arbitrary delays under a "searched schedule" log.
+            # An absent stamp (in-process test fixtures, pre-stamp
+            # storages) is assumed current: pre-stamp dirs cannot be
+            # told apart, and all recordings made by this build are
+            # stamped (cli/run_cmd.py).
+            try:
+                stamp = (storage.get_metadata(i) or {}).get("hint_space")
+            except Exception:
+                stamp = None
+            if stamp and stamp != HINT_SPACE:
+                log.warning(
+                    "run %d was recorded in hint space %s (this build: "
+                    "%s); excluded from search ingest", i, stamp,
+                    HINT_SPACE)
+                continue
             if self.L > 0:
                 cap = self.L
             elif self.release_mode == "reorder":
                 cap = self.ORDER_MODE_MAX_L
             else:
                 cap = None  # delay mode scores long traces blockwise
-            enc = te.encode_trace(trace, L=cap, H=self.H)
+            # two views of every run, one encode pass (te.encode_trace
+            # docstring): the arrival-anchored view is the
+            # counterfactual reference, the realized (release-time)
+            # view is what gets embedded into the novelty/failure
+            # archives — a delay-induced failure's signature exists
+            # only in its release times
+            enc, enc_rt = te.encode_trace_views(trace, L=cap, H=self.H)
             if enc.truncated:
                 log.warning(
                     "trace %d truncated: %d events beyond the L=%d cap "
@@ -542,20 +703,30 @@ class TPUSearchPolicy(QueueBackedPolicy):
                     i, enc.truncated, cap,
                     "configured trace_length" if self.L > 0
                     else "order-mode memory bound")
-            encoded.append((enc, ok))
+            # failure seeds are derived inline so the trace itself can be
+            # dropped — holding every run's Action objects through the
+            # whole ingest would multiply peak memory on long experiments
+            seed = None if ok else self._failure_seed(trace)
+            encoded.append((enc, enc_rt, ok, seed))
         # concentrate the feature pairs on the buckets the experiment
         # actually produces BEFORE embedding anything (a pair change
         # clears the archives; this loop repopulates them in full)
-        occupied = sorted({int(b) for enc, _ in encoded
+        occupied = sorted({int(b) for enc, _, _, _ in encoded
                            for b in enc.hint_ids[enc.mask]})
         search.set_occupied_buckets(occupied)
+        seeds = [s for _, _, ok, s in encoded if not ok and s is not None]
+        if seeds:
+            # most recent failures first: when seeds outnumber slots the
+            # freshest demonstrations win
+            search.seed_population(seeds[::-1][: self.MAX_SEED_GENOMES])
         failures, successes = [], []
-        for enc, ok in encoded:
+        for enc, enc_rt, ok, _ in encoded:
             # "failure" = the run reproduced the bug (validate failed);
-            # the label feeds the surrogate's training set
-            search.add_executed_trace(enc, reproduced=not ok)
+            # the label feeds the surrogate's training set. Embeddings
+            # use the realized view; references the arrival view.
+            search.add_executed_trace(enc_rt, reproduced=not ok)
             if not ok:
-                search.add_failure_trace(enc)
+                search.add_failure_trace(enc_rt)
                 failures.append(enc)
             else:
                 successes.append(enc)

@@ -54,6 +54,16 @@ class Event(Signal):
         """The fault this event supports, or None."""
         return None
 
+    def to_jsonable(self) -> Dict[str, Any]:
+        # ``deferred`` rides the wire (doc/schema/event.json, parity with
+        # the reference's schema): a consumer that does not know the
+        # class can still tell whether the sender is blocked awaiting an
+        # action. Decode ignores it — the registered class is
+        # authoritative.
+        d = super().to_jsonable()
+        d["deferred"] = self.deferred
+        return d
+
     @classmethod
     def from_jsonable(cls, d: Dict[str, Any]) -> "Event":
         return cls(
@@ -107,12 +117,21 @@ class PacketEvent(Event):
         return base64.b64decode(b64) if b64 else b""
 
     def replay_hint(self) -> str:
-        # Semantic parsers (e.g. the ZooKeeper FLE/ZAB inspector) set an
-        # explicit protocol-level hint; otherwise fall back to the flow.
+        # A packet's replay identity is (flow, semantic content): the
+        # SAME protocol message to two different receivers must live in
+        # different delay buckets — per-destination delivery timing is
+        # what decides e.g. a leader election (ZOOKEEPER-2212: the
+        # outcome turns on WHICH decider saw the newest-zxid notification
+        # before its window closed). Semantic parsers provide the
+        # content half; the flow is prefixed here so every packet hint is
+        # destination-resolved, and the searched delay table can delay
+        # src->A independently of src->B.
+        flow = (f"{self.option['src_entity']}->"
+                f"{self.option['dst_entity']}")
         explicit = self.option.get("replay_hint")
         if explicit:
-            return str(explicit)
-        return f"packet:{self.option['src_entity']}->{self.option['dst_entity']}"
+            return f"{flow}:{explicit}"
+        return f"packet:{flow}"
 
     def default_fault_action(self):
         from namazu_tpu.signal.action import PacketFaultAction

@@ -41,10 +41,21 @@ bool watched(const char* path) {
          (path[n] == '\0' || path[n] == '/' || root[n - 1] == '/');
 }
 
-/* Returns 1 when the op must fail with EIO. */
+/* Returns 1 when the op must fail with EIO.
+ *
+ * The reported path is RELATIVE to NMZ_TPU_FS_ROOT (leading '/'): the
+ * watched root is typically a per-run working dir, and a schedule
+ * searched on one run must key the same operation in the next run --
+ * absolute paths would put every run's events in disjoint replay-hint
+ * buckets and make delay tables untransferable. */
 int hook(const char* op, const char* path) {
   if (!watched(path)) return 0;
-  int r = nmz_agent_fs_event(op, path);
+  const char* root = fs_root();
+  size_t n = strlen(root);
+  if (n > 0 && root[n - 1] == '/') n--;
+  const char* rel = path + n;
+  if (rel[0] == '\0') rel = "/";
+  int r = nmz_agent_fs_event(op, rel);
   return r == 1 ? 1 : 0;
 }
 

@@ -1,0 +1,73 @@
+"""The north-star acceptance: tpu_search's zk-election repro rate must be
+at least the random policy's, measured through the REAL experiment loop
+(init/run/validate, proxy inspector, REST endpoint) — the suite-level
+counterpart of the committed ABRESULT artifacts (BASELINE.md: the
+reference's product is its repro-rate table, README.md:41-65).
+
+Phase A records under a random config chosen to produce failures often
+enough for a bounded test (max_interval 500 ms can starve a decider
+directly, unlike the example's headline 400 ms config where random is in
+the rare-repro regime); phase B swaps in the example's tpu_search config,
+which trains on phase A's history.
+"""
+
+import os
+import shutil
+
+import pytest
+
+from namazu_tpu.cli import cli_main
+from namazu_tpu.storage import load_storage
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLE = os.path.join(REPO, "examples", "zk-election")
+
+RECORD_CONFIG = """\
+explore_policy = "random"
+rest_port = 10982
+run = "sh $NMZ_MATERIALS_DIR/run.sh"
+validate = "sh $NMZ_MATERIALS_DIR/validate.sh"
+
+[explore_policy_param]
+min_interval = 0
+max_interval = 500
+seed = 0
+"""
+
+PHASE_A_RUNS = 10
+PHASE_B_MAX_RUNS = 6
+
+
+def test_tpu_search_repro_rate_at_least_random(tmp_path):
+    cfg = tmp_path / "config.toml"
+    cfg.write_text(RECORD_CONFIG)
+    storage = str(tmp_path / "ab")
+    assert cli_main(["init", str(cfg),
+                     os.path.join(EXAMPLE, "materials"), storage]) == 0
+    st = load_storage(storage)
+
+    for _ in range(PHASE_A_RUNS):
+        assert cli_main(["run", storage]) == 0
+    repros_a = sum(not st.is_successful(i) for i in range(PHASE_A_RUNS))
+    if repros_a == 0:
+        # P ~ a few percent at calibration; without a recorded failure
+        # the search has no signature to chase and the comparison is
+        # undefined — the committed ABRESULT artifacts carry the metric
+        pytest.skip("random produced no repro in phase A on this machine")
+    rate_a = repros_a / PHASE_A_RUNS
+
+    shutil.copy(os.path.join(EXAMPLE, "config_tpu.toml"),
+                os.path.join(storage, "config.toml"))
+    repros_b = 0
+    for n in range(1, PHASE_B_MAX_RUNS + 1):
+        assert cli_main(["run", storage]) == 0
+        repros_b = sum(not st.is_successful(PHASE_A_RUNS + i)
+                       for i in range(n))
+        if repros_b / n >= rate_a and repros_b >= 2:
+            break
+    assert repros_b / n >= rate_a, (
+        f"tpu_search reproduced {repros_b}/{n}; random did "
+        f"{repros_a}/{PHASE_A_RUNS} — the searched schedule must not be "
+        "worse than the policy it trained on (measured 19/20 vs 1/20 at "
+        "calibration, ABRESULT_r04.json)"
+    )

@@ -162,9 +162,11 @@ def test_policy_reorder_release_realizes_priority_order():
     # 0 (first), "early" gets 1 (second)
     from namazu_tpu.policy.replayable import fnv64a
 
+    # the policy buckets the event's full replay hint, which for packets
+    # is flow-qualified ("src->dst:<parser hint>")
     table = np.ones((H,), np.float32)
-    table[fnv64a(b"late") % H] = 0.0
-    table[fnv64a(b"early") % H] = 1.0
+    table[fnv64a(b"a->b:late") % H] = 0.0
+    table[fnv64a(b"a->b:early") % H] = 1.0
     pol._delays = table
 
     orc = Orchestrator(cfg, pol, collect_trace=True)
@@ -254,9 +256,11 @@ def test_policy_realized_order_equals_scored_order():
     })
     pol = create_policy("tpu_search")
     pol.load_config(cfg)
-    # priorities invert arrival order inside a window
+    # priorities invert arrival order inside a window; the policy buckets
+    # the flow-qualified replay hint ("a->b:<hint>")
     hints = ["pA", "pB", "pC", "pD"]
-    prios = {"pA": 3.0, "pB": 2.0, "pC": 1.0, "pD": 0.0}
+    full = [f"a->b:{h}" for h in hints]
+    prios = {"a->b:pA": 3.0, "a->b:pB": 2.0, "a->b:pC": 1.0, "a->b:pD": 0.0}
     table = np.full((H,), 10.0, np.float32)
     for h, p in prios.items():
         table[fnv64a(h.encode()) % H] = p
@@ -282,8 +286,9 @@ def test_policy_realized_order_equals_scored_order():
     realized = [h for h, a in sorted(acts,
                                      key=lambda x: x[1].triggered_time)]
 
-    # scored permutation for the same arrivals
-    trace, enc = trace_of(hints, offsets)
+    # scored permutation for the same arrivals (same bucket space as the
+    # policy: the flow-qualified hints)
+    trace, enc = trace_of(full, offsets)
     prio_vec = jnp.asarray(table)
     t = np.asarray(order_release_times(prio_vec, trace, gap=0.002,
                                        window=window))

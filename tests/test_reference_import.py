@@ -1,0 +1,110 @@
+"""Golden-trace tests: the reference's real recorded ZOOKEEPER-2212 hunt
+(example/zk-found-2212.ryu/example-result.20150805 — an actual 3-node
+ZooKeeper cluster under OVS/Ryu interception, 2015) imported and flowed
+through the native stack end to end: storage -> tools -> encoder -> one
+GA search generation. This is the only real-distributed-system data
+available in this image; everything else in tests/ is synthetic.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from namazu_tpu.cli import cli_main
+from namazu_tpu.storage import load_storage
+from namazu_tpu.storage.reference_import import (
+    import_experiment,
+    parse_gob_result,
+    semantic_hint,
+)
+
+REF = "/root/reference/example/zk-found-2212.ryu/example-result.20150805"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference recorded runs not present")
+
+
+@pytest.fixture(scope="module")
+def imported(tmp_path_factory):
+    dest = str(tmp_path_factory.mktemp("golden") / "storage")
+    summary = import_experiment(REF, dest)
+    return dest, summary
+
+
+def test_import_summary_matches_shipped_data(imported):
+    _, summary = imported
+    # the shipped experiment: 4 runs, 2 reproduced the bug (gob Succeed
+    # false), 151 recorded FLE notification round trips in total
+    assert summary["runs"] == 4
+    assert summary["failures"] == 2
+    assert summary["actions"] == 151
+
+
+def test_gob_results_decode(imported):
+    oks = [parse_gob_result(os.path.join(REF, f"{i:08x}", "result"))
+           for i in range(4)]
+    assert [ok for ok, _ in oks] == [True, True, False, False]
+    for _, required_s in oks:
+        # the recorded hunts each took tens of seconds
+        assert 1.0 < required_s < 600.0
+
+
+def test_semantic_hints_land_in_live_parser_format(imported):
+    with open(os.path.join(REF, "00000000", "actions",
+                           "0.event.json")) as f:
+        hint = semantic_hint(json.load(f))
+    # flow-qualified + parser-format content, like live captures
+    assert hint.startswith("zk3->zk1:fle:notif:state=looking:")
+    assert "zxid=" in hint and "epoch=" in hint
+
+
+def test_storage_roundtrip_and_tools(imported, capsys):
+    dest, _ = imported
+    st = load_storage(dest)
+    assert st.nr_stored_histories() == 4
+    trace = st.get_stored_history(0)
+    assert len(trace) == 48
+    a = trace.actions[0]
+    assert a.class_name() == "EventAcceptanceAction"
+    assert a.event_class == "PacketEvent"
+    assert "fle:notif" in a.event_hint and "->" in a.event_hint
+    assert a.option["dst_entity"] in ("zk1", "zk2", "zk3")
+    # the analysis tools run unmodified over imported data
+    assert cli_main(["tools", "summary", dest]) == 0
+    out = capsys.readouterr().out
+    assert "4 runs, 2 successful, 2 failed" in out
+    assert cli_main(["tools", "visualize", dest, "--reduction"]) == 0
+
+
+def test_real_traces_flow_into_search(imported):
+    """Real ZK traces: encode -> feature-space -> one GA generation, the
+    exact ingest path policy/tpu.py _ingest_history drives."""
+    from namazu_tpu.models.ga import GAConfig
+    from namazu_tpu.models.search import ScheduleSearch, SearchConfig
+    from namazu_tpu.ops import trace_encoding as te
+
+    dest, _ = imported
+    st = load_storage(dest)
+    encs, labels = [], []
+    for i in range(4):
+        enc = te.encode_trace(st.get_stored_history(i), H=64)
+        assert enc.length == len(st.get_stored_history(i))
+        # recorded FLE hints hash into more than one bucket
+        assert len(set(enc.hint_ids[enc.mask].tolist())) > 4
+        encs.append(enc)
+        labels.append(st.is_successful(i))
+    search = ScheduleSearch(SearchConfig(
+        H=64, K=64, population=64, seed=3,
+        ga=GAConfig(max_delay=0.4)))
+    occupied = sorted({int(b) for e in encs for b in e.hint_ids[e.mask]})
+    search.set_occupied_buckets(occupied)
+    for enc, ok in zip(encs, labels):
+        search.add_executed_trace(enc, reproduced=not ok)
+        if not ok:
+            search.add_failure_trace(enc)
+    refs = [e for e, ok in zip(encs, labels) if ok]
+    best = search.run(refs, generations=2)
+    assert np.isfinite(best.fitness)
+    assert best.delays.shape == (64,)

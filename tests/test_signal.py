@@ -67,9 +67,10 @@ def test_packet_event_roundtrip_and_hint():
     back = roundtrip(ev)
     assert back.payload == b"\x00\x01vote"
     assert back.replay_hint() == "packet:zk1->zk2"
-    # explicit semantic hint wins
+    # explicit semantic hint is flow-qualified: the same protocol message
+    # on different links must land in different delay buckets
     ev2 = PacketEvent.create("zk1", "zk1", "zk2", hint="fle:vote:3:epoch1")
-    assert ev2.replay_hint() == "fle:vote:3:epoch1"
+    assert ev2.replay_hint() == "zk1->zk2:fle:vote:3:epoch1"
 
 
 def test_packet_event_uuid_excluded_from_equality():
@@ -136,9 +137,9 @@ def test_action_preserves_event_hint():
     wire codec, so recorded traces keep the identity replay/search key on."""
     ev = PacketEvent.create("e", "s", "d", hint="fle:notif:leader=3")
     act = ev.default_action()
-    assert act.event_hint == "fle:notif:leader=3"
+    assert act.event_hint == "s->d:fle:notif:leader=3"
     back = roundtrip(act)
-    assert back.event_hint == "fle:notif:leader=3"
+    assert back.event_hint == "s->d:fle:notif:leader=3"
     # events without an explicit hint still stamp their derived hint
     act2 = PacketEvent.create("e", "s", "d").default_action()
     assert act2.event_hint == "packet:s->d"
