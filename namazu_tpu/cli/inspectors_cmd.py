@@ -32,6 +32,19 @@ def register(sub) -> None:
     _common_flags(pf)
     pf.add_argument("--mount-point", default=None)
     pf.add_argument("--original-dir", default=None)
+    pf.add_argument("--cmd", default=None,
+                    help="spawn this shell command under the LD_PRELOAD "
+                         "interposer (probes the target binary first: "
+                         "statically linked testees fail loudly instead "
+                         "of silently producing zero events)")
+    pf.add_argument("--root", default=None,
+                    help="watched subtree (NMZ_TPU_FS_ROOT) for --cmd")
+    pf.add_argument("--preload-lib", default=None,
+                    help="libnmz_fs_interpose.so path (default: the "
+                         "in-tree native/build)")
+    pf.add_argument("--agent-addr", default=None,
+                    help="host:port of a running agent endpoint; default "
+                         "= embedded autopilot orchestrator")
     pf.set_defaults(func=run_fs)
 
     pe = isub.add_parser("ethernet", help="ethernet (packet) inspector")
@@ -44,6 +57,9 @@ def register(sub) -> None:
                     help="semantic parser: zookeeper (protocol by upstream "
                          "port), zookeeper-fle, zookeeper-zab, "
                          "zookeeper-client, http/etcd")
+    pe.add_argument("--udp", action="store_true",
+                    help="relay UDP datagrams instead of a TCP stream "
+                         "(per-datagram defer/drop/reorder)")
     pe.set_defaults(func=run_ethernet)
 
 
@@ -99,10 +115,13 @@ def run_proc(args) -> int:
 
 def run_fs(args) -> int:
     init_log()
+    if args.cmd is not None:
+        return _run_fs_preload(args)
     from namazu_tpu.inspector.fs import serve_fs_inspector
 
     if not (args.mount_point and args.original_dir):
-        print("error: --mount-point and --original-dir are required",
+        print("error: --mount-point and --original-dir are required "
+              "(or use --cmd for the LD_PRELOAD launcher)",
               file=sys.stderr)
         return 1
     trans, orc = _make_transceiver(args, "_nmz_fs_inspector")
@@ -111,6 +130,118 @@ def run_fs(args) -> int:
     finally:
         if orc is not None:
             orc.shutdown()
+
+
+def _default_preload_lib() -> str:
+    import os
+
+    import namazu_tpu
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(
+        namazu_tpu.__file__)))
+    return os.path.join(pkg, "native", "build", "libnmz_fs_interpose.so")
+
+
+def _run_fs_preload(args) -> int:
+    """Launch a testee under the LD_PRELOAD interposer, fail-loud.
+
+    Two silent-failure modes of preload interposition are made loud
+    (the reference's FUSE hooks, fs.go:56-74, cannot no-op this way):
+
+    * statically linked testee — the dynamic loader never runs, so the
+      hooks never load; detected UP FRONT via the ELF PT_INTERP probe;
+    * zero intercepted events (wrong --root, testee never touched the
+      watched subtree) — detected AFTER the run from the recorded trace
+      (embedded-orchestrator mode).
+    """
+    import os
+    import shlex
+    import shutil as _shutil
+    import subprocess
+
+    from namazu_tpu.utils.elf import has_program_interpreter
+
+    if not args.root:
+        print("error: --root is required with --cmd", file=sys.stderr)
+        return 1
+    if args.orchestrator_url != "local://" and not args.agent_addr:
+        # the preloaded testee speaks the framed-TCP agent protocol, not
+        # REST; silently ignoring the URL would send its events to a
+        # fresh embedded orchestrator while the one the user pointed at
+        # sees nothing
+        print("error: --cmd mode talks the agent protocol; for a remote "
+              "orchestrator pass --agent-addr host:port (its agent "
+              "endpoint), not --orchestrator-url", file=sys.stderr)
+        return 1
+    lib = os.path.abspath(args.preload_lib or _default_preload_lib())
+    if not os.path.exists(lib):
+        print(f"error: interposer library not found: {lib}\n"
+              "build it with: make -C native", file=sys.stderr)
+        return 1
+
+    # Probe the command's target binary. --cmd runs through `sh -c`, so
+    # the probe inspects the first token (the common case: a single
+    # program invocation); shell builtins/pipelines probe as None.
+    tokens = shlex.split(args.cmd)
+    target = _shutil.which(tokens[0]) if tokens else None
+    interp = has_program_interpreter(target) if target else None
+    if interp is False:
+        print(
+            f"error: {target} is a statically linked executable — "
+            "LD_PRELOAD interposition is silently ignored for it, so the "
+            "run would produce zero filesystem events and look healthy. "
+            "Use a dynamically linked build of the testee, or "
+            "library-level interposition (namazu_tpu.inspector.fs."
+            "InterposedFs).", file=sys.stderr)
+        return 1
+    if interp is None and target:
+        print(f"note: cannot probe {target} (not ELF — a script?); "
+              "interposability depends on what it executes",
+              file=sys.stderr)
+
+    entity = args.entity_id or "_nmz_fs_preload"
+    env = dict(os.environ,
+               LD_PRELOAD=lib,
+               NMZ_TPU_ENTITY_ID=entity,
+               NMZ_TPU_FS_ROOT=os.path.abspath(args.root))
+
+    if args.agent_addr:
+        # remote orchestrator: no trace visibility from here, so only
+        # the up-front probe can be enforced
+        env["NMZ_TPU_AGENT_ADDR"] = args.agent_addr
+        return subprocess.run(["sh", "-c", args.cmd], env=env).returncode
+
+    from namazu_tpu.endpoint.agent import AgentEndpoint
+    from namazu_tpu.endpoint.hub import EndpointHub
+    from namazu_tpu.endpoint.local import LocalEndpoint
+    from namazu_tpu.orchestrator import Orchestrator
+    from namazu_tpu.policy import create_policy
+
+    cfg = Config.from_file(args.autopilot) if args.autopilot else Config()
+    policy = create_policy(cfg.get("explore_policy"))
+    policy.load_config(cfg)
+    hub = EndpointHub()
+    hub.add_endpoint(LocalEndpoint())
+    agent = AgentEndpoint(port=0)
+    hub.add_endpoint(agent)
+    orc = Orchestrator(cfg, policy, collect_trace=True, hub=hub)
+    orc.start()
+    env["NMZ_TPU_AGENT_ADDR"] = f"127.0.0.1:{agent.port}"
+    try:
+        rc = subprocess.run(["sh", "-c", args.cmd], env=env).returncode
+    finally:
+        trace = orc.shutdown()
+    n_fs = sum(1 for a in trace if a.event_class == "FilesystemEvent")
+    if n_fs == 0:
+        print(
+            "error: the run completed but ZERO filesystem events were "
+            f"intercepted under {args.root!r}. Either the testee never "
+            "touched the watched subtree, or interposition did not load "
+            "(statically linked helper? exec of a static child?). "
+            "Refusing to report this as a clean run.", file=sys.stderr)
+        return 1
+    print(f"{n_fs} filesystem events intercepted; testee exited {rc}")
+    return rc
 
 
 def make_parser(name, upstream: str = ""):
@@ -145,10 +276,14 @@ def run_ethernet(args) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    if args.udp and parser is not None and hasattr(parser, "segment"):
+        print(f"error: --parser {args.parser} is a stream parser and "
+              "cannot apply to UDP datagrams", file=sys.stderr)
+        return 1
     trans, orc = _make_transceiver(args, "_nmz_ethernet_inspector")
     try:
         return serve_proxy_inspector(trans, args.listen, args.upstream,
-                                     parser=parser)
+                                     parser=parser, udp=args.udp)
     finally:
         if orc is not None:
             orc.shutdown()

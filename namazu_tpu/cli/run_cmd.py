@@ -85,7 +85,7 @@ def run(args) -> int:
         successful = factory.run(validate_script).returncode == 0
     required_time = time.monotonic() - start
 
-    from namazu_tpu.ops.trace_encoding import HINT_SPACE
+    from namazu_tpu.signal.base import HINT_SPACE
 
     storage.record_new_trace(trace)
     # stamp the replay-hint format version: a future format bump must be

@@ -64,6 +64,16 @@ def register(sub) -> None:
                      help="config file (in EXAMPLE) swapped in for phase B")
     pab.add_argument("--json-out", default="",
                      help="also write the result JSON to this path")
+    pab.add_argument("--prime-config", default="config.toml",
+                     help="config used for priming runs (with "
+                          "--prime-runs)")
+    pab.add_argument("--prime-runs", type=int, default=0,
+                     help="record N runs under PRIME-CONFIG first, then "
+                          "run each phase on an independent CLONE of "
+                          "that history (fair search-vs-search "
+                          "comparisons: both train on the same recorded "
+                          "failures, neither sees the other's runs); "
+                          "0 = sequential single-storage A/B")
     pab.set_defaults(func=ab)
 
     pi = tsub.add_parser(
@@ -191,6 +201,12 @@ def ab(args) -> int:
     SURVEY.md 3.1); phase B swaps in the search config — whose policy
     trains on phase A's recorded history — and runs N more. Reports
     repro-rate and repros/hour per policy and their ratio.
+
+    With ``--prime-runs``, the recorded history is produced up front
+    under ``--prime-config`` and each phase runs on its own CLONE of it:
+    the right shape for search-vs-search comparisons (e.g. GA vs MCTS),
+    where both sides must train on identical failures and neither may
+    learn from the other's runs.
     """
     import time as _time
 
@@ -204,13 +220,10 @@ def ab(args) -> int:
             print(f"error: {path} not found", file=sys.stderr)
             return 1
 
-    if cli_main(["init", base_cfg, materials, args.storage]) != 0:
-        return 1
-
-    def phase(n: int) -> float:
+    def phase(storage: str, n: int) -> float:
         t0 = _time.monotonic()
         for _ in range(n):
-            if cli_main(["run", args.storage]) != 0:
+            if cli_main(["run", storage]) != 0:
                 raise RuntimeError("run failed (infra error)")
         return _time.monotonic() - t0
 
@@ -219,13 +232,36 @@ def ab(args) -> int:
     if search_name == baseline_name:  # self-vs-self A/B: keep keys distinct
         search_name += "_b"
 
-    wall_a = phase(args.runs)
-    shutil.copy(search_cfg, os.path.join(args.storage, "config.toml"))
-    wall_b = phase(args.runs)
+    if args.prime_runs > 0:
+        prime_cfg = os.path.join(args.example, args.prime_config)
+        if not os.path.exists(prime_cfg):
+            print(f"error: {prime_cfg} not found", file=sys.stderr)
+            return 1
+        os.makedirs(args.storage, exist_ok=False)
+        prime = os.path.join(args.storage, "prime")
+        if cli_main(["init", prime_cfg, materials, prime]) != 0:
+            return 1
+        phase(prime, args.prime_runs)
+        walls = {}
+        for key, cfg in (("a", base_cfg), ("b", search_cfg)):
+            clone = os.path.join(args.storage, key)
+            shutil.copytree(prime, clone)
+            shutil.copy(cfg, os.path.join(clone, "config.toml"))
+            walls[key] = phase(clone, args.runs)
+        res_a = _phase_stats(load_storage(os.path.join(args.storage, "a")),
+                             args.prime_runs, args.runs, walls["a"])
+        res_b = _phase_stats(load_storage(os.path.join(args.storage, "b")),
+                             args.prime_runs, args.runs, walls["b"])
+    else:
+        if cli_main(["init", base_cfg, materials, args.storage]) != 0:
+            return 1
+        wall_a = phase(args.storage, args.runs)
+        shutil.copy(search_cfg, os.path.join(args.storage, "config.toml"))
+        wall_b = phase(args.storage, args.runs)
+        st = load_storage(args.storage)
+        res_a = _phase_stats(st, 0, args.runs, wall_a)
+        res_b = _phase_stats(st, args.runs, args.runs, wall_b)
 
-    st = load_storage(args.storage)
-    res_a = _phase_stats(st, 0, args.runs, wall_a)
-    res_b = _phase_stats(st, args.runs, args.runs, wall_b)
     ra, rb = res_a["repros_per_hour"], res_b["repros_per_hour"]
     result = {
         "example": os.path.basename(os.path.abspath(args.example)),
@@ -235,6 +271,9 @@ def ab(args) -> int:
         # the BASELINE.md target is >= 10x baseline repros/hour
         "repros_per_hour_ratio": round(rb / ra, 2) if ra > 0 else None,
     }
+    if args.prime_runs > 0:
+        result["primed_runs"] = args.prime_runs
+        result["prime_config"] = args.prime_config
     for name, res in ((baseline_name, res_a), (search_name, res_b)):
         print(f"{name:>12}: {res['repros']}/{res['runs']} repros "
               f"({100 * res['repro_rate']:.0f}%), {res['wall_s']}s, "

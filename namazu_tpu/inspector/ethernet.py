@@ -189,6 +189,141 @@ class ProxyLink:
                     pass
 
 
+class UdpProxyLink:
+    """One inspected UDP relay: datagrams in via the listen socket are
+    deferred per-datagram and forwarded to the upstream address; replies
+    from upstream route back to the most recent client address.
+
+    UDP is where per-packet interception semantics are CLEAN, unlike the
+    TCP proxy's parsed streams: a datagram is a self-contained message,
+    so a drop is exactly the reference's NF_DROP (any-IP capture,
+    /root/reference/nmz/inspector/ethernet/ethernet_nfq.go:95-103 — its
+    packet verdicts are per-datagram for UDP flows) and independent
+    per-datagram release order IS the interleaving being fuzzed — no
+    stream to desynchronize, no retransmit problem (UDP has none).
+    """
+
+    #: bounded concurrent deferrals: a datagram burst must not spawn a
+    #: thread per packet (thousands of parked ch.get threads distort the
+    #: very timing being fuzzed); N workers give N-way independent
+    #: release reordering, and bursts beyond N queue FIFO behind them
+    RELEASE_WORKERS = 16
+
+    def __init__(
+        self,
+        inspector: "EthernetProxyInspector",
+        listen: str,
+        upstream: str,
+        src_entity: str,
+        dst_entity: str,
+    ):
+        self.inspector = inspector
+        self.listen = _addr(listen)
+        self.upstream = _addr(upstream)
+        self.src_entity = src_entity
+        self.dst_entity = dst_entity
+        self._sock: Optional[socket.socket] = None
+        self._up: Optional[socket.socket] = None
+        self._client_addr: Optional[tuple] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._rel_q: _queue.Queue = _queue.Queue()
+
+    @property
+    def port(self) -> int:
+        assert self._sock is not None
+        return self._sock.getsockname()[1]
+
+    def start(self) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind(self.listen)
+        self._up = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._up.bind((self.listen[0], 0))
+        conn = self.inspector.next_conn_id()
+        for name, sock, fwd, se, de in (
+            ("fwd", self._sock, self._send_upstream,
+             self.src_entity, self.dst_entity),
+            ("rev", self._up, self._send_client,
+             self.dst_entity, self.src_entity),
+        ):
+            threading.Thread(
+                target=self._recv_loop, args=(sock, fwd, se, de, conn),
+                daemon=True,
+                name=f"udp-{name}-{se}->{de}",
+            ).start()
+        for i in range(self.RELEASE_WORKERS):
+            threading.Thread(
+                target=self._release_worker, daemon=True,
+                name=f"udp-release-{self.src_entity}-{i}",
+            ).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for _ in range(self.RELEASE_WORKERS):
+            self._rel_q.put(None)
+        for s in (self._sock, self._up):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def _send_upstream(self, data: bytes) -> None:
+        self._up.sendto(data, self.upstream)
+
+    def _send_client(self, data: bytes) -> None:
+        with self._lock:
+            addr = self._client_addr
+        if addr is not None:
+            self._sock.sendto(data, addr)
+
+    def _recv_loop(self, sock: socket.socket, forward, src_entity: str,
+                   dst_entity: str, conn_id: int) -> None:
+        insp = self.inspector
+        while not self._stop.is_set():
+            try:
+                data, addr = sock.recvfrom(65536)
+            except OSError:
+                return
+            if sock is self._sock:
+                with self._lock:
+                    self._client_addr = addr
+            seg, ch, event = insp.intercept_datagram(
+                data, src_entity, dst_entity, conn_id)
+            if ch is None:
+                forward(seg)
+                continue
+            # datagrams release independently as their actions arrive —
+            # true per-packet reordering, which a byte stream cannot
+            # allow but datagram semantics do (bounded by the worker
+            # pool; see RELEASE_WORKERS)
+            self._rel_q.put((seg, ch, event, forward))
+
+    def _release_worker(self) -> None:
+        insp = self.inspector
+        while True:
+            item = self._rel_q.get()
+            if item is None:
+                return
+            data, ch, event, forward = item
+            try:
+                action = ch.get(timeout=insp.action_timeout)
+            except _queue.Empty:
+                insp.trans.forget(event)
+                log.warning("datagram %s->%s: no action in %ss; releasing",
+                            event.option.get("src_entity"),
+                            event.option.get("dst_entity"),
+                            insp.action_timeout)
+                action = None
+            if isinstance(action, PacketFaultAction):
+                insp.drop_count += 1  # the fault: datagram never forwarded
+                continue
+            try:
+                forward(data)
+            except OSError:
+                pass
+
+
 class EthernetProxyInspector:
     def __init__(
         self,
@@ -228,6 +363,22 @@ class EthernetProxyInspector:
     def add_link(self, listen: str, upstream: str,
                  src_entity: str, dst_entity: str) -> ProxyLink:
         link = ProxyLink(self, listen, upstream, src_entity, dst_entity)
+        self.links.append(link)
+        return link
+
+    def add_udp_link(self, listen: str, upstream: str,
+                     src_entity: str, dst_entity: str) -> UdpProxyLink:
+        """Inspect a UDP flow (per-datagram defer/drop/reorder)."""
+        if self.parser is not None and hasattr(self.parser, "segment"):
+            # a stream parser buffers partial TCP frames across calls —
+            # on datagrams that silently holds/merges packets; refuse
+            # rather than lose traffic
+            raise ValueError(
+                f"{type(self.parser).__name__} is a stream parser and "
+                "cannot apply to UDP datagrams; use a chunk-level parser "
+                "or none"
+            )
+        link = UdpProxyLink(self, listen, upstream, src_entity, dst_entity)
         self.links.append(link)
         return link
 
@@ -279,15 +430,39 @@ class EthernetProxyInspector:
             out.append((data, ch, event))
         return out
 
+    def intercept_datagram(self, data: bytes, src_entity: str,
+                           dst_entity: str, conn_id: int = 0):
+        """One datagram -> at most one deferred event.
+
+        Stream segmentation never applies here (it would buffer bytes of
+        "incomplete frames" across datagrams — i.e. silently hold or
+        merge packets); chunk-level parsers run per datagram, and a
+        ``None`` hint forwards without deferring, same contract as
+        :meth:`intercept`."""
+        hint = ""
+        if self.parser is not None:
+            if self._parser_takes_conn:
+                hint = self.parser(data, src_entity, dst_entity, conn_id)
+            else:
+                hint = self.parser(data, src_entity, dst_entity)
+            if hint is None:
+                return (data, None, None)
+        self.packet_count += 1
+        event = PacketEvent.create(
+            self.entity_id, src_entity, dst_entity,
+            payload=data[:128], hint=hint or "",
+        )
+        return (data, self.trans.send_event(event), event)
+
 
 def serve_proxy_inspector(
     transceiver: Transceiver, listen: str, upstream: str,
-    parser: Optional[PacketParser] = None,
+    parser: Optional[PacketParser] = None, udp: bool = False,
 ) -> int:
     """CLI entry: proxy one link until interrupted."""
     inspector = EthernetProxyInspector(transceiver, parser=parser)
-    inspector.add_link(listen, upstream, src_entity="client",
-                       dst_entity="server")
+    add = inspector.add_udp_link if udp else inspector.add_link
+    add(listen, upstream, src_entity="client", dst_entity="server")
     inspector.start()
     try:
         threading.Event().wait()

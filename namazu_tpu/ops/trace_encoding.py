@@ -23,20 +23,16 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from namazu_tpu.policy.replayable import fnv64a
+# the hint-format version lives with the signal classes that define the
+# hints (stdlib-only, so the control plane can stamp runs without numpy);
+# re-exported here because the search plane reads it alongside encoding
+from namazu_tpu.signal.base import HINT_SPACE  # noqa: F401  (re-export)
 from namazu_tpu.utils.trace import SingleTrace
 
 DEFAULT_L = 256  # default length quantum for encoded traces
 DEFAULT_H = 256  # hint buckets (genome length)
 DEFAULT_K = 256  # precedence pairs (feature dimension)
 
-# Version tag of the replay-hint format whose fnv64a hashes the bucket
-# space is built from. Bump whenever hint derivation changes in a way
-# that re-buckets events (it invalidates every delay table, archive
-# feature, and checkpoint): "flow-v2" = packet hints are flow-qualified
-# ("src->dst:<content>", signal/event.py PacketEvent.replay_hint);
-# checkpoints from other spaces are rejected at load
-# (models/search.py) rather than silently delivering arbitrary delays.
-HINT_SPACE = "flow-v2"
 
 
 def checkpoint_hint_space(z) -> str:

@@ -92,6 +92,12 @@ class TPUSearchPolicy(QueueBackedPolicy):
         # window boundaries at the same offsets
         self._anchor: Optional[float] = None
         self._anchor_set = threading.Event()
+        # injectable clock: every reorder-window decision (arrival
+        # stamping, window-boundary ticks, closed-window drains) reads
+        # time through this hook, so the realized-vs-scored order
+        # invariant is testable with a scripted clock and zero real
+        # sleeps instead of margin-widened wall-clock waits
+        self._now = time.monotonic
         self.mcts_simulations = 256
         self.mcts_tree_depth = 24
         self.mcts_levels = 8
@@ -256,7 +262,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
                 self._emit(self._action_for(event))
                 return
             prio = self._delay_for(event.replay_hint())
-            now = time.monotonic()
+            now = self._now()
             with self._pending_lock:
                 if self._anchor is None:
                     self._anchor = now
@@ -339,7 +345,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
         # phase 2: aligned ticks
         while not self._stop_reorder.is_set():
             anchor = self._anchor
-            now = time.monotonic()
+            now = self._now()
             k = int((now - anchor) // w) + 1
             if self._stop_reorder.wait(max(0.0, anchor + k * w - now)):
                 break
@@ -657,9 +663,10 @@ class TPUSearchPolicy(QueueBackedPolicy):
             n = storage.nr_stored_histories()
         except Exception:
             return []
-        from namazu_tpu.ops.trace_encoding import HINT_SPACE
+        from namazu_tpu.signal.base import HINT_SPACE
 
         encoded = []
+        skipped_unstamped = 0
         for i in range(n):
             try:
                 trace = storage.get_stored_history(i)
@@ -669,19 +676,18 @@ class TPUSearchPolicy(QueueBackedPolicy):
             # runs recorded under a different replay-hint format hash
             # into a different bucket space — training on them would
             # deliver arbitrary delays under a "searched schedule" log.
-            # An absent stamp (in-process test fixtures, pre-stamp
-            # storages) is assumed current: pre-stamp dirs cannot be
-            # told apart, and all recordings made by this build are
-            # stamped (cli/run_cmd.py).
+            # Absent stamps default to "content-v1", the same convention
+            # the checkpoint loader uses (te.checkpoint_hint_space):
+            # every recording made by a stamping build carries the tag
+            # (cli/run_cmd.py), so an unstamped run IS a pre-flow-prefix
+            # recording and must not train this build's search.
             try:
-                stamp = (storage.get_metadata(i) or {}).get("hint_space")
+                stamp = ((storage.get_metadata(i) or {})
+                         .get("hint_space", "content-v1"))
             except Exception:
-                stamp = None
-            if stamp and stamp != HINT_SPACE:
-                log.warning(
-                    "run %d was recorded in hint space %s (this build: "
-                    "%s); excluded from search ingest", i, stamp,
-                    HINT_SPACE)
+                stamp = "content-v1"
+            if stamp != HINT_SPACE:
+                skipped_unstamped += 1
                 continue
             if self.L > 0:
                 cap = self.L
@@ -708,6 +714,12 @@ class TPUSearchPolicy(QueueBackedPolicy):
             # whole ingest would multiply peak memory on long experiments
             seed = None if ok else self._failure_seed(trace)
             encoded.append((enc, enc_rt, ok, seed))
+        if skipped_unstamped:
+            log.warning(
+                "%d stored run(s) recorded in another hint space were "
+                "excluded from search ingest (this build: %s); re-record "
+                "under the current build to train on them",
+                skipped_unstamped, HINT_SPACE)
         # concentrate the feature pairs on the buckets the experiment
         # actually produces BEFORE embedding anything (a pair change
         # clears the archives; this loop repopulates them in full)

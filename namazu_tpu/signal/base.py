@@ -18,6 +18,17 @@ from enum import Enum
 from typing import Any, Dict, Iterable, Optional, Type
 
 
+# Version tag of the replay-hint format (the strings replay_hint()
+# methods below produce, whose fnv64a hashes build the search plane's
+# bucket space). Bump whenever hint derivation changes in a way that
+# re-buckets events — it invalidates every delay table, archive feature,
+# checkpoint, and recorded history: "flow-v2" = packet hints are
+# flow-qualified ("src->dst:<content>", event.py PacketEvent.replay_hint).
+# Artifacts from other spaces are rejected at load (models/search.py,
+# policy/tpu.py) rather than silently delivering arbitrary delays.
+HINT_SPACE = "flow-v2"
+
+
 class SignalType(str, Enum):
     EVENT = "event"
     ACTION = "action"

@@ -1,0 +1,62 @@
+"""Minimal ELF program-header probe: can LD_PRELOAD interpose a binary?
+
+The LD_PRELOAD fs interposer silently does nothing for statically linked
+testees (the dynamic linker never runs, so the hooks never load) — which
+includes Go binaries such as etcd, one of the reference's flagship
+targets. The reference's FUSE backend (/root/reference/nmz/inspector/fs/
+fs.go:56-74) cannot vacuously no-op like that, so the preload launcher
+probes the target up front and fails loudly instead of producing a clean
+zero-event run.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+PT_INTERP = 3
+
+
+def has_program_interpreter(path: str) -> Optional[bool]:
+    """Whether the file is an ELF executable with a PT_INTERP segment.
+
+    ``True``  — dynamically linked: the LD_PRELOAD interposer will load.
+    ``False`` — ELF without an interpreter (statically linked): LD_PRELOAD
+    is silently ignored by the kernel/loader.
+    ``None``  — not an ELF file (e.g. a ``#!`` script) or unreadable;
+    interposability depends on what the file eventually executes.
+    """
+    try:
+        with open(path, "rb") as f:
+            ident = f.read(16)
+            if len(ident) < 16 or ident[:4] != b"\x7fELF":
+                return None
+            ei_class, ei_data = ident[4], ident[5]
+            end = "<" if ei_data == 1 else ">"
+            if ei_class == 2:  # ELF64
+                hdr = f.read(48)
+                if len(hdr) < 42:
+                    return None
+                (_t, _m, _v, _entry, e_phoff, _shoff, _flags, _ehsize,
+                 e_phentsize, e_phnum) = struct.unpack(
+                    end + "HHIQQQIHHH", hdr[:42])
+            elif ei_class == 1:  # ELF32
+                hdr = f.read(36)
+                if len(hdr) < 28:
+                    return None
+                (_t, _m, _v, _entry, e_phoff, _shoff, _flags, _ehsize,
+                 e_phentsize, e_phnum) = struct.unpack(
+                    end + "HHIIIIIHHH", hdr[:28])
+            else:
+                return None
+            f.seek(e_phoff)
+            for _ in range(e_phnum):
+                ph = f.read(e_phentsize)
+                if len(ph) < 4:
+                    return None
+                (p_type,) = struct.unpack(end + "I", ph[:4])
+                if p_type == PT_INTERP:
+                    return True
+            return False
+    except OSError:
+        return None

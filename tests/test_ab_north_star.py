@@ -35,7 +35,7 @@ seed = 0
 """
 
 PHASE_A_RUNS = 10
-PHASE_B_MAX_RUNS = 6
+PHASE_B_MAX_RUNS = 8
 
 
 def test_tpu_search_repro_rate_at_least_random(tmp_path):

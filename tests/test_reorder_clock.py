@@ -1,0 +1,95 @@
+"""Realized-vs-scored order with a scripted clock — ZERO real sleeps.
+
+The wall-clock variants in test_order_mode.py drive a live orchestrator
+through real reorder windows and need generous margins to survive CI
+scheduling stalls. Here the policy's injectable clock (``_now``) scripts
+the arrivals exactly, drains are invoked at explicit window boundaries,
+and the realized release order is compared against the scorer's
+``order_release_times`` permutation for the same arrivals — the
+realized==scored invariant, deterministic and instant.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from namazu_tpu.ops.schedule import TraceArrays, order_release_times
+from namazu_tpu.policy import create_policy
+from namazu_tpu.policy.replayable import fnv64a
+from namazu_tpu.signal import PacketEvent
+from namazu_tpu.utils.config import Config
+
+H = 64
+WINDOW = 0.25
+
+
+def make_policy(table):
+    pol = create_policy("tpu_search")
+    pol.load_config(Config({
+        "explore_policy": "tpu_search",
+        "explore_policy_param": {
+            "seed": 5, "release_mode": "reorder",
+            "reorder_window": int(WINDOW * 1000), "reorder_gap": 1,
+            "search_on_start": False, "hint_buckets": H,
+        },
+    }))
+    pol._delays = table
+    pol.start = lambda: None  # no threads: drains are driven explicitly
+    released = []
+    pol._emit = released.append
+    return pol, released
+
+
+def scripted(pol, arrivals_hints):
+    """Queue events at scripted fake-clock arrival times."""
+    for t, hint in arrivals_hints:
+        pol._now = lambda t=t: t
+        pol.queue_event(PacketEvent.create("n0", "a", "b", hint=hint))
+
+
+def test_realized_order_equals_scored_order_no_sleeps():
+    hints = ["pA", "pB", "pC", "pD", "pE"]
+    # pD arrives in window 1; the rest co-pend in window 0 and must be
+    # permuted by priority, while pD stays behind the boundary
+    arrivals = [0.01, 0.05, 0.11, 0.30, 0.18]
+    prios = {f"a->b:{h}": p
+             for h, p in zip(hints, [4.0, 1.0, 3.0, 0.0, 2.0])}
+    table = np.full((H,), 9.0, np.float32)
+    for h, p in prios.items():
+        table[fnv64a(h.encode()) % H] = p
+
+    pol, released = make_policy(table)
+    scripted(pol, zip(arrivals, hints))
+    assert pol._anchor == arrivals[0]
+
+    # drain window 0 at its boundary, then everything at the next
+    pol._drain_pending(gap=0.0, boundary=pol._anchor + WINDOW)
+    n_first = len(released)
+    pol._drain_pending(gap=0.0, boundary=pol._anchor + 2 * WINDOW)
+    realized = [a.event_hint.split(":", 1)[1] for a in released]
+
+    # the scorer's permutation for the same arrivals/buckets
+    enc_hints = [f"a->b:{h}" for h in hints]
+    hint_ids = jnp.asarray([fnv64a(h.encode()) % H for h in enc_hints])
+    trace = TraceArrays(
+        hint_ids,
+        jnp.asarray(np.asarray(arrivals, np.float32) - arrivals[0]),
+        jnp.ones((len(hints),), bool),
+    )
+    t = np.asarray(order_release_times(
+        jnp.asarray(table), trace, gap=0.001, window=WINDOW))
+    scored = [hints[i] for i in np.argsort(t, kind="stable")]
+
+    assert realized == scored
+    # window 0 closed with exactly its own four events
+    assert n_first == 4 and realized[-1] == "pD"
+
+
+def test_window_boundary_respects_scripted_arrivals():
+    """An event arriving after a drain boundary stays pending."""
+    table = np.zeros((H,), np.float32)
+    pol, released = make_policy(table)
+    scripted(pol, [(0.0, "x"), (0.6, "y")])
+    pol._drain_pending(gap=0.0, boundary=0.25)
+    assert [a.event_hint for a in released] == ["a->b:x"]
+    pol._drain_pending(gap=0.0, boundary=None)  # shutdown flush
+    assert [a.event_hint for a in released] == ["a->b:x", "a->b:y"]

@@ -23,7 +23,12 @@ def record_run(storage, entities, successful):
         a.mark_triggered(now + i * 0.002)
         t.append(a)
     storage.record_new_trace(t)
-    storage.record_result(successful, 0.5)
+    from namazu_tpu.signal.base import HINT_SPACE
+
+    # stamp like cli/run_cmd.py does: unstamped runs are treated as
+    # pre-flow-prefix recordings and excluded from search ingest
+    storage.record_result(successful, 0.5,
+                          metadata={"hint_space": HINT_SPACE})
 
 
 @pytest.fixture

@@ -1,0 +1,137 @@
+"""UDP link type: per-datagram defer/drop/reorder through live policies.
+
+Parity target: the reference's NFQUEUE backend captures any IP traffic
+and its verdicts are naturally per-datagram for UDP
+(/root/reference/nmz/inspector/ethernet/ethernet_nfq.go:95-103); the TCP
+proxy cannot carry UDP at all, and drops on parsed TCP streams have
+messy semantics — on UDP a drop is exactly NF_DROP.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from namazu_tpu.inspector.ethernet import EthernetProxyInspector
+from namazu_tpu.inspector.transceiver import new_transceiver
+from namazu_tpu.orchestrator import Orchestrator
+from namazu_tpu.policy import create_policy
+from namazu_tpu.utils.config import Config
+
+
+@pytest.fixture
+def echo_server():
+    srv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    srv.bind(("127.0.0.1", 0))
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            try:
+                data, addr = srv.recvfrom(65536)
+            except OSError:
+                return
+            srv.sendto(b"echo:" + data, addr)
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    yield srv.getsockname()[1]
+    stop.set()
+    srv.close()
+
+
+def run_inspector(policy_name, params, echo_port):
+    cfg = Config({"explore_policy": policy_name,
+                  "explore_policy_param": params})
+    policy = create_policy(policy_name)
+    policy.load_config(cfg)
+    orc = Orchestrator(cfg, policy, collect_trace=True)
+    orc.start()
+    trans = new_transceiver("local://", "_udp_test", orc.local_endpoint)
+    insp = EthernetProxyInspector(trans, entity_id="_udp_test",
+                                  action_timeout=10.0)
+    link = insp.add_udp_link("127.0.0.1:0", f"127.0.0.1:{echo_port}",
+                             src_entity="client", dst_entity="server")
+    insp.start()
+    return orc, insp, link
+
+
+def test_udp_echo_roundtrip_with_delay(echo_server):
+    """Datagrams pass both directions through the policy; a dumb policy
+    with an interval defers each datagram measurably."""
+    orc, insp, link = run_inspector("dumb", {"interval": 150}, echo_server)
+    try:
+        cli = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        cli.settimeout(10.0)
+        t0 = time.monotonic()
+        cli.sendto(b"ping", ("127.0.0.1", link.port))
+        data, _ = cli.recvfrom(65536)
+        rtt = time.monotonic() - t0
+        assert data == b"echo:ping"
+        # request + reply each deferred >= 150 ms by the dumb interval
+        assert rtt >= 0.3
+        assert insp.packet_count == 2
+        cli.close()
+    finally:
+        insp.stop()
+        trace = orc.shutdown()
+    hints = {a.event_hint for a in trace}
+    assert {"packet:client->server", "packet:server->client"} <= hints
+
+
+def test_udp_drop_is_clean(echo_server):
+    """fault_action_probability=1 drops every datagram — the echo never
+    arrives, nothing desyncs, the socket stays usable."""
+    orc, insp, link = run_inspector(
+        "random", {"min_interval": 0, "max_interval": 1,
+                   "fault_action_probability": 1.0, "seed": 1},
+        echo_server)
+    try:
+        cli = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        cli.settimeout(0.8)
+        cli.sendto(b"lost", ("127.0.0.1", link.port))
+        with pytest.raises(socket.timeout):
+            cli.recvfrom(65536)
+        deadline = time.monotonic() + 5
+        while insp.drop_count < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert insp.drop_count >= 1
+        cli.close()
+    finally:
+        insp.stop()
+        orc.shutdown()
+
+
+def test_udp_datagrams_reorder_independently(echo_server):
+    """Per-datagram release means a later datagram can overtake an
+    earlier one — the interleaving a byte stream could never produce.
+    The tpu_search reorder table gives datagram 'a' a later priority...
+    delay mode: bucket of hint packet:client->server applies to both, so
+    instead use the replayable policy whose per-hint delay differs —
+    here both datagrams share a flow hint, so reordering is exercised
+    via the random policy's independent draws: send N datagrams, assert
+    the echo order differs from send order at least once."""
+    orc, insp, link = run_inspector(
+        "random", {"min_interval": 0, "max_interval": 120, "seed": 3},
+        echo_server)
+    try:
+        cli = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        cli.settimeout(10.0)
+        n = 8
+        for i in range(n):
+            cli.sendto(b"m%d" % i, ("127.0.0.1", link.port))
+            time.sleep(0.005)
+        got = []
+        for _ in range(n):
+            data, _ = cli.recvfrom(65536)
+            got.append(data.removeprefix(b"echo:"))
+        assert sorted(got) == [b"m%d" % i for i in range(n)]
+        assert got != [b"m%d" % i for i in range(n)], (
+            "8 datagrams with U[0,120ms] independent delays arrived in "
+            "perfect send order — per-datagram reordering is not happening"
+        )
+        cli.close()
+    finally:
+        insp.stop()
+        orc.shutdown()
