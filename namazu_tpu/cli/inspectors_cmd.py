@@ -60,6 +60,14 @@ def register(sub) -> None:
     pe.add_argument("--udp", action="store_true",
                     help="relay UDP datagrams instead of a TCP stream "
                          "(per-datagram defer/drop/reorder)")
+    pe.add_argument("--hookswitch", default=None,
+                    help="serve hookswitch verdicts on this ZMQ address "
+                         "(e.g. ipc:///tmp/hookswitch-socket) instead of "
+                         "proxying; raw ethernet frames from an external "
+                         "switch, any-IP capture")
+    pe.add_argument("--no-tcp-watcher", action="store_true",
+                    help="disable TCP retransmit suppression "
+                         "(hookswitch mode)")
     pe.set_defaults(func=run_ethernet)
 
 
@@ -211,21 +219,19 @@ def _run_fs_preload(args) -> int:
         env["NMZ_TPU_AGENT_ADDR"] = args.agent_addr
         return subprocess.run(["sh", "-c", args.cmd], env=env).returncode
 
-    from namazu_tpu.endpoint.agent import AgentEndpoint
-    from namazu_tpu.endpoint.hub import EndpointHub
-    from namazu_tpu.endpoint.local import LocalEndpoint
     from namazu_tpu.orchestrator import Orchestrator
     from namazu_tpu.policy import create_policy
 
     cfg = Config.from_file(args.autopilot) if args.autopilot else Config()
+    # agent_port 0 makes the default hub include an agent endpoint on an
+    # auto-assigned port (orchestrator/core.py; same wiring container.py
+    # uses) — and a rest_port in the --autopilot config still works
+    cfg.set("agent_port", 0)
     policy = create_policy(cfg.get("explore_policy"))
     policy.load_config(cfg)
-    hub = EndpointHub()
-    hub.add_endpoint(LocalEndpoint())
-    agent = AgentEndpoint(port=0)
-    hub.add_endpoint(agent)
-    orc = Orchestrator(cfg, policy, collect_trace=True, hub=hub)
+    orc = Orchestrator(cfg, policy, collect_trace=True)
     orc.start()
+    agent = orc.hub.endpoint("agent")
     env["NMZ_TPU_AGENT_ADDR"] = f"127.0.0.1:{agent.port}"
     try:
         rc = subprocess.run(["sh", "-c", args.cmd], env=env).returncode
@@ -268,6 +274,29 @@ def run_ethernet(args) -> int:
     init_log()
     from namazu_tpu.inspector.ethernet import serve_proxy_inspector
 
+    if args.hookswitch:
+        if args.udp:
+            print("error: --udp and --hookswitch are mutually exclusive "
+                  "(the switch sends raw frames of any protocol)",
+                  file=sys.stderr)
+            return 1
+        from namazu_tpu.inspector.hookswitch import (
+            serve_hookswitch_inspector,
+            zmq_available,
+        )
+
+        if not zmq_available():
+            print("error: the hookswitch backend needs pyzmq; use the "
+                  "TCP-proxy or UDP backends instead", file=sys.stderr)
+            return 1
+        trans, orc = _make_transceiver(args, "_nmz_ethernet_inspector")
+        try:
+            return serve_hookswitch_inspector(
+                trans, args.hookswitch,
+                enable_tcp_watcher=not args.no_tcp_watcher)
+        finally:
+            if orc is not None:
+                orc.shutdown()
     if not (args.listen and args.upstream):
         print("error: --listen and --upstream are required", file=sys.stderr)
         return 1

@@ -237,7 +237,11 @@ def ab(args) -> int:
         if not os.path.exists(prime_cfg):
             print(f"error: {prime_cfg} not found", file=sys.stderr)
             return 1
-        os.makedirs(args.storage, exist_ok=False)
+        if os.path.exists(args.storage):
+            print(f"error: {args.storage} exists; remove it or pick "
+                  "another storage dir", file=sys.stderr)
+            return 1
+        os.makedirs(args.storage)
         prime = os.path.join(args.storage, "prime")
         if cli_main(["init", prime_cfg, materials, prime]) != 0:
             return 1

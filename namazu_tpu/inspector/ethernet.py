@@ -136,6 +136,17 @@ class ProxyLink:
         rel_q: _queue.Queue = _queue.Queue()
         insp = self.inspector
 
+        # Drop semantics depend on framing: a message-segmenting parser
+        # guarantees the skipped bytes are one whole protocol message,
+        # so the peer's decoder stays in sync (the closest analogue of
+        # the reference's NF_DROP, which TCP itself repairs by
+        # retransmission). On raw/chunk links a skip would tear an
+        # arbitrary byte range out of a live stream — a fault model no
+        # real network produces — so the drop is realized as a
+        # CONNECTION CLOSE instead: a reset is a real-world fault, and
+        # the testee's reconnect logic (not its codec) absorbs it.
+        framed = hasattr(insp.parser, "segment")
+
         def writer() -> None:
             while True:
                 item = rel_q.get()
@@ -153,7 +164,18 @@ class ProxyLink:
                         action = None
                     if isinstance(action, PacketFaultAction):
                         insp.drop_count += 1
-                        continue  # the fault: message never forwarded
+                        if framed:
+                            continue  # skip one whole message
+                        log.info(
+                            "drop on unframed link %s->%s: closing the "
+                            "connection (byte-range skips would desync "
+                            "the stream)", src_entity, dst_entity)
+                        for s in (src, dst):
+                            try:
+                                s.shutdown(socket.SHUT_RDWR)
+                            except OSError:
+                                pass
+                        break
                 if data:
                     try:
                         dst.sendall(data)
@@ -291,7 +313,11 @@ class UdpProxyLink:
             seg, ch, event = insp.intercept_datagram(
                 data, src_entity, dst_entity, conn_id)
             if ch is None:
-                forward(seg)
+                try:
+                    forward(seg)
+                except OSError:
+                    pass  # transient send failure must not kill the
+                    # whole receive direction (datagrams are lossy)
                 continue
             # datagrams release independently as their actions arrive —
             # true per-packet reordering, which a byte stream cannot

@@ -1,0 +1,212 @@
+"""Hookswitch (ZMQ) ethernet inspector backend.
+
+Speaks the hookswitch wire protocol (parity:
+/root/reference/nmz/inspector/ethernet/ethernet_hookswitch.go:56-160 and
+the pynmz worker, misc/pynmz/inspector/ether.py): the inspector BINDS a
+ZMQ PAIR socket; the external switch (Openflow 1.3 via Ryu, or a
+userspace NFQ hook) connects and sends each captured ethernet frame as a
+two-part message ``[json {"id": N, "op": ...}, frame bytes]``; the
+inspector replies ``[json {"id": N, "op": "accept"|"drop"}, b""]`` once
+the policy decides. This is the "any IP traffic" capture path the
+userspace TCP proxy cannot provide — the switch sees raw frames, so TCP
+retransmit suppression (rawpacket.TcpRetransWatcher) is REQUIRED here,
+exactly the problem the proxy design sidesteps.
+
+Gated on pyzmq (present in this image); the external hookswitch process
+itself is not shipped here — tests drive the inspector with a fake
+switch socket, the same strategy the reference's own suite uses
+(ethernet_test.go:36-80).
+"""
+
+from __future__ import annotations
+
+import json
+import queue as _queue
+import threading
+from typing import Optional
+
+from namazu_tpu.inspector.rawpacket import TcpRetransWatcher, decode_ethernet
+from namazu_tpu.inspector.transceiver import Transceiver
+from namazu_tpu.signal.action import PacketFaultAction
+from namazu_tpu.signal.event import PacketEvent
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("inspector.hookswitch")
+
+
+def zmq_available() -> bool:
+    try:
+        import zmq  # noqa: F401
+    except ImportError:
+        return False
+    return True
+
+
+class HookSwitchInspector:
+    """One ZMQ PAIR endpoint serving verdicts to an external switch."""
+
+    #: bounded concurrent deferrals (same rationale as
+    #: ethernet.UdpProxyLink.RELEASE_WORKERS: a frame burst must not
+    #: become a thread per packet)
+    DECIDE_WORKERS = 16
+
+    def __init__(
+        self,
+        transceiver: Transceiver,
+        zmq_addr: str = "ipc:///tmp/nmz-hookswitch",
+        entity_id: str = "_nmz_ethernet_inspector",
+        enable_tcp_watcher: bool = True,
+        action_timeout: Optional[float] = 30.0,
+    ):
+        if not zmq_available():
+            raise RuntimeError(
+                "hookswitch backend needs pyzmq; none importable. Use the "
+                "TCP-proxy or UDP backends (inspector/ethernet.py), which "
+                "have no dependencies."
+            )
+        self.trans = transceiver
+        self.zmq_addr = zmq_addr
+        self.entity_id = entity_id
+        self.action_timeout = action_timeout
+        self.watcher = TcpRetransWatcher() if enable_tcp_watcher else None
+        self.packet_count = 0
+        self.drop_count = 0
+        self.retrans_count = 0
+        self._ctx = None
+        self._sock = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._decide_q: _queue.Queue = _queue.Queue()
+        # verdicts are queued here and sent by the serve thread: ZMQ
+        # sockets are not thread-safe, and a worker's send racing the
+        # serve loop's recv on the same PAIR socket can abort the
+        # process — ALL socket use stays on one thread
+        self._out_q: _queue.Queue = _queue.Queue()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        import zmq
+
+        self.trans.start()
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.PAIR)
+        self._sock.bind(self.zmq_addr)
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="hookswitch-serve")
+        self._thread.start()
+        for i in range(self.DECIDE_WORKERS):
+            threading.Thread(target=self._decide_worker, daemon=True,
+                             name=f"hookswitch-decide-{i}").start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        for _ in range(self.DECIDE_WORKERS):
+            self._decide_q.put(None)
+        if self._sock is not None:
+            try:
+                self._sock.close(linger=0)
+            except Exception:  # pragma: no cover - zmq teardown races
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- wire -------------------------------------------------------------
+
+    def _reply(self, frame_id: int, op: str) -> None:
+        meta = json.dumps({"id": frame_id, "op": op}).encode()
+        self._out_q.put([meta, b""])
+
+    def _flush_replies(self) -> None:
+        import zmq
+
+        while True:
+            try:
+                msg = self._out_q.get_nowait()
+            except _queue.Empty:
+                return
+            try:
+                self._sock.send_multipart(msg)
+            except zmq.ZMQError:
+                return
+
+    def _serve(self) -> None:
+        import zmq
+
+        poller = zmq.Poller()
+        poller.register(self._sock, zmq.POLLIN)
+        while not self._stop.is_set():
+            try:
+                ready = poller.poll(timeout=50)
+                self._flush_replies()
+                if not ready:
+                    continue
+                parts = self._sock.recv_multipart(flags=zmq.NOBLOCK)
+            except zmq.ZMQError:
+                return
+            if len(parts) != 2:
+                log.warning("strange hookswitch message: %d parts",
+                            len(parts))
+                continue
+            try:
+                meta = json.loads(parts[0])
+                frame_id = int(meta["id"])
+            except (ValueError, KeyError) as e:
+                log.warning("bad hookswitch meta %r: %s", parts[0][:80], e)
+                continue
+            pkt = decode_ethernet(parts[1])
+            # retransmit suppression runs in the receive loop (the
+            # watcher is not thread-safe, same contract as the
+            # reference, ethernet_hookswitch.go:87-95): verdict=drop —
+            # the endpoint's own TCP stack recovers, and the duplicate
+            # never becomes a second event
+            if self.watcher is not None and self.watcher.is_retransmit(pkt):
+                self.retrans_count += 1
+                self._reply(frame_id, "drop")
+                continue
+            self._decide_q.put((frame_id, pkt))
+
+    def _decide_worker(self) -> None:
+        while True:
+            item = self._decide_q.get()
+            if item is None:
+                return
+            self._decide(*item)
+
+    def _decide(self, frame_id: int, pkt) -> None:
+        self.packet_count += 1
+        event = PacketEvent.create(
+            self.entity_id, pkt.src_entity, pkt.dst_entity,
+            payload=pkt.payload[:128], hint=pkt.content_hint(),
+        )
+        ch = self.trans.send_event(event)
+        try:
+            action = ch.get(timeout=self.action_timeout)
+        except _queue.Empty:
+            self.trans.forget(event)
+            log.warning("frame %d: no action in %ss; accepting",
+                        frame_id, self.action_timeout)
+            action = None
+        if isinstance(action, PacketFaultAction):
+            self.drop_count += 1
+            self._reply(frame_id, "drop")
+            return
+        self._reply(frame_id, "accept")
+
+
+def serve_hookswitch_inspector(
+    transceiver: Transceiver, zmq_addr: str,
+    enable_tcp_watcher: bool = True,
+) -> int:
+    """CLI entry: serve verdicts until interrupted."""
+    inspector = HookSwitchInspector(
+        transceiver, zmq_addr=zmq_addr,
+        enable_tcp_watcher=enable_tcp_watcher)
+    inspector.start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        inspector.stop()
+    return 0

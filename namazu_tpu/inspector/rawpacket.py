@@ -1,0 +1,126 @@
+"""Raw packet header decoding for kernel/switch-level interception.
+
+Pure-stdlib decoder for the slice of Ethernet/IPv4/TCP/UDP the
+hookswitch backend needs (the reference leans on gopacket for this,
+/root/reference/nmz/inspector/ethernet/util.go:36-60): flow endpoints
+for entity ids, TCP (seq, ack, flags) for retransmit suppression, and
+the L4 payload for semantic hints.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from typing import NamedTuple, Optional
+
+ETH_HLEN = 14
+ETHERTYPE_IPV4 = 0x0800
+PROTO_TCP = 6
+PROTO_UDP = 17
+
+# TCP flag bits (low byte of the 13th/14th header bytes)
+FIN, SYN, RST, PSH, ACK = 0x01, 0x02, 0x04, 0x08, 0x10
+
+
+class Packet(NamedTuple):
+    """Decoded headers of one ethernet frame (fields None when absent)."""
+
+    src_ip: Optional[str] = None
+    dst_ip: Optional[str] = None
+    proto: Optional[int] = None  # PROTO_TCP / PROTO_UDP / other
+    src_port: Optional[int] = None
+    dst_port: Optional[int] = None
+    seq: Optional[int] = None  # TCP only
+    ack: Optional[int] = None  # TCP only
+    flags: Optional[int] = None  # TCP only (FIN|SYN|RST|PSH|ACK bits)
+    payload: bytes = b""
+
+    @property
+    def src_entity(self) -> str:
+        """Flow endpoint as an entity id (parity: makeEntityIDs,
+        util.go:25-33 — "entity-IP:PORT", unknown when not IP/TCP)."""
+        if self.src_ip is None or self.src_port is None:
+            return "_nmz_unknown_entity"
+        return f"entity-{self.src_ip}:{self.src_port}"
+
+    @property
+    def dst_entity(self) -> str:
+        if self.dst_ip is None or self.dst_port is None:
+            return "_nmz_unknown_entity"
+        return f"entity-{self.dst_ip}:{self.dst_port}"
+
+    @property
+    def flow_key(self) -> str:
+        return (f"{self.src_ip}:{self.src_port}-"
+                f"{self.dst_ip}:{self.dst_port}")
+
+    def content_hint(self) -> str:
+        """Timing-independent identity of the frame's payload: protocol +
+        a short digest. Raw frames have no semantic parser, so payload
+        content is the only stable identity (uuid/seq/timing must stay
+        out of replay hints, reference interface.go:24-31); the flow half
+        is added by PacketEvent.replay_hint."""
+        if not self.payload:
+            kind = {PROTO_TCP: "tcp", PROTO_UDP: "udp"}.get(self.proto, "ip")
+            return f"frame:{kind}:empty"
+        digest = hashlib.sha1(self.payload[:256]).hexdigest()[:16]
+        return f"frame:{digest}"
+
+
+def decode_ethernet(frame: bytes) -> Packet:
+    """Decode an ethernet frame's IPv4/TCP/UDP headers, best effort."""
+    if len(frame) < ETH_HLEN:
+        return Packet()
+    (ethertype,) = struct.unpack_from("!H", frame, 12)
+    if ethertype != ETHERTYPE_IPV4:
+        return Packet()
+    off = ETH_HLEN
+    if len(frame) < off + 20:
+        return Packet()
+    ver_ihl = frame[off]
+    if ver_ihl >> 4 != 4:
+        return Packet()
+    ihl = (ver_ihl & 0xF) * 4
+    proto = frame[off + 9]
+    src_ip = ".".join(str(b) for b in frame[off + 12:off + 16])
+    dst_ip = ".".join(str(b) for b in frame[off + 16:off + 20])
+    l4 = off + ihl
+    if proto == PROTO_TCP and len(frame) >= l4 + 20:
+        sport, dport, seq, ack = struct.unpack_from("!HHII", frame, l4)
+        data_off = (frame[l4 + 12] >> 4) * 4
+        flags = frame[l4 + 13] & (FIN | SYN | RST | PSH | ACK)
+        return Packet(src_ip, dst_ip, proto, sport, dport, seq, ack,
+                      flags, bytes(frame[l4 + data_off:]))
+    if proto == PROTO_UDP and len(frame) >= l4 + 8:
+        sport, dport = struct.unpack_from("!HH", frame, l4)
+        return Packet(src_ip, dst_ip, proto, sport, dport,
+                      payload=bytes(frame[l4 + 8:]))
+    return Packet(src_ip, dst_ip, proto)
+
+
+class TcpRetransWatcher:
+    """Suppress TCP retransmissions before they reach the policy.
+
+    Crucial at raw-packet level: a delayed segment triggers the sender's
+    retransmit timer, and without suppression the duplicate would be
+    queued as a fresh event — double delivery of the same message into
+    the schedule (parity: tcpwatcher.go:14-72, keyed by flow and matched
+    on seq+ack+flags; an RST clears the flow's memory). Not thread-safe;
+    call from the single receive loop, like the reference does.
+    """
+
+    def __init__(self) -> None:
+        self._last: dict[str, tuple] = {}
+
+    def is_retransmit(self, pkt: Packet) -> bool:
+        if pkt.proto != PROTO_TCP or pkt.seq is None:
+            return False
+        key = pkt.flow_key
+        sig = (pkt.seq, pkt.ack, pkt.flags)
+        if self._last.get(key) == sig:
+            return True
+        if pkt.flags is not None and pkt.flags & RST:
+            self._last.pop(key, None)
+        else:
+            self._last[key] = sig
+        return False

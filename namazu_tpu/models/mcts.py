@@ -119,19 +119,35 @@ class _SearchCarry(NamedTuple):
 
 def _make_rollout(trace: TraceArrays, pairs, archive, failure_feats,
                   hint_order, level_values, H: int, cfg: MCTSConfig,
-                  weights: ScoreWeights, coin=None):
+                  weights: ScoreWeights, coin=None, seeds=None):
     """Returns rollout(key, levels i32[tree_depth]) ->
     (mean_fitness, best_fitness, best_delays, best_faults).
 
     When ``cfg.max_fault > 0`` (and a fault ``coin`` is given), the random
     fault matrices participate in the counterfactual score — the returned
-    best fault table is *selected*, not an unselected random draw."""
+    best fault table is *selected*, not an unselected random draw.
+
+    ``seeds f32[S, H]`` (S may be 0) are demonstration delay tables —
+    recorded failures' injected delays, same source as the GA's
+    population seeding: up to half of each rollout batch completes the
+    unpinned buckets from a noise-perturbed seed instead of uniform
+    noise, so leaf values reflect what the demonstrations reach from
+    this tree prefix and the tree is steered toward them."""
+    n_seeds = 0 if seeds is None else seeds.shape[0]
+    n_seeded_rows = min(cfg.rollouts // 2, max(0, n_seeds * 4))
 
     def rollout(key, levels):
-        kd, kf = jax.random.split(key)
+        kd, kf, ks = jax.random.split(key, 3)
         R = cfg.rollouts
         delays = jax.random.uniform(kd, (R, H), jnp.float32, 0.0,
                                     cfg.max_delay)
+        if n_seeded_rows > 0:
+            rep = jnp.tile(seeds, (-(-n_seeded_rows // n_seeds), 1))
+            rep = rep[:n_seeded_rows]
+            noise = jax.random.normal(ks, (n_seeded_rows, H)) * (
+                0.05 * cfg.max_delay)
+            delays = delays.at[:n_seeded_rows].set(
+                jnp.clip(rep + noise, 0.0, cfg.max_delay))
         faults = jax.random.uniform(kf, (R, H), jnp.float32, 0.0,
                                     cfg.max_fault)
         # pin the tree-assigned buckets
@@ -163,6 +179,7 @@ def mcts_search(
     cfg: MCTSConfig = MCTSConfig(),
     weights: ScoreWeights = ScoreWeights(),
     coin: jax.Array | None = None,  # f32[H] deterministic fault coin
+    seeds: jax.Array | None = None,  # f32[S, H] demonstration tables
 ) -> MCTSResult:
     """Run one full MCTS; pure function of its inputs (jit-safe)."""
     if coin is None and cfg.max_fault > 0:
@@ -177,7 +194,7 @@ def mcts_search(
     level_values = jnp.linspace(0.0, cfg.max_delay, D).astype(jnp.float32)
     rollout = _make_rollout(trace, pairs, archive, failure_feats,
                             hint_order, level_values, H, cfg, weights,
-                            coin=coin)
+                            coin=coin, seeds=seeds)
 
     def simulate(i, carry: _SearchCarry) -> _SearchCarry:
         tree, key = carry.tree, carry.key
@@ -290,9 +307,10 @@ def mcts_search(
 def mcts_search_jit(key, trace, pairs, archive, failure_feats, hint_order,
                     H: int, cfg: MCTSConfig = MCTSConfig(),
                     weights: ScoreWeights = ScoreWeights(),
-                    coin=None) -> MCTSResult:
+                    coin=None, seeds=None) -> MCTSResult:
     return mcts_search(key, trace, pairs, archive, failure_feats,
-                       hint_order, H, cfg, weights, coin=coin)
+                       hint_order, H, cfg, weights, coin=coin,
+                       seeds=seeds)
 
 
 def make_parallel_mcts(mesh, H: int, cfg: MCTSConfig = MCTSConfig(),
@@ -309,11 +327,12 @@ def make_parallel_mcts(mesh, H: int, cfg: MCTSConfig = MCTSConfig(),
     axes = tuple(mesh.axis_names)
 
     def _local(key, trace, pairs, archive, failure_feats, hint_order,
-               coin):
+               coin, seeds):
         for ax in axes:
             key = jax.random.fold_in(key, jax.lax.axis_index(ax))
         res = mcts_search(key, trace, pairs, archive, failure_feats,
-                          hint_order, H, cfg, weights, coin=coin)
+                          hint_order, H, cfg, weights, coin=coin,
+                          seeds=seeds)
         all_fit, all_d, all_f = (res.best_fitness, res.best_delays,
                                  res.best_faults)
         for ax in reversed(axes):
@@ -330,7 +349,7 @@ def make_parallel_mcts(mesh, H: int, cfg: MCTSConfig = MCTSConfig(),
         return jax.shard_map(
             _local,
             mesh=mesh,
-            in_specs=(P(), trace_spec, P(), P(), P(), P(), P()),
+            in_specs=(P(), trace_spec, P(), P(), P(), P(), P(), P()),
             out_specs=(P(), P(), P()),
             check_vma=False,
         )
@@ -341,9 +360,11 @@ def make_parallel_mcts(mesh, H: int, cfg: MCTSConfig = MCTSConfig(),
 
     @jax.jit
     def run(key, trace: TraceArrays, pairs, archive, failure_feats,
-            hint_order, coin=None):
+            hint_order, coin=None, seeds=None):
         if trace.hint_ids.ndim == 1:
             trace = jax.tree.map(lambda x: x[None], trace)
+        if seeds is None:  # static absence -> 0-row array, one code path
+            seeds = jnp.zeros((0, H), jnp.float32)
         had_coin = coin is not None
         trace = normalize_fault_trace(trace, coin)
         if not had_coin:
@@ -358,8 +379,8 @@ def make_parallel_mcts(mesh, H: int, cfg: MCTSConfig = MCTSConfig(),
             # coin >= 1 never beats a fault probability in [0, 1]
             coin = jnp.ones((H,), jnp.float32)
             return sharded_nofault(key, trace, pairs, archive,
-                                   failure_feats, hint_order, coin)
+                                   failure_feats, hint_order, coin, seeds)
         return sharded_fault(key, trace, pairs, archive, failure_feats,
-                             hint_order, coin)
+                             hint_order, coin, seeds)
 
     return run

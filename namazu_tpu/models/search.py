@@ -525,9 +525,30 @@ class MCTSSearch(SearchBase):
         self._best_fitness = float("-inf")
         self._best_delays = np.zeros((cfg.H,), np.float32)
         self._best_faults = np.zeros((cfg.H,), np.float32)
+        self._seed_tables: Optional[np.ndarray] = None  # f32[S, H]
 
     def _reset_best(self) -> None:
         self._best_fitness = float("-inf")
+
+    #: seed tables are cyclically tiled to this fixed row count so the
+    #: jitted search sees ONE seeds shape — otherwise every new recorded
+    #: failure (S = 1, 2, 3, ...) would force a full recompile of the
+    #: parallel MCTS
+    SEED_ROWS = 16
+
+    def seed_population(self, delay_tables) -> None:
+        """Demonstration tables steer the rollouts: half of each rollout
+        batch completes unpinned buckets from a noise-perturbed seed
+        (models/mcts.py _make_rollout) — the MCTS analogue of the GA's
+        population seeding, same source (recorded failures' injected
+        delays)."""
+        if len(delay_tables) == 0:
+            return
+        raw = np.clip(
+            np.stack([np.asarray(t, np.float32) for t in delay_tables]),
+            0.0, self.mcts_cfg.max_delay)
+        reps = -(-self.SEED_ROWS // raw.shape[0])
+        self._seed_tables = np.tile(raw, (reps, 1))[: self.SEED_ROWS]
 
     def _hint_order(self, encs) -> np.ndarray:
         """Bucket ids ordered by frequency across the reference traces —
@@ -551,12 +572,14 @@ class MCTSSearch(SearchBase):
         encs, trace, pairs, archive, failures = self._device_inputs(encoded)
         hint_order = jnp.asarray(self._hint_order(encs))
         coin = None if self._coin is None else jnp.asarray(self._coin)
+        seeds = (None if self._seed_tables is None
+                 else jnp.asarray(self._seed_tables))
 
         searches = max(1, generations // 64)
         for _ in range(searches):
             self._key, sub = jax.random.split(self._key)
             fit, d, f = self._run(sub, trace, pairs, archive, failures,
-                                  hint_order, coin)
+                                  hint_order, coin, seeds)
             fit = float(fit)
             if fit > self._best_fitness:
                 self._best_fitness = fit

@@ -42,11 +42,11 @@ def has_program_interpreter(path: str) -> Optional[bool]:
                     end + "HHIQQQIHHH", hdr[:42])
             elif ei_class == 1:  # ELF32
                 hdr = f.read(36)
-                if len(hdr) < 28:
+                if len(hdr) < 30:
                     return None
                 (_t, _m, _v, _entry, e_phoff, _shoff, _flags, _ehsize,
                  e_phentsize, e_phnum) = struct.unpack(
-                    end + "HHIIIIIHHH", hdr[:28])
+                    end + "HHIIIIIHHH", hdr[:30])
             else:
                 return None
             f.seek(e_phoff)

@@ -128,9 +128,11 @@ def test_proxy_inspector_drop_fault(echo_server, autopilot):
     try:
         c = socket.create_connection(("127.0.0.1", link.port), timeout=5)
         c.sendall(b"will-be-dropped")
-        c.settimeout(0.5)
-        with pytest.raises(socket.timeout):
-            c.recv(1024)  # the chunk was dropped; echo never arrives
+        c.settimeout(5)
+        # on an unframed link a drop closes the connection (a real-world
+        # fault) rather than tearing a byte range out of the stream: the
+        # client sees EOF, never a silently shortened payload
+        assert c.recv(1024) == b""
         assert insp.drop_count >= 1
         c.close()
     finally:

@@ -39,6 +39,31 @@ def static_binary(tmp_path_factory):
     return str(out)
 
 
+def test_probe_handles_elf32(tmp_path):
+    """Crafted 32-bit ELF headers (no 32-bit toolchain in the image):
+    the ELF32 header is 52 bytes; PT_INTERP in the program headers makes
+    it dynamic. Regression: the probe used to unpack a 30-byte struct
+    from a 28-byte slice and crash on every 32-bit binary."""
+    import struct
+
+    def elf32(p_types):
+        ident = b"\x7fELF" + bytes([1, 1, 1, 0]) + b"\x00" * 8
+        e_phoff, phentsize = 52, 32
+        hdr = struct.pack("<HHIIIIIHHHHHH", 2, 3, 1, 0, e_phoff, 0, 0,
+                          52, phentsize, len(p_types), 0, 0, 0)
+        phs = b"".join(
+            struct.pack("<IIIIIIII", t, 0, 0, 0, 0, 0, 0, 0)
+            for t in p_types)
+        return ident + hdr + phs
+
+    dyn = tmp_path / "dyn32"
+    dyn.write_bytes(elf32([1, 3, 1]))  # PT_LOAD, PT_INTERP, PT_LOAD
+    static = tmp_path / "static32"
+    static.write_bytes(elf32([1, 1]))
+    assert has_program_interpreter(str(dyn)) is True
+    assert has_program_interpreter(str(static)) is False
+
+
 def test_probe_classifies_binaries(static_binary):
     assert has_program_interpreter(static_binary) is False
     # the python interpreter is dynamically linked
