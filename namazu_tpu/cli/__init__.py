@@ -20,6 +20,7 @@ def build_parser() -> argparse.ArgumentParser:
         inspectors_cmd,
         orchestrator_cmd,
         run_cmd,
+        sidecar_cmd,
         tools_cmd,
     )
 
@@ -34,6 +35,7 @@ def build_parser() -> argparse.ArgumentParser:
     inspectors_cmd.register(sub)
     tools_cmd.register(sub)
     container_cmd.register(sub)
+    sidecar_cmd.register(sub)
     return parser
 
 

@@ -1,0 +1,43 @@
+"""``nmz-tpu sidecar`` — run the persistent search sidecar.
+
+The orchestrator ⇄ JAX boundary of SURVEY.md §5.8: a long-lived process
+holding the compiled search plane (device mesh, jitted GA/MCTS step,
+archives) that per-run policies query over loopback instead of paying
+search construction + jit warm-up inside every two-second experiment
+process. Point a policy at it with ``sidecar = "127.0.0.1:10990"`` in
+``explore_policy_param``.
+"""
+
+from __future__ import annotations
+
+
+def register(sub) -> None:
+    p = sub.add_parser("sidecar", help="persistent search sidecar")
+    p.add_argument("--listen", default="127.0.0.1:10990",
+                   help="host:port to serve on (default 127.0.0.1:10990)")
+    p.add_argument("--platform", default="",
+                   help="jax platform override (e.g. cpu); empty = "
+                        "process default")
+    p.set_defaults(func=run_sidecar)
+
+
+def run_sidecar(args) -> int:
+    from namazu_tpu.utils.log import init_log
+
+    init_log()
+    if args.platform:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = args.platform
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", args.platform)
+        except Exception:
+            pass
+        if args.platform == "cpu":
+            os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    from namazu_tpu.sidecar import serve_sidecar
+
+    host, _, port = args.listen.rpartition(":")
+    return serve_sidecar(host or "127.0.0.1", int(port))

@@ -72,6 +72,7 @@ class HookSwitchInspector:
         self.packet_count = 0
         self.drop_count = 0
         self.retrans_count = 0
+        self._count_lock = threading.Lock()  # counters bump from workers
         self._ctx = None
         self._sock = None
         self._stop = threading.Event()
@@ -103,13 +104,15 @@ class HookSwitchInspector:
         self._stop.set()
         for _ in range(self.DECIDE_WORKERS):
             self._decide_q.put(None)
+        # the serve thread owns the socket (ZMQ sockets are not
+        # thread-safe): signal, wait for it to leave its poll, THEN close
+        if self._thread is not None:
+            self._thread.join(timeout=5)
         if self._sock is not None:
             try:
                 self._sock.close(linger=0)
             except Exception:  # pragma: no cover - zmq teardown races
                 pass
-        if self._thread is not None:
-            self._thread.join(timeout=5)
 
     # -- wire -------------------------------------------------------------
 
@@ -137,7 +140,11 @@ class HookSwitchInspector:
         poller.register(self._sock, zmq.POLLIN)
         while not self._stop.is_set():
             try:
-                ready = poller.poll(timeout=50)
+                # short poll bound: verdicts queued by decide workers
+                # while no frames arrive must not sit a whole poll cycle
+                # — that delay would ride on top of every policy-chosen
+                # release time
+                ready = poller.poll(timeout=5)
                 self._flush_replies()
                 if not ready:
                     continue
@@ -174,7 +181,8 @@ class HookSwitchInspector:
             self._decide(*item)
 
     def _decide(self, frame_id: int, pkt) -> None:
-        self.packet_count += 1
+        with self._count_lock:
+            self.packet_count += 1
         event = PacketEvent.create(
             self.entity_id, pkt.src_entity, pkt.dst_entity,
             payload=pkt.payload[:128], hint=pkt.content_hint(),
@@ -188,7 +196,8 @@ class HookSwitchInspector:
                         frame_id, self.action_timeout)
             action = None
         if isinstance(action, PacketFaultAction):
-            self.drop_count += 1
+            with self._count_lock:
+                self.drop_count += 1
             self._reply(frame_id, "drop")
             return
         self._reply(frame_id, "accept")

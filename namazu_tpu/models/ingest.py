@@ -1,0 +1,152 @@
+"""History ingest: stored experiment runs -> search-plane state.
+
+Shared by the in-process policy (policy/tpu.py) and the persistent
+search sidecar (namazu_tpu/sidecar.py): both must featurize the same
+history the same way — arrival-anchored references, realized-release
+embeddings, failure-derived demonstration seeds, hint-space guard — or
+a schedule trained in one home would not replay in the other.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+from namazu_tpu.ops import trace_encoding as te
+from namazu_tpu.signal.base import HINT_SPACE
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("models.ingest")
+
+
+class IngestParams(NamedTuple):
+    H: int = te.DEFAULT_H
+    L: int = 0  # explicit trace-length cap; 0 = policy defaults
+    release_mode: str = "delay"  # "delay" | "reorder"
+    reference_mode: str = "recent"  # "recent" | "envelope"
+    max_interval: float = 0.1  # seed-table clip (seconds)
+    max_reference_traces: int = 4
+    max_seed_genomes: int = 16
+    # order mode scores dense (whole-trace lexsort), so uncapped encodes
+    # would materialize [population, L] intermediates per generation
+    order_mode_max_l: int = 4096
+
+
+def failure_seed(trace, H: int, max_interval: float):
+    """Per-bucket delay table replaying this failure's injected delays:
+    for the first released event of each bucket, ``release - arrival``
+    IS the delay the recording policy injected on it (absolute times —
+    no anchor needed). Replayed against similar arrivals, the table
+    re-enacts the failure's interleaving up to the system's reactions;
+    it seeds the search as a demonstration (models/search.py
+    seed_population)."""
+    seed = np.zeros((H,), np.float32)
+    seen = set()
+    got = False
+    for a in trace:
+        arr = getattr(a, "event_arrived", None)
+        rel = a.triggered_time
+        if not arr or not rel:
+            continue
+        hint = getattr(a, "event_hint", "") or \
+            f"{a.event_class or a.class_name()}:{a.entity_id}"
+        b = te.hint_bucket(hint, H)
+        if b in seen:
+            continue
+        seen.add(b)
+        seed[b] = min(max(rel - arr, 0.0), max_interval)
+        got = True
+    return seed if got else None
+
+
+def ingest_history(search, storage, p: IngestParams) -> List:
+    """Feed stored traces into the search's archives; return the
+    reference traces to evolve against.
+
+    References are the most recent SUCCESSFUL runs (padded with failures
+    only when no success exists yet): the counterfactual asks "what
+    would delaying bucket X do to the interleaving the next run will
+    naturally produce", so it must be anchored on arrivals close to what
+    an ordinary run records. The failure traces instead supply the
+    *target* features through the failure archive (bug-affinity term) —
+    embedded at their REALIZED release times, where a delay-induced
+    failure's signature actually lives (te.encode_trace docstring).
+    """
+    if storage is None:
+        return []
+    try:
+        n = storage.nr_stored_histories()
+    except Exception:
+        return []
+    encoded = []
+    skipped_unstamped = 0
+    for i in range(n):
+        try:
+            trace = storage.get_stored_history(i)
+            ok = storage.is_successful(i)
+        except Exception:
+            continue
+        # runs recorded under a different replay-hint format hash into a
+        # different bucket space — training on them would deliver
+        # arbitrary delays under a "searched schedule" log. Absent
+        # stamps default to "content-v1", the same convention the
+        # checkpoint loader uses (te.checkpoint_hint_space): every
+        # recording made by a stamping build carries the tag
+        # (cli/run_cmd.py).
+        try:
+            stamp = ((storage.get_metadata(i) or {})
+                     .get("hint_space", "content-v1"))
+        except Exception:
+            stamp = "content-v1"
+        if stamp != HINT_SPACE:
+            skipped_unstamped += 1
+            continue
+        if p.L > 0:
+            cap: Optional[int] = p.L
+        elif p.release_mode == "reorder":
+            cap = p.order_mode_max_l
+        else:
+            cap = None  # delay mode scores long traces blockwise
+        # two views of every run, one encode pass: arrival-anchored =
+        # counterfactual reference; realized = archive embedding
+        enc, enc_rt = te.encode_trace_views(trace, L=cap, H=p.H)
+        if enc.truncated:
+            log.warning(
+                "trace %d truncated: %d events beyond the L=%d cap were "
+                "dropped from scoring (%s)", i, enc.truncated, cap,
+                "configured trace_length" if p.L > 0
+                else "order-mode memory bound")
+        seed = None if ok else failure_seed(trace, p.H, p.max_interval)
+        encoded.append((enc, enc_rt, ok, seed))
+    if skipped_unstamped:
+        log.warning(
+            "%d stored run(s) recorded in another hint space were "
+            "excluded from search ingest (this build: %s); re-record "
+            "under the current build to train on them",
+            skipped_unstamped, HINT_SPACE)
+    # concentrate the feature pairs on the buckets the experiment
+    # actually produces BEFORE embedding anything (a pair change clears
+    # the archives; the loop below repopulates them in full)
+    occupied = sorted({int(b) for enc, _, _, _ in encoded
+                       for b in enc.hint_ids[enc.mask]})
+    search.set_occupied_buckets(occupied)
+    seeds = [s for _, _, ok, s in encoded if not ok and s is not None]
+    if seeds:
+        # most recent failures first: when seeds outnumber slots the
+        # freshest demonstrations win
+        search.seed_population(seeds[::-1][: p.max_seed_genomes])
+    failures, successes = [], []
+    for enc, enc_rt, ok, _ in encoded:
+        # "failure" = the run reproduced the bug (validate failed); the
+        # label feeds the surrogate's training set
+        search.add_executed_trace(enc_rt, reproduced=not ok)
+        if not ok:
+            search.add_failure_trace(enc_rt)
+            failures.append(enc)
+        else:
+            successes.append(enc)
+    if p.reference_mode == "envelope" and successes:
+        return [te.envelope_trace(successes)]
+    pool = successes if successes else failures
+    return pool[::-1][: p.max_reference_traces]

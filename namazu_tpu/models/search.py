@@ -45,6 +45,39 @@ class BestSchedule(NamedTuple):
     fitness: float
 
 
+def make_score_weights(
+    release_mode: str = "delay",
+    w_novelty: float = 1.0,
+    w_bug: float = 1.0,
+    w_delay_cost: float = 0.01,
+    w_fault_cost: float = 0.05,
+    tau: float = 0.005,
+    reorder_gap: float = 0.002,
+    reorder_window: float = 0.05,
+) -> ScoreWeights:
+    """ScoreWeights for a release mode — one home for the subtle part
+    (shared by policy/tpu.py and the sidecar): scoring must model the
+    same realization the control plane uses. Order mode permutes within
+    reorder_window batches by the table's priorities; delay mode adds
+    the table to arrivals. delay_cost=0 in order mode: uniform priority
+    shifts don't change the permutation, so penalizing the table's mean
+    would only drive priorities onto the 0 clip boundary (collapsing to
+    arrival order via the tie-break); tau of the order of the gap keeps
+    adjacent ranks' precedence features saturated."""
+    if release_mode == "reorder":
+        gap = max(reorder_gap, 1e-4)
+        return ScoreWeights(
+            novelty=w_novelty, bug=w_bug, fault_cost=w_fault_cost,
+            order_mode=True, order_gap=gap,
+            order_window=max(reorder_window, 0.0),
+            tau=gap * 0.5, delay_cost=0.0,
+        )
+    return ScoreWeights(
+        novelty=w_novelty, bug=w_bug, delay_cost=w_delay_cost,
+        fault_cost=w_fault_cost, tau=tau,
+    )
+
+
 def _enable_persistent_compile_cache() -> None:
     """Point XLA's persistent compilation cache at a stable user dir.
 
@@ -386,14 +419,25 @@ class ScheduleSearch(SearchBase):
 
     # -- surrogate (BASELINE config 5) ------------------------------------
 
+    #: minimum labeled examples PER CLASS before surrogate re-ranking
+    #: may override the fitness argmax: an MLP fit on one positive
+    #: re-ranks near-randomly, and handing it veto power over the
+    #: evolved best dilutes a good schedule into mush (observed: with a
+    #: single recorded failure the re-ranked pick lost the failure's
+    #: decisive starve pattern that the argmax carried)
+    MIN_CLASS_EXAMPLES = 3
+
     def _train_surrogate(self):
         """Fit the online MLP on the labeled archive; returns it, or None
-        when surrogate use is off or only one outcome class exists yet."""
+        when surrogate use is off or either outcome class is still too
+        thin to learn from."""
         if self.cfg.surrogate_topk <= 0:
             return None
         feats, labels = self.labeled_archive()
-        if len(feats) < 4 or labels.min() == labels.max():
-            return None  # nothing learnable yet
+        pos = int((labels > 0.5).sum())
+        neg = int(len(labels) - pos)
+        if min(pos, neg) < self.MIN_CLASS_EXAMPLES:
+            return None  # nothing reliably learnable yet
         if self._surrogate is None:
             from namazu_tpu.models.surrogate import RewardSurrogate
 

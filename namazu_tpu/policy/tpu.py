@@ -55,6 +55,12 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.checkpoint_path = ""
         self.search_on_start = True
         self.search_join_timeout = 120.0  # shutdown waits this long
+        # persistent search sidecar address ("host:port"; "" = search
+        # in-process). The sidecar (namazu_tpu/sidecar.py) holds the
+        # compiled search and device state across `run` processes, so a
+        # per-run search request costs one ingest + warm generations
+        # instead of rebuild + jit warm-up.
+        self.sidecar = ""
         # evolve every Nth run (1 = every run). The installed schedule
         # always comes from the checkpoint (cheap np.load), but the
         # evolve+ingest+save cycle costs seconds of wall-clock per `run`
@@ -137,6 +143,11 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self._search = None
         self._search_thread: Optional[threading.Thread] = None
         self._search_lock = threading.Lock()
+        # set when the run is ending (shutdown/wait_for_search): the
+        # sidecar evolve parks on this so it never competes with the
+        # testee for CPU during the decisive window — its product is for
+        # the NEXT run, which install-from-checkpoint covers
+        self._run_ending = threading.Event()
 
     # -- config ----------------------------------------------------------
 
@@ -159,6 +170,17 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.search_join_timeout = parse_duration(
             p("search_join_timeout", self.search_join_timeout * 1000))
         self.search_every = max(1, int(p("search_every", self.search_every)))
+        self.sidecar = str(p("sidecar", self.sidecar) or "")
+        if self.sidecar and not str(p("checkpoint", "") or ""):
+            # the sidecar evolve runs at end-of-run and its product ships
+            # to the NEXT run via the checkpoint; without one every
+            # request is wasted work whose install lands in an exiting
+            # process. Fail fast like the other config knobs.
+            raise ValueError(
+                "sidecar mode requires a checkpoint (the evolved schedule "
+                "reaches the next run through it); set checkpoint = "
+                "\"search.npz\""
+            )
         self.max_fault = float(p("max_fault", 0.0))
         self.platform = str(p("platform", self.platform))
         self.search_backend = str(p("search_backend", self.search_backend))
@@ -375,37 +397,19 @@ class TPUSearchPolicy(QueueBackedPolicy):
             MCTSSearch,
             ScheduleSearch,
             SearchConfig,
+            make_score_weights,
         )
 
-        from namazu_tpu.ops.schedule import ScoreWeights
-
-        # scoring must model the same realization the control plane uses:
-        # order mode permutes within reorder_window batches by the table's
-        # priorities; delay mode adds the table to arrivals. delay_cost=0
-        # in order mode: uniform priority shifts don't change the
-        # permutation, so penalizing the table's mean would only drive
-        # priorities onto the 0 clip boundary (collapsing to arrival
-        # order via the tie-break).
-        if self.release_mode == "reorder":
-            gap = max(self.reorder_gap, 1e-4)
-            weights = ScoreWeights(
-                novelty=self.w_novelty,
-                bug=self.w_bug,
-                fault_cost=self.w_fault_cost,
-                order_mode=True,
-                order_gap=gap,
-                order_window=max(self.reorder_window, 0.0),
-                tau=gap * 0.5,
-                delay_cost=0.0,
-            )
-        else:
-            weights = ScoreWeights(
-                novelty=self.w_novelty,
-                bug=self.w_bug,
-                delay_cost=self.w_delay_cost,
-                fault_cost=self.w_fault_cost,
-                tau=self.tau,
-            )
+        # one home for the subtle mode-dependent weight construction,
+        # shared with the sidecar (models/search.py make_score_weights)
+        weights = make_score_weights(
+            release_mode=self.release_mode,
+            w_novelty=self.w_novelty, w_bug=self.w_bug,
+            w_delay_cost=self.w_delay_cost,
+            w_fault_cost=self.w_fault_cost, tau=self.tau,
+            reorder_gap=self.reorder_gap,
+            reorder_window=self.reorder_window,
+        )
         cfg = SearchConfig(
             H=self.H, L=self.L, K=self.K,
             population=self.population,
@@ -558,6 +562,23 @@ class TPUSearchPolicy(QueueBackedPolicy):
                         self.search_every, n,
                         -(-n // self.search_every) * self.search_every)
                     return
+            if self.sidecar:
+                try:
+                    # park until the run ends: a warm sidecar evolve is
+                    # fast enough to land INSIDE the testee's decisive
+                    # window, and on small hosts the CPU it burns there
+                    # skews the very timing being fuzzed. The evolve's
+                    # product ships via the checkpoint to the next run,
+                    # so end-of-run is the right moment (and the
+                    # reference's division of labor: exploration work
+                    # happens between experiments, SURVEY.md 3.1).
+                    self._run_ending.wait()
+                    self._sidecar_search(ckpt)
+                    return
+                except Exception:
+                    log.exception(
+                        "sidecar %s unreachable/failed; falling back to "
+                        "the in-process search", self.sidecar)
             with self._search_lock:
                 if self._search is None:
                     self._search = self._build_search()
@@ -608,32 +629,81 @@ class TPUSearchPolicy(QueueBackedPolicy):
     MAX_SEED_GENOMES = 16
 
     def _failure_seed(self, trace):
-        """Per-bucket delay table replaying this failure's injected
-        delays: for the first released event of each bucket,
-        ``release - arrival`` IS the delay the recording policy injected
-        on it (absolute times — no anchor needed). Replayed against
-        similar arrivals, the table re-enacts the failure's interleaving
-        up to the system's reactions; it seeds the GA population as a
-        demonstration (models/search.py seed_population)."""
-        import numpy as np
+        """See models/ingest.py failure_seed (shared with the sidecar)."""
+        from namazu_tpu.models.ingest import failure_seed
 
-        seed = np.zeros((self.H,), np.float32)
-        seen = set()
-        got = False
-        for a in trace:
-            arr = getattr(a, "event_arrived", None)
-            rel = a.triggered_time
-            if not arr or not rel:
-                continue
-            hint = getattr(a, "event_hint", "") or \
-                f"{a.event_class or a.class_name()}:{a.entity_id}"
-            b = self._bucket(hint)
-            if b in seen:
-                continue
-            seen.add(b)
-            seed[b] = min(max(rel - arr, 0.0), self.max_interval)
-            got = True
-        return seed if got else None
+        return failure_seed(trace, self.H, self.max_interval)
+
+    def _search_params(self) -> dict:
+        """Flat JSON-able search knobs — what the sidecar needs to build
+        an equivalent backend (sidecar.build_search_from_params)."""
+        return {
+            "H": self.H, "L": self.L, "K": self.K,
+            "population": self.population,
+            "migrate_k": self.migrate_k,
+            "seed": self.seed,
+            "max_interval": self.max_interval,
+            "max_fault": self.max_fault,
+            "surrogate_topk": self.surrogate_topk,
+            "search_backend": self.search_backend,
+            "mcts_tree_depth": self.mcts_tree_depth,
+            "mcts_levels": self.mcts_levels,
+            "mcts_simulations": self.mcts_simulations,
+            "mcts_rollouts": self.mcts_rollouts,
+            "release_mode": self.release_mode,
+            "w_novelty": self.w_novelty, "w_bug": self.w_bug,
+            "w_delay_cost": self.w_delay_cost,
+            "w_fault_cost": self.w_fault_cost,
+            "tau": self.tau,
+            "reorder_gap": self.reorder_gap,
+            "reorder_window": self.reorder_window,
+            "devices": self.n_devices,
+        }
+
+    def _sidecar_search(self, ckpt: str) -> None:
+        """Delegate the evolve cycle to the persistent sidecar and
+        install what it returns. Raises on any failure — the caller
+        falls back to the in-process search."""
+        import numpy as _np
+
+        from namazu_tpu.sidecar import request
+
+        storage_dir = getattr(self._storage, "dir", None)
+        if not storage_dir:
+            raise RuntimeError(
+                "sidecar search needs a directory-backed storage")
+        resp = request(self.sidecar, {
+            "op": "search",
+            "key": os.path.abspath(storage_dir),
+            "storage": os.path.abspath(storage_dir),
+            "search_params": self._search_params(),
+            "ingest_params": self._ingest_params()._asdict(),
+            "generations": self.generations,
+            "checkpoint": os.path.abspath(ckpt) if ckpt else "",
+        }, timeout=max(self.search_join_timeout, 30.0))
+        if not resp.get("ok"):
+            raise RuntimeError(f"sidecar: {resp.get('error', 'failed')}")
+        if resp.get("no_history"):
+            log.info("sidecar: no stored history yet; keeping current "
+                     "delays")
+            return
+        self._delays = _np.asarray(resp["delays"], _np.float32)
+        self._faults = _np.asarray(resp["faults"], _np.float32)
+        log.info("installed sidecar schedule (fitness %.4f, gen %d)",
+                 resp["fitness"], resp["generations_run"])
+
+    def _ingest_params(self):
+        from namazu_tpu.models.ingest import IngestParams
+
+        return IngestParams(
+            H=self.H, L=self.L,
+            release_mode=self.release_mode,
+            reference_mode=self.reference_mode,
+            max_interval=self.max_interval,
+            max_reference_traces=self.MAX_REFERENCE_TRACES,
+            max_seed_genomes=self.MAX_SEED_GENOMES,
+            order_mode_max_l=self.ORDER_MODE_MAX_L,
+        )
     # order mode scores dense (a windowed permutation needs the whole
     # trace in one lexsort — ops/schedule.py), so uncapped encoding would
     # materialize [population, L] intermediates per generation; cap the
@@ -642,110 +712,12 @@ class TPUSearchPolicy(QueueBackedPolicy):
 
     def _ingest_history(self, search):
         """Feed stored traces into the archives; return the reference
-        traces to evolve against.
+        traces to evolve against — shared implementation with the
+        persistent search sidecar (models/ingest.py, which carries the
+        full design rationale)."""
+        from namazu_tpu.models.ingest import ingest_history
 
-        References are the most recent SUCCESSFUL runs (padded with
-        failures only when no success exists yet): the counterfactual
-        asks "what would delaying bucket X do to the interleaving the
-        next run will naturally produce", so it must be anchored on
-        arrivals close to what an ordinary run records. A failure trace's
-        arrivals already CONTAIN the delays that induced the bug — scored
-        against itself, the zero-delay genome trivially matches the
-        failure signature and the search would install a no-op. The
-        failure traces instead supply the *target* features through the
-        failure archive (bug-affinity term)."""
-        from namazu_tpu.ops import trace_encoding as te
-
-        storage = self._storage
-        if storage is None:
-            return []
-        try:
-            n = storage.nr_stored_histories()
-        except Exception:
-            return []
-        from namazu_tpu.signal.base import HINT_SPACE
-
-        encoded = []
-        skipped_unstamped = 0
-        for i in range(n):
-            try:
-                trace = storage.get_stored_history(i)
-                ok = storage.is_successful(i)
-            except Exception:
-                continue
-            # runs recorded under a different replay-hint format hash
-            # into a different bucket space — training on them would
-            # deliver arbitrary delays under a "searched schedule" log.
-            # Absent stamps default to "content-v1", the same convention
-            # the checkpoint loader uses (te.checkpoint_hint_space):
-            # every recording made by a stamping build carries the tag
-            # (cli/run_cmd.py), so an unstamped run IS a pre-flow-prefix
-            # recording and must not train this build's search.
-            try:
-                stamp = ((storage.get_metadata(i) or {})
-                         .get("hint_space", "content-v1"))
-            except Exception:
-                stamp = "content-v1"
-            if stamp != HINT_SPACE:
-                skipped_unstamped += 1
-                continue
-            if self.L > 0:
-                cap = self.L
-            elif self.release_mode == "reorder":
-                cap = self.ORDER_MODE_MAX_L
-            else:
-                cap = None  # delay mode scores long traces blockwise
-            # two views of every run, one encode pass (te.encode_trace
-            # docstring): the arrival-anchored view is the
-            # counterfactual reference, the realized (release-time)
-            # view is what gets embedded into the novelty/failure
-            # archives — a delay-induced failure's signature exists
-            # only in its release times
-            enc, enc_rt = te.encode_trace_views(trace, L=cap, H=self.H)
-            if enc.truncated:
-                log.warning(
-                    "trace %d truncated: %d events beyond the L=%d cap "
-                    "were dropped from scoring (%s)",
-                    i, enc.truncated, cap,
-                    "configured trace_length" if self.L > 0
-                    else "order-mode memory bound")
-            # failure seeds are derived inline so the trace itself can be
-            # dropped — holding every run's Action objects through the
-            # whole ingest would multiply peak memory on long experiments
-            seed = None if ok else self._failure_seed(trace)
-            encoded.append((enc, enc_rt, ok, seed))
-        if skipped_unstamped:
-            log.warning(
-                "%d stored run(s) recorded in another hint space were "
-                "excluded from search ingest (this build: %s); re-record "
-                "under the current build to train on them",
-                skipped_unstamped, HINT_SPACE)
-        # concentrate the feature pairs on the buckets the experiment
-        # actually produces BEFORE embedding anything (a pair change
-        # clears the archives; this loop repopulates them in full)
-        occupied = sorted({int(b) for enc, _, _, _ in encoded
-                           for b in enc.hint_ids[enc.mask]})
-        search.set_occupied_buckets(occupied)
-        seeds = [s for _, _, ok, s in encoded if not ok and s is not None]
-        if seeds:
-            # most recent failures first: when seeds outnumber slots the
-            # freshest demonstrations win
-            search.seed_population(seeds[::-1][: self.MAX_SEED_GENOMES])
-        failures, successes = [], []
-        for enc, enc_rt, ok, _ in encoded:
-            # "failure" = the run reproduced the bug (validate failed);
-            # the label feeds the surrogate's training set. Embeddings
-            # use the realized view; references the arrival view.
-            search.add_executed_trace(enc_rt, reproduced=not ok)
-            if not ok:
-                search.add_failure_trace(enc_rt)
-                failures.append(enc)
-            else:
-                successes.append(enc)
-        if self.reference_mode == "envelope" and successes:
-            return [te.envelope_trace(successes)]
-        pool = successes if successes else failures
-        return pool[::-1][: self.MAX_REFERENCE_TRACES]
+        return ingest_history(search, self._storage, self._ingest_params())
 
     def shutdown(self) -> None:
         """With a checkpoint configured, let an in-flight search finish
@@ -757,6 +729,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
             self._stop_reorder.set()
             self._reorder_thread.join(timeout=10)
             self._drain_pending(gap=0.0)  # flush, loss-free shutdown
+        self._run_ending.set()  # release a parked sidecar evolve
         t = self._search_thread
         if t is not None and self.checkpoint_path:
             t.join(timeout=self.search_join_timeout)
@@ -764,6 +737,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
 
     def wait_for_search(self, timeout: float = 120.0) -> bool:
         """Block until the background search installed a schedule (tests)."""
+        self._run_ending.set()
         t = self._search_thread
         if t is None:
             return self._delays is not None

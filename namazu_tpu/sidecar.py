@@ -1,0 +1,263 @@
+"""Persistent search sidecar: the orchestrator ⇄ JAX boundary.
+
+SURVEY.md §5.8 calls for a fourth endpoint-like boundary beside
+local/REST/agent: the control plane ships recorded history to a
+long-lived JAX process and gets the best schedule back. Without it,
+every `run` process pays search construction + jit warm-up (seconds)
+for a two-second experiment; the sidecar holds the compiled search and
+device state for the WHOLE experiment, so a per-run search request costs
+one ingest + a few warm generations (~100 ms class) plus a loopback
+round trip.
+
+Wire: framed JSON over TCP (the same 4-byte little-endian length prefix
+as the guest-agent endpoint — endpoint/agent.py read_frame/write_frame),
+one request per connection:
+
+* ``{"op": "ping"}`` -> ``{"ok": true, "searches": N}``
+* ``{"op": "search", "key": str, "storage": dir,
+     "search_params": {...}, "ingest_params": {...},
+     "generations": N, "checkpoint": path}``
+  -> ``{"ok": true, "fitness": f, "delays": [...], "faults": [...],
+        "generations_run": N}``
+
+The sidecar reads the storage directory itself (same host by design —
+this boundary rides loopback/DCN, never the per-event hot path), runs
+the SAME ingest the in-process policy uses (models/ingest.py), and
+persists the checkpoint so in-process and sidecar searches are
+interchangeable mid-experiment. A changed ``search_params`` fingerprint
+for a key rebuilds that search.
+
+Start one with ``nmz-tpu sidecar --listen 127.0.0.1:10990``; point the
+policy at it with ``sidecar = "127.0.0.1:10990"`` in
+``explore_policy_param``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+from typing import Dict, Optional, Tuple
+
+from namazu_tpu.endpoint.agent import read_frame, write_frame
+from namazu_tpu.storage import load_storage
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("sidecar")
+
+
+def build_search_from_params(p: dict):
+    """Construct a search backend from a flat JSON-able params dict (the
+    policy's knobs, TPUSearchPolicy._search_params)."""
+    from namazu_tpu.models.ga import GAConfig
+    from namazu_tpu.models.search import (
+        MCTSSearch,
+        ScheduleSearch,
+        SearchConfig,
+        make_score_weights,
+    )
+
+    weights = make_score_weights(
+        release_mode=p.get("release_mode", "delay"),
+        w_novelty=p.get("w_novelty", 1.0),
+        w_bug=p.get("w_bug", 1.0),
+        w_delay_cost=p.get("w_delay_cost", 0.01),
+        w_fault_cost=p.get("w_fault_cost", 0.05),
+        tau=p.get("tau", 0.005),
+        reorder_gap=p.get("reorder_gap", 0.002),
+        reorder_window=p.get("reorder_window", 0.05),
+    )
+    cfg = SearchConfig(
+        H=p.get("H", 256), L=p.get("L", 0), K=p.get("K", 256),
+        population=p.get("population", 4096),
+        migrate_k=p.get("migrate_k", 8),
+        seed=p.get("seed", 0),
+        ga=GAConfig(max_delay=p.get("max_interval", 0.1),
+                    max_fault=p.get("max_fault", 0.0)),
+        weights=weights,
+        surrogate_topk=p.get("surrogate_topk", 16),
+    )
+    n_devices = p.get("devices")
+    if p.get("search_backend", "ga") == "mcts":
+        from namazu_tpu.models.mcts import MCTSConfig
+
+        mcts_cfg = MCTSConfig(
+            tree_depth=p.get("mcts_tree_depth", 24),
+            n_levels=p.get("mcts_levels", 8),
+            simulations=p.get("mcts_simulations", 256),
+            rollouts=p.get("mcts_rollouts", 64),
+            max_delay=p.get("max_interval", 0.1),
+            max_fault=p.get("max_fault", 0.0),
+        )
+        return MCTSSearch(cfg, mcts_cfg=mcts_cfg, n_devices=n_devices)
+    return ScheduleSearch(cfg, n_devices=n_devices)
+
+
+class SearchService:
+    """Holds one live search per experiment key."""
+
+    def __init__(self) -> None:
+        # key -> (params-fingerprint, search)
+        self._searches: Dict[str, Tuple[str, object]] = {}
+        self._lock = threading.Lock()
+        # one lock per key, held across the whole ingest+evolve+save:
+        # a timed-out client's next request for the same storage must
+        # queue behind the in-flight one — concurrent ingest would clear
+        # the archives mid-evolve (set_occupied_buckets) and corrupt the
+        # shared checkpoint
+        self._key_locks: Dict[str, threading.Lock] = {}
+
+    def handle(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "ping":
+            return {"ok": True, "searches": len(self._searches)}
+        if op == "search":
+            return self._search(req)
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _get_search(self, key: str, params: dict, checkpoint: str):
+        fp = json.dumps(params, sort_keys=True)
+        with self._lock:
+            cached = self._searches.get(key)
+            if cached is not None and cached[0] == fp:
+                return cached[1], False
+            search = build_search_from_params(params)
+            if checkpoint and os.path.exists(checkpoint):
+                try:
+                    search.load(checkpoint)
+                    log.info("loaded checkpoint %s (gen %d)",
+                             checkpoint, search.generations_run)
+                except Exception:
+                    log.exception("checkpoint %s not loadable; fresh "
+                                  "search", checkpoint)
+            self._searches[key] = (fp, search)
+            return search, True
+
+    def _key_lock(self, key: str) -> threading.Lock:
+        with self._lock:
+            return self._key_locks.setdefault(key, threading.Lock())
+
+    def _search(self, req: dict) -> dict:
+        key = str(req.get("key") or req.get("storage") or "default")
+        with self._key_lock(key):
+            return self._search_locked(key, req)
+
+    def _search_locked(self, key: str, req: dict) -> dict:
+        from namazu_tpu.models.ingest import IngestParams, ingest_history
+
+        params = req.get("search_params") or {}
+        checkpoint = str(req.get("checkpoint") or "")
+        search, fresh = self._get_search(key, params, checkpoint)
+        storage_dir = req.get("storage")
+        try:
+            storage = load_storage(storage_dir) if storage_dir else None
+        except Exception as e:
+            return {"ok": False, "error": f"storage: {e}"}
+        ip = req.get("ingest_params") or {}
+        references = ingest_history(
+            search, storage,
+            IngestParams(**{k: v for k, v in ip.items()
+                            if k in IngestParams._fields}))
+        if not references:
+            return {"ok": True, "no_history": True,
+                    "generations_run": search.generations_run}
+        best = search.run(references,
+                          generations=int(req.get("generations", 64)))
+        if checkpoint:
+            try:
+                search.save(checkpoint)
+            except Exception:
+                log.exception("could not save checkpoint %s", checkpoint)
+        return {
+            "ok": True,
+            "fitness": float(best.fitness),
+            "delays": [float(x) for x in best.delays],
+            "faults": [float(x) for x in best.faults],
+            "generations_run": search.generations_run,
+        }
+
+
+class SidecarServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 10990):
+        self.service = SearchService()
+        self._host, self._port = host, port
+        self._srv: Optional[socket.socket] = None
+        self._stop = threading.Event()
+
+    @property
+    def port(self) -> int:
+        assert self._srv is not None
+        return self._srv.getsockname()[1]
+
+    def start(self) -> None:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self._host, self._port))
+        srv.listen(8)
+        self._srv = srv
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="sidecar-accept").start()
+        log.info("search sidecar on %s:%d", self._host, self.port)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="sidecar-conn").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        # one request per connection: searches take seconds and the
+        # client blocks on the reply anyway, so connection reuse would
+        # only add framing state
+        try:
+            req = read_frame(conn)
+            if req is None:
+                return
+            try:
+                resp = self.service.handle(req)
+            except Exception as e:
+                log.exception("sidecar request failed")
+                resp = {"ok": False, "error": repr(e)}
+            write_frame(conn, resp)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def request(addr: str, req: dict, timeout: float = 300.0) -> dict:
+    """One framed request/response against a sidecar at ``host:port``."""
+    host, _, port = addr.rpartition(":")
+    with socket.create_connection((host or "127.0.0.1", int(port)),
+                                  timeout=timeout) as s:
+        write_frame(s, req)
+        resp = read_frame(s)
+    if resp is None:
+        raise ConnectionError(f"sidecar {addr}: connection closed")
+    return resp
+
+
+def serve_sidecar(host: str, port: int) -> int:
+    """CLI entry: serve until interrupted."""
+    server = SidecarServer(host, port)
+    server.start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+    return 0

@@ -1,0 +1,163 @@
+"""Persistent search sidecar (SURVEY.md §5.8's orchestrator ⇄ JAX
+boundary): framed-JSON wire, shared ingest with the in-process policy,
+warm-search amortization, checkpoint interchangeability, and the
+policy's sidecar delegation with in-process fallback.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from namazu_tpu.sidecar import SidecarServer, request
+from namazu_tpu.storage import new_storage
+from namazu_tpu.utils.config import Config
+
+from tests.test_tpu_policy import record_run  # reuse the history fixture
+
+
+@pytest.fixture
+def history(tmp_path):
+    st = new_storage("naive", str(tmp_path / "st"))
+    st.create()
+    record_run(st, ["a", "b", "a", "c", "b", "a"], successful=True)
+    record_run(st, ["b", "a", "c", "a", "b", "c"], successful=False)
+    return st
+
+
+@pytest.fixture
+def server():
+    s = SidecarServer(port=0)
+    s.start()
+    yield s
+    s.shutdown()
+
+
+SEARCH_PARAMS = {
+    "H": 32, "K": 32, "population": 64, "migrate_k": 2, "seed": 5,
+    "max_interval": 0.05, "surrogate_topk": 0,
+}
+INGEST_PARAMS = {"H": 32, "max_interval": 0.05}
+
+
+def search_req(history, ckpt=""):
+    return {
+        "op": "search",
+        "key": history.dir,
+        "storage": history.dir,
+        "search_params": SEARCH_PARAMS,
+        "ingest_params": INGEST_PARAMS,
+        "generations": 4,
+        "checkpoint": ckpt,
+    }
+
+
+def test_ping(server):
+    resp = request(f"127.0.0.1:{server.port}", {"op": "ping"})
+    assert resp == {"ok": True, "searches": 0}
+
+
+def test_search_and_warm_amortization(server, history, tmp_path):
+    addr = f"127.0.0.1:{server.port}"
+    ckpt = str(tmp_path / "side.npz")
+    t0 = time.monotonic()
+    r1 = request(addr, search_req(history, ckpt))
+    cold = time.monotonic() - t0
+    assert r1["ok"] and np.isfinite(r1["fitness"])
+    assert len(r1["delays"]) == 32
+    assert (tmp_path / "side.npz").exists()
+
+    t0 = time.monotonic()
+    r2 = request(addr, search_req(history, ckpt))
+    warm = time.monotonic() - t0
+    assert r2["ok"]
+    assert r2["generations_run"] > r1["generations_run"]
+    # the whole point of the sidecar: the compiled search is held, so a
+    # follow-up request skips construction + jit warm-up
+    assert warm < cold / 2, (cold, warm)
+
+
+def test_checkpoint_interchangeable_with_in_process(server, history,
+                                                    tmp_path):
+    """A checkpoint written by the sidecar loads in an in-process
+    ScheduleSearch built with the same params — the two homes are
+    interchangeable mid-experiment."""
+    from namazu_tpu.models.search import ScheduleSearch
+    from namazu_tpu.sidecar import build_search_from_params
+
+    addr = f"127.0.0.1:{server.port}"
+    ckpt = str(tmp_path / "x.npz")
+    assert request(addr, search_req(history, ckpt))["ok"]
+    local = build_search_from_params(SEARCH_PARAMS)
+    assert isinstance(local, ScheduleSearch)
+    local.load(ckpt)
+    assert local.generations_run >= 4
+
+
+def test_unknown_op_and_bad_storage(server):
+    addr = f"127.0.0.1:{server.port}"
+    assert not request(addr, {"op": "nope"})["ok"]
+    bad = {"op": "search", "key": "k", "storage": "/nonexistent-st",
+           "search_params": SEARCH_PARAMS, "ingest_params": INGEST_PARAMS,
+           "generations": 1, "checkpoint": ""}
+    resp = request(addr, bad)
+    assert not resp["ok"] and "storage" in resp["error"]
+
+
+def test_policy_delegates_to_sidecar(server, history):
+    """tpu_search with sidecar=addr installs the sidecar's table and
+    never builds a local search."""
+    from namazu_tpu.policy import create_policy
+
+    pol = create_policy("tpu_search")
+    pol.load_config(Config({
+        "explore_policy": "tpu_search",
+        "explore_policy_param": {
+            "seed": 5, "max_interval": 50, "hint_buckets": 32,
+            "feature_pairs": 32, "population": 64, "generations": 4,
+            "migrate_k": 2, "surrogate_topk": 0,
+            "sidecar": f"127.0.0.1:{server.port}",
+            "checkpoint": "side_pol.npz",
+        },
+    }))
+    pol.set_history_storage(history)
+    pol.start()
+    assert pol.wait_for_search(timeout=120)
+    assert pol._delays is not None and pol._delays.shape == (32,)
+    assert pol._search is None  # the heavy path never ran locally
+    pol.shutdown()
+
+
+def test_sidecar_without_checkpoint_fails_fast():
+    """The sidecar evolve's product ships via the checkpoint; a config
+    with sidecar but no checkpoint is wasted work every run and must be
+    rejected at load, like the other enum knobs."""
+    from namazu_tpu.policy import create_policy
+
+    pol = create_policy("tpu_search")
+    with pytest.raises(ValueError, match="checkpoint"):
+        pol.load_config(Config({
+            "explore_policy": "tpu_search",
+            "explore_policy_param": {"sidecar": "127.0.0.1:10990"},
+        }))
+
+
+def test_policy_falls_back_when_sidecar_down(history):
+    from namazu_tpu.policy import create_policy
+
+    pol = create_policy("tpu_search")
+    pol.load_config(Config({
+        "explore_policy": "tpu_search",
+        "explore_policy_param": {
+            "seed": 5, "max_interval": 50, "hint_buckets": 32,
+            "feature_pairs": 32, "population": 64, "generations": 2,
+            "migrate_k": 2, "surrogate_topk": 0,
+            "sidecar": "127.0.0.1:1",  # nothing listens there
+            "checkpoint": "fb.npz",
+        },
+    }))
+    pol.set_history_storage(history)
+    pol.start()
+    assert pol.wait_for_search(timeout=180)
+    assert pol._delays is not None  # in-process fallback produced one
+    pol.shutdown()
