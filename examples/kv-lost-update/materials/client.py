@@ -1,0 +1,66 @@
+"""Both racing clients in one process, two proxied connections.
+
+Each "client" does the classic lost-update read-modify-write: GET the
+counter, increment, PUT it back unconditionally (no compare-and-swap, no
+retry). One interpreter drives both from threads so the stagger between them
+(run.sh passes 180 ms) sits on a millisecond-accurate clock — two
+separate python processes on this one-core image boot with
+±hundreds-of-ms relative jitter, which would drown the window under
+test.
+
+Uninspected, the staggered windows never overlap (a round trip is
+milliseconds) and the final value is 2; under the ethernet inspector's
+deferrals client 1's PUT can cross client 2's GET and one increment
+vanishes.
+
+Usage: client.py PORT1 PORT2 STAGGER_S
+"""
+
+import http.client
+import sys
+import threading
+import time
+
+errors = []
+
+
+def rmw(conn: http.client.HTTPConnection, delay_s: float,
+        start: float) -> None:
+    # a crashed client is an infra error, not a bug repro: record the
+    # exception so main() exits nonzero and the runner aborts without
+    # recording (same guard as the zk-election node processes)
+    try:
+        time.sleep(max(0.0, start + delay_s - time.monotonic()))
+        conn.request("GET", "/kv")
+        v = int(conn.getresponse().read() or b"0")
+        # ... the unguarded window: "compute" the new value ...
+        new = str(v + 1)
+        conn.request("PUT", "/kv", body=new)
+        conn.getresponse().read()
+    except Exception as e:  # noqa: BLE001 - any failure is infra
+        errors.append(e)
+
+
+def main():
+    p1, p2 = int(sys.argv[1]), int(sys.argv[2])
+    stagger = float(sys.argv[3])
+    c1 = http.client.HTTPConnection("127.0.0.1", p1, timeout=30)
+    c2 = http.client.HTTPConnection("127.0.0.1", p2, timeout=30)
+    c1.connect()
+    c2.connect()
+    start = time.monotonic()
+    t1 = threading.Thread(target=rmw, args=(c1, 0.0, start))
+    t2 = threading.Thread(target=rmw, args=(c2, stagger, start))
+    t1.start()
+    t2.start()
+    t1.join()
+    t2.join()
+    c1.close()
+    c2.close()
+    if errors:
+        print(f"client error: {errors}", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""One proxy-inspector process for both client links, with the etcd
+(HTTP) stream parser providing semantic hints ("c1->kv:http:PUT:/kv").
+
+Usage: proxy.py ORCHESTRATOR_URL LINK[,LINK...]
+       LINK = listenPort:upstreamPort:srcEntity:dstEntity
+"""
+
+import signal as _signal
+import sys
+import threading
+
+from namazu_tpu.inspector.ethernet import EthernetProxyInspector
+from namazu_tpu.inspector.http_parser import etcd_parser
+from namazu_tpu.inspector.transceiver import new_transceiver
+
+
+def main():
+    url = sys.argv[1]
+    entity = "_nmz_kv_proxy"
+    trans = new_transceiver(url, entity)
+    inspector = EthernetProxyInspector(
+        trans, entity_id=entity, parser=etcd_parser(), action_timeout=30.0,
+    )
+    for spec in sys.argv[2].split(","):
+        lport, uport, src, dst = spec.split(":")
+        inspector.add_link(f"127.0.0.1:{lport}", f"127.0.0.1:{uport}",
+                           src_entity=src, dst_entity=dst)
+    inspector.start()
+    print("proxy ready", flush=True)
+    stop = threading.Event()
+    _signal.signal(_signal.SIGTERM, lambda *_: stop.set())
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        inspector.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""HTTP key-value server — the system under test.
+
+A miniature of the etcd-class workload (the reference's etcd examples
+drive a real etcd over HTTP, example/etcd/3517-reproduce): GET /kv
+returns the current value, PUT /kv sets it. Threaded per connection
+(keep-alive clients would otherwise starve each other behind the
+stdlib's one-connection-at-a-time default); each individual request is
+atomic under the GIL, so the server itself is consistent — the planted
+bug lives entirely in the CLIENTS' unguarded read-modify-write
+(client.py), like a real lost-update race.
+
+Usage: server.py PORT
+"""
+
+import sys
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class KV(BaseHTTPRequestHandler):
+    value = "0"
+
+    def _reply(self, code: int, body: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 (stdlib naming)
+        self._reply(200, KV.value)
+
+    def do_PUT(self):  # noqa: N802
+        n = int(self.headers.get("Content-Length", 0))
+        KV.value = self.rfile.read(n).decode() or "0"
+        self._reply(200, KV.value)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+def main():
+    srv = ThreadingHTTPServer(("127.0.0.1", int(sys.argv[1])), KV)
+    print("kv ready", flush=True)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

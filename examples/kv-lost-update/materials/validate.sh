@@ -1,0 +1,6 @@
+#!/bin/sh
+# Oracle: two increments from 0 must land at 2; a lost update (stale
+# read-modify-write overwriting the other client's increment) leaves 1.
+[ -f "$NMZ_WORKING_DIR/final" ] || exit 1
+[ "$(cat "$NMZ_WORKING_DIR/final")" = "2" ] || exit 1
+exit 0
