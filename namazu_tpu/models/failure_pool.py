@@ -1,0 +1,161 @@
+"""Durable cross-run failure-signature pool.
+
+The north-star A/B's weakest batches share one root cause (round-4
+measurements, RESULTS.md): phase B trains on whatever failures phase A
+happened to record — often one or two — and a search exploiting so few
+signatures overfits their noise. The reference has no answer to this
+(each experiment's history dir is an island; ``nmz run`` never looks
+outside it, cli/run.go:171-248). This pool is the cross-experiment
+memory: every ingested failure's *realized* encoding (the signature the
+search chases) plus its demonstration seed table is written to a shared
+directory, content-addressed; any later ingest — same storage, another
+batch, another process — folds the pooled signatures into its failure
+archive and seed set before evolving.
+
+Layout: one ``<digest>.npz`` per distinct signature (write-to-tmp +
+rename, so concurrent runs and sidecar requests never see a torn file;
+identical signatures land on the same name, making the pool its own
+dedupe). Entries are keyed by the content digest of the masked encoded
+trace, so re-ingesting the same stored run is a no-op.
+
+Entries stamp the hint space and bucket count; a pool written by a
+different build or config is skipped entry-by-entry, never trusted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+from typing import List, NamedTuple, Optional, Sequence, Set
+
+import numpy as np
+
+from namazu_tpu.ops.trace_encoding import HINT_SPACE, EncodedTrace
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("models.failure_pool")
+
+#: newest entries loaded per ingest — matches the failure archive's ring
+#: capacity (SearchConfig.failure_size); loading more would only evict
+#: older signatures from the very archive the pool exists to fill
+MAX_LOAD = 64
+
+
+class PoolEntry(NamedTuple):
+    digest: str
+    realized: EncodedTrace  # release-time view (archive embedding)
+    arrival: EncodedTrace  # arrival view (reference fallback)
+    seed: Optional[np.ndarray]  # f32[H] demonstration table, or None
+
+
+def trace_digest(enc: EncodedTrace) -> str:
+    """Content digest of the masked trace — identity + times, padding
+    excluded so the same run hashes identically under different encode
+    lengths."""
+    m = enc.mask
+    h = hashlib.sha256()
+    h.update(enc.hint_ids[m].tobytes())
+    h.update(enc.arrival[m].tobytes())
+    return h.hexdigest()[:32]
+
+
+def pool_add(pool_dir: str, realized: EncodedTrace, arrival: EncodedTrace,
+             seed: Optional[np.ndarray], H: int) -> str:
+    """Persist one failure signature; returns its digest. Idempotent —
+    an existing entry with the same digest is left untouched."""
+    digest = trace_digest(realized)
+    os.makedirs(pool_dir, exist_ok=True)
+    path = os.path.join(pool_dir, f"{digest}.npz")
+    if os.path.exists(path):
+        return digest
+    payload = {
+        "hint_space": np.asarray(HINT_SPACE),
+        "H": np.asarray(H),
+        "hint_ids": realized.hint_ids,
+        "entity_ids": realized.entity_ids,
+        "released": realized.arrival,  # the realized view's time vector
+        "arrival": arrival.arrival,
+        "mask": realized.mask,
+        "faultable": realized.faultable,
+    }
+    if seed is not None:
+        payload["seed"] = np.asarray(seed, np.float32)
+    fd, tmp = tempfile.mkstemp(dir=pool_dir, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return digest
+
+
+def pool_load(pool_dir: str, H: int,
+              exclude: Optional[Set[str]] = None,
+              max_entries: int = MAX_LOAD) -> List[PoolEntry]:
+    """Newest-first pooled signatures compatible with this build/config.
+
+    Entries from another hint space or bucket count are skipped with one
+    aggregate warning (same contract as the checkpoint loader,
+    models/search.py load): training on them would chase signatures in
+    a different feature space.
+    """
+    exclude = exclude or set()
+    if not os.path.isdir(pool_dir):
+        return []
+    files = []
+    for name in os.listdir(pool_dir):
+        if not name.endswith(".npz"):
+            continue
+        digest = name[:-4]
+        if digest in exclude:
+            continue
+        path = os.path.join(pool_dir, name)
+        try:
+            files.append((os.path.getmtime(path), digest, path))
+        except OSError:
+            continue
+    files.sort(reverse=True)  # newest first
+    entries: List[PoolEntry] = []
+    incompatible = 0
+    for _, digest, path in files:
+        if len(entries) >= max_entries:
+            break
+        try:
+            with np.load(path) as z:
+                if (str(z["hint_space"]) != HINT_SPACE
+                        or int(z["H"]) != H):
+                    incompatible += 1
+                    continue
+                ids = z["hint_ids"]
+                ents = z["entity_ids"]
+                mask = z["mask"]
+                fb = z["faultable"]
+                entries.append(PoolEntry(
+                    digest=digest,
+                    realized=EncodedTrace(ids, ents, z["released"], mask,
+                                          faultable=fb),
+                    arrival=EncodedTrace(ids, ents, z["arrival"], mask,
+                                         faultable=fb),
+                    seed=np.array(z["seed"]) if "seed" in z else None,
+                ))
+        except Exception:
+            log.exception("unreadable pool entry %s; skipping", path)
+    if incompatible:
+        log.warning(
+            "%d pooled signature(s) from another hint space or bucket "
+            "count were skipped (this build: %s, H=%d)",
+            incompatible, HINT_SPACE, H)
+    return entries
+
+
+def pool_size(pool_dir: str) -> int:
+    """Number of stored signatures (cheap: directory listing)."""
+    if not os.path.isdir(pool_dir):
+        return 0
+    return sum(1 for n in os.listdir(pool_dir) if n.endswith(".npz"))

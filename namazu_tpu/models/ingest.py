@@ -31,6 +31,13 @@ class IngestParams(NamedTuple):
     # order mode scores dense (whole-trace lexsort), so uncapped encodes
     # would materialize [population, L] intermediates per generation
     order_mode_max_l: int = 4096
+    # shared failure-signature pool directory ("" = off): every ingested
+    # failure is persisted there, and pooled signatures from OTHER runs/
+    # batches/experiments are folded into the failure archive + seeds —
+    # the cross-batch memory that keeps a search from training on the
+    # 1-2 failures its own phase A happened to record
+    # (models/failure_pool.py)
+    failure_pool: str = ""
 
 
 def failure_seed(trace, H: int, max_interval: float):
@@ -125,17 +132,41 @@ def ingest_history(search, storage, p: IngestParams) -> List:
             "excluded from search ingest (this build: %s); re-record "
             "under the current build to train on them",
             skipped_unstamped, HINT_SPACE)
+    # cross-batch failure pool: persist this storage's failures, then
+    # pull in signatures recorded by OTHER runs/batches (dedup by
+    # content digest — re-ingesting our own failures is a no-op)
+    pooled = []
+    if p.failure_pool:
+        from namazu_tpu.models.failure_pool import pool_add, pool_load
+
+        own = set()
+        for enc, enc_rt, ok, seed in encoded:
+            if not ok:
+                try:
+                    own.add(pool_add(p.failure_pool, enc_rt, enc,
+                                     seed, p.H))
+                except Exception:
+                    log.exception("could not pool failure signature")
+        pooled = pool_load(p.failure_pool, p.H, exclude=own)
+        if pooled:
+            log.info("folding %d pooled failure signature(s) into the "
+                     "search (pool %s)", len(pooled), p.failure_pool)
     # concentrate the feature pairs on the buckets the experiment
     # actually produces BEFORE embedding anything (a pair change clears
     # the archives; the loop below repopulates them in full)
-    occupied = sorted({int(b) for enc, _, _, _ in encoded
-                       for b in enc.hint_ids[enc.mask]})
+    occupied = sorted(
+        {int(b) for enc, _, _, _ in encoded
+         for b in enc.hint_ids[enc.mask]}
+        | {int(b) for e in pooled
+           for b in e.realized.hint_ids[e.realized.mask]})
     search.set_occupied_buckets(occupied)
     seeds = [s for _, _, ok, s in encoded if not ok and s is not None]
+    # most recent failures first: when seeds outnumber slots the
+    # freshest demonstrations win; pooled demonstrations (already
+    # newest-first) fill the remaining slots
+    seeds = seeds[::-1] + [e.seed for e in pooled if e.seed is not None]
     if seeds:
-        # most recent failures first: when seeds outnumber slots the
-        # freshest demonstrations win
-        search.seed_population(seeds[::-1][: p.max_seed_genomes])
+        search.seed_population(seeds[: p.max_seed_genomes])
     failures, successes = [], []
     for enc, enc_rt, ok, _ in encoded:
         # "failure" = the run reproduced the bug (validate failed); the
@@ -146,7 +177,20 @@ def ingest_history(search, storage, p: IngestParams) -> List:
             failures.append(enc)
         else:
             successes.append(enc)
+    for e in pooled:
+        # same treatment as an in-storage failure: archive embedding
+        # (novelty + surrogate positive) and failure-signature target —
+        # once per distinct signature (re-requests must not duplicate
+        # surrogate positives or evict diverse runs from the archive)
+        if search.has_failure_signature(e.digest):
+            continue
+        search.add_executed_trace(e.realized, reproduced=True)
+        search.add_failure_trace(e.realized)
     if p.reference_mode == "envelope" and successes:
         return [te.envelope_trace(successes)]
     pool = successes if successes else failures
+    if not pool and pooled:
+        # a fresh storage with no runs of its own can still evolve
+        # against pooled signatures' natural arrivals
+        pool = [e.arrival for e in reversed(pooled)]
     return pool[::-1][: p.max_reference_traces]

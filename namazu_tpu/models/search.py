@@ -37,6 +37,16 @@ class SearchConfig(NamedTuple):
     # with the highest predicted repro instead of the raw fitness argmax.
     # 0 disables (fitness argmax, the pre-surrogate behavior).
     surrogate_topk: int = 0
+    # novelty anneal (GA backend): with fewer than this many DISTINCT
+    # failure signatures in the archive the search keeps its full
+    # configured novelty weight (keep exploring — exploiting 1-2
+    # signatures overfits their noise, the round-4 A/B floor's root
+    # cause); once the archive holds >= this many, the novelty weight is
+    # scaled by min_failure_signatures / n_signatures (never below
+    # novelty_floor) so a rich archive shifts the search toward
+    # exploitation. 0 disables (static weights).
+    min_failure_signatures: int = 0
+    novelty_floor: float = 0.25
 
 
 class BestSchedule(NamedTuple):
@@ -116,6 +126,14 @@ class SearchBase:
         self._archive_n = 0
         self.failures = np.full((cfg.failure_size, cfg.K), 0.5, np.float32)
         self._failure_n = 0
+        # failure-signature dedupe: ingest re-feeds the WHOLE stored
+        # history every search request, so without it the failure ring
+        # fills with copies of the same 1-2 signatures and crowds out
+        # older distinct ones — exactly the thin-signature regime the
+        # novelty anneal and the cross-batch pool exist to escape.
+        # Slot-aligned digests (evicted slot -> digest leaves the set).
+        self._failure_digests = [""] * cfg.failure_size
+        self._failure_digest_set: set = set()
         self.generations_run = 0
         # fault half of the genome is scored only when faults can be
         # non-zero; coin=None keeps the pre-config-4 jit cache entry
@@ -144,6 +162,10 @@ class SearchBase:
         self._archive_n = 0
         self.failures[:] = 0.5
         self._failure_n = 0
+        # the caller re-ingests the full history right after, so the
+        # digests must clear with the features they key
+        self._failure_digests = [""] * self.cfg.failure_size
+        self._failure_digest_set.clear()
         self._reset_best()
 
     def _reset_best(self) -> None:
@@ -179,11 +201,35 @@ class SearchBase:
         self._archive_n += 1
 
     def add_failure_trace(self, encoded: te.EncodedTrace) -> None:
-        """Record a bug-reproducing run — the bug-affinity target."""
-        self.failures[self._failure_n % self.cfg.failure_size] = (
-            self._feats_of(encoded)
-        )
+        """Record a bug-reproducing run — the bug-affinity target.
+        Idempotent per distinct signature (content digest): re-ingesting
+        the same stored failure never spends a ring slot."""
+        from namazu_tpu.models.failure_pool import trace_digest
+
+        digest = trace_digest(encoded)
+        if digest in self._failure_digest_set:
+            return
+        slot = self._failure_n % self.cfg.failure_size
+        evicted = self._failure_digests[slot]
+        if evicted:
+            self._failure_digest_set.discard(evicted)
+        self.failures[slot] = self._feats_of(encoded)
+        self._failure_digests[slot] = digest
+        self._failure_digest_set.add(digest)
         self._failure_n += 1
+
+    def distinct_failure_signatures(self) -> int:
+        """How many distinct failure signatures the archive currently
+        holds — the novelty anneal's progress variable."""
+        return len(self._failure_digest_set)
+
+    def has_failure_signature(self, digest: str) -> bool:
+        """Whether a signature digest is already archived — lets ingest
+        skip the whole embed/add path for known pooled entries (not just
+        the ring write): without this, every search request re-embeds
+        every pooled signature and stuffs duplicate reproduced=True rows
+        into the novelty archive / surrogate training set."""
+        return digest in self._failure_digest_set
 
     def labeled_archive(self):
         """(feats [N,K], labels [N]) of the populated archive slots whose
@@ -232,6 +278,7 @@ class SearchBase:
             "archive_n": np.asarray(self._archive_n),
             "failures": self.failures,
             "failure_n": np.asarray(self._failure_n),
+            "failure_digests": np.asarray(self._failure_digests),
             "key": np.asarray(jax.random.key_data(self._key)),
             "generations_run": np.asarray(self.generations_run),
         }
@@ -285,6 +332,16 @@ class SearchBase:
             self._archive_n = int(z["archive_n"])
             self.failures = z["failures"]
             self._failure_n = int(z["failure_n"])
+            if "failure_digests" in z:
+                self._failure_digests = [str(d) for d in
+                                         z["failure_digests"]]
+                self._failure_digest_set = {d for d in
+                                            self._failure_digests if d}
+            else:
+                # pre-dedupe checkpoint: ring contents are unkeyed (and
+                # possibly duplicated); the next ingest re-keys afresh
+                self._failure_digests = [""] * self.cfg.failure_size
+                self._failure_digest_set = set()
             self._key = jax.random.wrap_key_data(jnp.asarray(z["key"]))
             self.generations_run = int(z["generations_run"])
             self._restore_state(z)
@@ -386,15 +443,30 @@ class ScheduleSearch(SearchBase):
         import jax.numpy as jnp
 
         coin = None if self._coin is None else jnp.asarray(self._coin)
+        nov_scale = jnp.asarray(self.novelty_scale(), jnp.float32)
         state = self._state
         for _ in range(generations):
             state = self._step(state, self._key, trace, pairs, archive,
-                               failures, coin)
+                               failures, coin, nov_scale)
         state.best_fitness.block_until_ready()
         self._state = state
         self.generations_run += generations
-        picked = self._surrogate_pick(trace, pairs, archive, failures)
+        picked = self._surrogate_pick(trace, pairs, archive, failures,
+                                      nov_scale)
         return picked if picked is not None else self.best()
+
+    def novelty_scale(self) -> float:
+        """Annealed multiplier on ``weights.novelty`` (see
+        ``SearchConfig.min_failure_signatures``): 1.0 while the failure
+        archive holds fewer than the threshold's worth of distinct
+        signatures, then decays as threshold/n, floored."""
+        ms = self.cfg.min_failure_signatures
+        if ms <= 0:
+            return 1.0
+        n = self.distinct_failure_signatures()
+        if n < ms:
+            return 1.0
+        return max(self.cfg.novelty_floor, ms / n)
 
     def _fetch_population(self):
         """Population as host numpy arrays (delays, faults).
@@ -447,8 +519,8 @@ class ScheduleSearch(SearchBase):
                               seed=self.cfg.seed + self.generations_run)
         return self._surrogate
 
-    def _surrogate_pick(self, trace, pairs, archive,
-                        failures) -> Optional[BestSchedule]:
+    def _surrogate_pick(self, trace, pairs, archive, failures,
+                        nov_scale=None) -> Optional[BestSchedule]:
         """Re-rank the evolved population's fitness top-k by predicted
         repro probability; return the winner (None = surrogate inactive)."""
         surrogate = self._train_surrogate()
@@ -468,6 +540,7 @@ class ScheduleSearch(SearchBase):
             delays, trace, pairs, archive, failures, self.cfg.weights,
             faults=None if self._coin is None else jnp.asarray(faults),
             coin=None if self._coin is None else jnp.asarray(self._coin),
+            novelty_scale=nov_scale,
         )
         top = np.asarray(jnp.argsort(-fitness)[:k])
         # features averaged over the reference traces, like the fitness

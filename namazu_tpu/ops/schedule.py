@@ -311,6 +311,7 @@ def score_population(
     weights: ScoreWeights = ScoreWeights(),
     faults: Optional[jax.Array] = None,  # [P, H] fault probabilities
     coin: Optional[jax.Array] = None,  # [H] deterministic fault coin
+    novelty_scale: Optional[jax.Array] = None,  # dynamic f32 scalar
 ) -> tuple[jax.Array, jax.Array]:
     """Fitness f32[P] and features f32[P,K] for a whole population.
 
@@ -318,7 +319,13 @@ def score_population(
     counterfactual: dropped events reshape the features, and a
     ``fault_cost`` per dropped event keeps "drop everything" from being
     the novelty optimum. Long delay-mode traces score blockwise (see
-    :func:`_genome_features`)."""
+    :func:`_genome_features`).
+
+    ``novelty_scale`` multiplies ``weights.novelty`` as a *traced*
+    scalar — the novelty-anneal lever (exploration weight decays as the
+    failure archive accumulates distinct signatures) without a new jit
+    specialization per annealed value. ``None`` keeps the pre-anneal
+    graph."""
     if faults is None:
         feats, _ = jax.vmap(
             lambda d: _genome_features(d, trace, pairs, weights.tau,
@@ -340,8 +347,10 @@ def score_population(
     novelty = _min_sq_distance_best(feats, archive)
     bug = -_min_sq_distance_best(feats, failure_feats)
     delay_cost = jnp.mean(delays, axis=-1)
+    w_nov = (weights.novelty if novelty_scale is None
+             else weights.novelty * novelty_scale)
     fitness = (
-        weights.novelty * novelty
+        w_nov * novelty
         + weights.bug * bug
         - weights.delay_cost * delay_cost
         - fault_pen
@@ -352,9 +361,10 @@ def score_population(
 @functools.partial(jax.jit, static_argnames=("weights",))
 def score_population_jit(delays, trace, pairs, archive, failure_feats,
                          weights: ScoreWeights = ScoreWeights(),
-                         faults=None, coin=None):
+                         faults=None, coin=None, novelty_scale=None):
     return score_population(delays, trace, pairs, archive, failure_feats,
-                            weights, faults=faults, coin=coin)
+                            weights, faults=faults, coin=coin,
+                            novelty_scale=novelty_scale)
 
 
 # -- multi-trace scoring ----------------------------------------------------
@@ -369,6 +379,7 @@ def score_population_multi(
     weights: ScoreWeights = ScoreWeights(),
     faults: Optional[jax.Array] = None,  # [P, H]
     coin: Optional[jax.Array] = None,  # [H]
+    novelty_scale: Optional[jax.Array] = None,  # dynamic f32 scalar
 ) -> tuple[jax.Array, jax.Array]:
     """Fitness aggregated over T recorded traces.
 
@@ -407,8 +418,10 @@ def score_population_multi(
     delay_cost = jnp.mean(delays, axis=-1)
     fault_pen = (0.0 if faults is None
                  else weights.fault_cost * frac.mean(axis=0))
+    w_nov = (weights.novelty if novelty_scale is None
+             else weights.novelty * novelty_scale)
     fitness = (
-        weights.novelty * novelty
+        w_nov * novelty
         + weights.bug * bug
         - weights.delay_cost * delay_cost
         - fault_pen

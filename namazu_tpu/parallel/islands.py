@@ -83,13 +83,14 @@ def make_multiaxis_island_step(
     axes = tuple(mesh.axis_names)
 
     def _local_step(key, pop, trace, pairs, archive, failure_feats,
-                    coin=None):
+                    novelty_scale, coin=None):
         for ax in axes:
             key = jax.random.fold_in(key, jax.lax.axis_index(ax))
 
         fitness, _feats = score_population_multi(
             pop.delays, trace, pairs, archive, failure_feats, weights,
             faults=None if coin is None else pop.faults, coin=coin,
+            novelty_scale=novelty_scale,
         )
         # local best before evolution (elites survive anyway)
         best_i = jnp.argmax(fitness)
@@ -150,6 +151,7 @@ def make_multiaxis_island_step(
             P(),  # pairs
             P(),  # archive
             P(),  # failure feats
+            P(),  # novelty anneal scale (replicated scalar)
         )
 
     sharded_fault = jax.shard_map(
@@ -169,7 +171,8 @@ def make_multiaxis_island_step(
 
     @jax.jit
     def step(state: IslandState, base_key, trace: TraceArrays, pairs,
-             archive, failure_feats, coin=None) -> IslandState:
+             archive, failure_feats, coin=None,
+             novelty_scale=None) -> IslandState:
         if trace.hint_ids.ndim == 1:  # single trace -> batch of one
             trace = jax.tree.map(lambda x: x[None], trace)
         trace = normalize_fault_trace(trace, coin)
@@ -182,15 +185,21 @@ def make_multiaxis_island_step(
                 "trace_encoding.fault_coin(seed, H)"
             )
         key = jax.random.fold_in(base_key, state.gen)
+        if novelty_scale is None:
+            novelty_scale = jnp.ones((), jnp.float32)
+        else:
+            novelty_scale = jnp.asarray(novelty_scale, jnp.float32)
         if coin is None:
             # static no-fault variant: the drop-mask/penalty branch is
             # never compiled into the hot loop when faults are off
             new_pop, fit, bd, bf = sharded_nofault(
-                key, state.pop, trace, pairs, archive, failure_feats
+                key, state.pop, trace, pairs, archive, failure_feats,
+                novelty_scale
             )
         else:
             new_pop, fit, bd, bf = sharded_fault(
-                key, state.pop, trace, pairs, archive, failure_feats, coin
+                key, state.pop, trace, pairs, archive, failure_feats,
+                novelty_scale, coin
             )
         improved = fit > state.best_fitness
         return IslandState(

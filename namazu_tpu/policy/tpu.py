@@ -109,6 +109,17 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.mcts_levels = 8
         self.mcts_rollouts = 64
         self.surrogate_topk = 16  # 0 = fitness argmax only (no surrogate)
+        # cross-batch failure-signature pool directory ("" = off); see
+        # models/failure_pool.py. Relative paths anchor to the PARENT of
+        # the storage dir (sibling experiments share one pool; anchoring
+        # inside the storage would make every batch an island again).
+        self.failure_pool = ""
+        # novelty anneal (GA backend): explore at full w_novelty until
+        # the failure archive holds this many DISTINCT signatures, then
+        # scale novelty down as the archive grows (SearchConfig docs).
+        # 0 = static weights (pre-anneal behavior).
+        self.min_failure_signatures = 0
+        self.novelty_floor = 0.25
         # fitness weights (ops/schedule.py ScoreWeights). For pure
         # repro-rate maximization set w_novelty=0 so the search chases
         # the failure signature alone; the defaults balance exploration
@@ -199,6 +210,17 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.mcts_levels = int(p("mcts_levels", self.mcts_levels))
         self.mcts_rollouts = int(p("mcts_rollouts", self.mcts_rollouts))
         self.surrogate_topk = int(p("surrogate_topk", self.surrogate_topk))
+        self.failure_pool = os.path.expanduser(os.path.expandvars(
+            str(p("failure_pool", self.failure_pool) or "")))
+        self.min_failure_signatures = int(
+            p("min_failure_signatures", self.min_failure_signatures))
+        self.novelty_floor = float(p("novelty_floor", self.novelty_floor))
+        if (self.min_failure_signatures > 0
+                and self.search_backend == "mcts"):
+            log.warning(
+                "novelty anneal (min_failure_signatures=%d) applies to "
+                "the GA backend only; the mcts backend scores with "
+                "static weights", self.min_failure_signatures)
         self.dcn_hosts = int(p("dcn_hosts", self.dcn_hosts))
         self.w_novelty = float(p("w_novelty", self.w_novelty))
         self.w_bug = float(p("w_bug", self.w_bug))
@@ -419,6 +441,8 @@ class TPUSearchPolicy(QueueBackedPolicy):
                         max_fault=self.max_fault),
             weights=weights,
             surrogate_topk=self.surrogate_topk,
+            min_failure_signatures=self.min_failure_signatures,
+            novelty_floor=self.novelty_floor,
         )
         mesh = None
         if self.dcn_hosts > 1:
@@ -645,6 +669,8 @@ class TPUSearchPolicy(QueueBackedPolicy):
             "max_interval": self.max_interval,
             "max_fault": self.max_fault,
             "surrogate_topk": self.surrogate_topk,
+            "min_failure_signatures": self.min_failure_signatures,
+            "novelty_floor": self.novelty_floor,
             "search_backend": self.search_backend,
             "mcts_tree_depth": self.mcts_tree_depth,
             "mcts_levels": self.mcts_levels,
@@ -692,6 +718,18 @@ class TPUSearchPolicy(QueueBackedPolicy):
         log.info("installed sidecar schedule (fitness %.4f, gen %d)",
                  resp["fitness"], resp["generations_run"])
 
+    def _failure_pool_path(self) -> str:
+        """Pool dir; a relative path anchors to the storage dir's PARENT
+        so sibling experiment storages (e.g. A/B batches under one root)
+        share one pool."""
+        p = self.failure_pool
+        if (p and not os.path.isabs(p)
+                and getattr(self._storage, "dir", None)):
+            parent = os.path.dirname(
+                os.path.abspath(self._storage.dir))
+            return os.path.join(parent, p)
+        return p
+
     def _ingest_params(self):
         from namazu_tpu.models.ingest import IngestParams
 
@@ -703,6 +741,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
             max_reference_traces=self.MAX_REFERENCE_TRACES,
             max_seed_genomes=self.MAX_SEED_GENOMES,
             order_mode_max_l=self.ORDER_MODE_MAX_L,
+            failure_pool=self._failure_pool_path(),
         )
     # order mode scores dense (a windowed permutation needs the whole
     # trace in one lexsort — ops/schedule.py), so uncapped encoding would

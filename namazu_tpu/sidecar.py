@@ -77,6 +77,8 @@ def build_search_from_params(p: dict):
                     max_fault=p.get("max_fault", 0.0)),
         weights=weights,
         surrogate_topk=p.get("surrogate_topk", 16),
+        min_failure_signatures=p.get("min_failure_signatures", 0),
+        novelty_floor=p.get("novelty_floor", 0.25),
     )
     n_devices = p.get("devices")
     if p.get("search_backend", "ga") == "mcts":

@@ -1,0 +1,240 @@
+"""Cross-batch failure-signature pool + novelty anneal
+(models/failure_pool.py, VERDICT r4 "raise the north-star floor").
+
+The pool is the cross-experiment memory the reference lacks (each
+``nmz run`` history dir is an island, cli/run.go:171-248): failures
+recorded in one storage must reach a search training on another, and
+re-ingesting the same failure must never spend another archive slot.
+"""
+
+import numpy as np
+import pytest
+
+from namazu_tpu.models.failure_pool import (
+    pool_add,
+    pool_load,
+    pool_size,
+    trace_digest,
+)
+from namazu_tpu.models.ingest import IngestParams, ingest_history
+from namazu_tpu.models.search import ScheduleSearch, SearchConfig
+from namazu_tpu.ops import trace_encoding as te
+
+H, K = 32, 32
+
+
+def _enc(seed: int, n: int = 12) -> te.EncodedTrace:
+    rng = np.random.RandomState(seed)
+    return te.encode_event_stream(
+        [f"hint:{rng.randint(0, 8)}" for _ in range(n)],
+        arrivals=np.cumsum(rng.rand(n) * 1e-3).tolist(),
+        L=16, H=H,
+    )
+
+
+def _search(**kw) -> ScheduleSearch:
+    cfg = SearchConfig(H=H, K=K, population=16, archive_size=16,
+                       failure_size=8, **kw)
+    return ScheduleSearch(cfg, n_devices=1)
+
+
+# -- digest / pool file layer -------------------------------------------
+
+
+def test_digest_ignores_padding():
+    a = _enc(0)
+    longer = te.EncodedTrace(
+        np.pad(a.hint_ids, (0, 16)), np.pad(a.entity_ids, (0, 16)),
+        np.pad(a.arrival, (0, 16)), np.pad(a.mask, (0, 16)),
+    )
+    assert trace_digest(a) == trace_digest(longer)
+    assert trace_digest(a) != trace_digest(_enc(1))
+
+
+def test_pool_roundtrip_and_idempotence(tmp_path):
+    pool = str(tmp_path / "pool")
+    enc = _enc(0)
+    seed = np.linspace(0, 0.1, H).astype(np.float32)
+    d1 = pool_add(pool, enc, enc, seed, H)
+    d2 = pool_add(pool, enc, enc, seed, H)  # same content -> same entry
+    assert d1 == d2
+    assert pool_size(pool) == 1
+    entries = pool_load(pool, H)
+    assert len(entries) == 1
+    e = entries[0]
+    assert e.digest == d1
+    np.testing.assert_array_equal(e.realized.hint_ids, enc.hint_ids)
+    np.testing.assert_allclose(e.seed, seed)
+    # exclusion: loading with the digest excluded returns nothing
+    assert pool_load(pool, H, exclude={d1}) == []
+
+
+def test_pool_skips_other_bucket_count(tmp_path):
+    pool = str(tmp_path / "pool")
+    enc = _enc(0)
+    pool_add(pool, enc, enc, None, H)
+    assert pool_load(pool, H * 2) == []  # other config: not trusted
+
+
+# -- archive dedupe ------------------------------------------------------
+
+
+def test_add_failure_trace_dedupes():
+    s = _search()
+    enc = _enc(0)
+    s.add_failure_trace(enc)
+    s.add_failure_trace(enc)  # re-ingest of the same stored run
+    assert s._failure_n == 1
+    assert s.distinct_failure_signatures() == 1
+    s.add_failure_trace(_enc(1))
+    assert s.distinct_failure_signatures() == 2
+
+
+def test_failure_ring_eviction_frees_digest():
+    s = _search()
+    for i in range(10):  # ring holds 8
+        s.add_failure_trace(_enc(i))
+    assert s.distinct_failure_signatures() == 8
+    # evicted signature 0 may be re-added (spends a slot again)
+    s.add_failure_trace(_enc(0))
+    assert s.distinct_failure_signatures() == 8
+
+
+def test_digests_survive_checkpoint(tmp_path):
+    s = _search()
+    s.add_failure_trace(_enc(0))
+    s.add_failure_trace(_enc(1))
+    ckpt = str(tmp_path / "s.npz")
+    s.save(ckpt)
+    s2 = _search()
+    s2.load(ckpt)
+    assert s2.distinct_failure_signatures() == 2
+    s2.add_failure_trace(_enc(0))  # still deduped after restore
+    assert s2._failure_n == 2
+
+
+# -- novelty anneal ------------------------------------------------------
+
+
+def test_novelty_scale_schedule():
+    s = _search(min_failure_signatures=3, novelty_floor=0.2)
+    assert s.novelty_scale() == 1.0  # no signatures: explore
+    for i in range(2):
+        s.add_failure_trace(_enc(i))
+    assert s.novelty_scale() == 1.0  # below threshold: still explore
+    s.add_failure_trace(_enc(2))
+    assert s.novelty_scale() == 1.0  # at threshold
+    for i in range(3, 8):
+        s.add_failure_trace(_enc(i))
+    assert s.novelty_scale() == pytest.approx(3 / 8)
+    # floor
+    s2 = _search(min_failure_signatures=1, novelty_floor=0.5)
+    for i in range(8):
+        s2.add_failure_trace(_enc(i))
+    assert s2.novelty_scale() == 0.5
+
+
+def test_anneal_off_by_default():
+    s = _search()
+    for i in range(6):
+        s.add_failure_trace(_enc(i))
+    assert s.novelty_scale() == 1.0
+
+
+def test_run_with_anneal_executes():
+    """The annealed scale flows through the jitted island step and the
+    fitness actually responds to it (a pure-novelty genome scores lower
+    under anneal than without)."""
+    s = _search(min_failure_signatures=1, novelty_floor=0.1)
+    for i in range(4):
+        s.add_failure_trace(_enc(i))
+    best = s.run([_enc(100)], generations=3)
+    assert np.isfinite(best.fitness)
+    assert s.novelty_scale() == pytest.approx(0.25)
+
+
+# -- ingest integration --------------------------------------------------
+
+
+class _FakeStorage:
+    """Minimal storage: list of (trace, successful)."""
+
+    def __init__(self, runs):
+        self.runs = runs
+
+    def nr_stored_histories(self):
+        return len(self.runs)
+
+    def get_stored_history(self, i):
+        return self.runs[i][0]
+
+    def is_successful(self, i):
+        return self.runs[i][1]
+
+    def get_metadata(self, i):
+        return {"hint_space": te.HINT_SPACE}
+
+
+def _trace(seed: int, fail_delay: float = 0.0):
+    """A small recorded trace (actions with arrival + release stamps)."""
+    from namazu_tpu.signal import PacketEvent
+    from namazu_tpu.signal.action import EventAcceptanceAction
+    from namazu_tpu.utils.trace import SingleTrace
+
+    rng = np.random.RandomState(seed)
+    trace = SingleTrace()
+    t = 1000.0
+    for i in range(10):
+        ev = PacketEvent.create(f"n{rng.randint(3)}", "a", "b",
+                                hint=f"m{i % 5}")
+        a = EventAcceptanceAction.for_event(ev)
+        t += float(rng.rand() * 1e-3)
+        a.event_arrived = t
+        a.triggered_time = t + fail_delay
+        trace.append(a)
+    return trace
+
+
+def test_ingest_pools_across_storages(tmp_path):
+    pool = str(tmp_path / "pool")
+    p = IngestParams(H=H, failure_pool=pool)
+
+    # batch 1: one failure recorded -> pooled
+    s1 = _search()
+    st1 = _FakeStorage([(_trace(0), True), (_trace(1, 0.05), False)])
+    refs1 = ingest_history(s1, st1, p)
+    assert refs1
+    assert pool_size(pool) == 1
+    assert s1.distinct_failure_signatures() == 1
+
+    # batch 2 (fresh storage, DIFFERENT failure): sees its own failure
+    # plus batch 1's pooled signature
+    s2 = _search()
+    st2 = _FakeStorage([(_trace(2), True), (_trace(3, 0.07), False)])
+    ingest_history(s2, st2, p)
+    assert pool_size(pool) == 2
+    assert s2.distinct_failure_signatures() == 2
+
+    # batch 3: no failures of its own, trains purely on the pool
+    s3 = _search()
+    st3 = _FakeStorage([(_trace(4), True)])
+    ingest_history(s3, st3, p)
+    assert s3.distinct_failure_signatures() == 2
+
+    # re-ingesting batch 2 is fully deduped (no growth anywhere)
+    ingest_history(s2, st2, p)
+    assert pool_size(pool) == 2
+    assert s2.distinct_failure_signatures() == 2
+
+
+def test_ingest_pool_only_references(tmp_path):
+    """A storage with zero runs still gets references from the pool."""
+    pool = str(tmp_path / "pool")
+    p = IngestParams(H=H, failure_pool=pool)
+    s1 = _search()
+    ingest_history(s1, _FakeStorage([(_trace(1, 0.05), False)]), p)
+
+    s2 = _search()
+    refs = ingest_history(s2, _FakeStorage([]), p)
+    assert refs  # pooled arrival views serve as references
+    assert s2.distinct_failure_signatures() == 1
