@@ -1,0 +1,72 @@
+"""A template for your own exploration policy.
+
+Parity: /root/reference/example/template/mypolicy.go:15-80 — the
+documented plugin entry point. The Go version must be compiled into its
+own ``main`` that wraps the whole CLI; here the same file works BOTH
+ways:
+
+* **config-driven** (preferred): the experiment config names this file
+  in ``policy_plugins`` and sets ``explore_policy = "mypolicy"`` — the
+  stock ``nmz-tpu init/run`` loads it from the materials dir, no custom
+  binary;
+* **reference-style**: ``python mypolicy.py init|run ...`` is its own
+  driver, exactly like the Go template's ``main()``.
+
+The policy itself demonstrates the three things every policy does:
+consume events without blocking, decide an order, and emit actions.
+This one releases each window of pending events in REVERSE arrival
+order ("pong" before "ping") — trivially wrong as a fuzzer, obviously
+visible in a trace, which is the point of a template.
+"""
+
+from namazu_tpu.policy.base import QueueBackedPolicy, register_policy
+from namazu_tpu.signal.event import Event, ProcSetEvent
+from namazu_tpu.signal.action import ProcSetSchedAction
+from namazu_tpu.utils.config import parse_duration
+
+
+class MyPolicy(QueueBackedPolicy):
+    NAME = "mypolicy"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.hold = 0.05  # seconds each event is held back
+
+    def load_config(self, config) -> None:
+        # read your knobs from [explore_policy_param]
+        self.hold = parse_duration(config.policy_param("hold", 50))
+
+    def queue_event(self, event: Event) -> None:
+        """Called for EVERY intercepted event; must never block.
+
+        Possible events mirror the reference template's comment
+        (mypolicy.go:48-53): PacketEvent, FilesystemEvent, ProcSetEvent,
+        LogEvent, FunctionEvent. Fault actions (PacketFaultAction,
+        FilesystemFaultAction, ShellAction) can be emitted instead of
+        the default — see event.default_fault_action().
+        """
+        self.start()
+        if isinstance(event, ProcSetEvent):
+            # procfs events want scheduler attributes, not a release
+            self._emit(ProcSetSchedAction.for_procset(event, {}))
+            return
+        # the ScheduledQueue releases each event at now+bound; holding
+        # the n-th arrival for hold/n makes later arrivals OVERTAKE
+        # earlier ones whenever they come close together — a visibly
+        # "impossible" order a passthrough policy never produces, easy
+        # to spot in `tools dump-trace`
+        self._n = getattr(self, "_n", 0) + 1
+        self._queue.put_at(event, self.hold / self._n)
+
+
+register_policy(MyPolicy.NAME, MyPolicy)
+
+
+if __name__ == "__main__":
+    # reference-style standalone driver (mypolicy.go:73-80): this file
+    # IS the CLI, with the policy pre-registered
+    import sys
+
+    from namazu_tpu.cli import cli_main
+
+    sys.exit(cli_main(sys.argv[1:]))

@@ -1,0 +1,52 @@
+"""Minimal testee for the policy template: two messages through the
+orchestrator, realized order written out.
+
+Sends a "first" then a "second" PacketEvent via the REST endpoint (the
+same wire real inspectors use) and records the order the policy RELEASED
+them in. Under ``mypolicy`` (later arrivals release earlier) the realized
+order is second,first — an order a passthrough policy never produces, so
+validate.sh can assert the plugin actually drove the schedule.
+"""
+
+import sys
+import threading
+import time
+
+from namazu_tpu.inspector.transceiver import new_transceiver
+from namazu_tpu.signal import PacketEvent
+
+
+def main() -> int:
+    url, out_path = sys.argv[1], sys.argv[2]
+    trans = new_transceiver(url, "pingpong")
+    trans.start()
+
+    order, lock = [], threading.Lock()
+
+    def send(tag: str, delay: float):
+        time.sleep(delay)
+        ch = trans.send_event(PacketEvent.create(
+            "pingpong", "client", "server", hint=tag))
+        act = ch.get(timeout=30)
+        assert act is not None, f"no action for {tag}"
+        with lock:
+            order.append(tag)
+
+    threads = []
+    # "first" demonstrably arrives before "second" (40 ms apart — well
+    # inside mypolicy's default 200 ms hold, so the overtake triggers)
+    for tag, delay in (("first", 0.0), ("second", 0.04)):
+        t = threading.Thread(target=send, args=(tag, delay))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=60)
+
+    with open(out_path, "w") as f:
+        f.write(",".join(order) + "\n")
+    print("released order:", ",".join(order))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,7 @@
+#!/bin/sh
+# Send two messages through the orchestrator and record the order the
+# policy released them in. PALLAS_AXON_POOL_IPS= skips this image's TPU
+# plugin boot in the short-lived interpreter (~2s per python startup).
+PORT="${NMZ_REST_PORT:-10983}"
+PALLAS_AXON_POOL_IPS= python "$NMZ_MATERIALS_DIR/pingpong.py" \
+    "http://127.0.0.1:${PORT}" "$NMZ_WORKING_DIR/order.txt"

@@ -1,0 +1,8 @@
+#!/bin/sh
+# "Bug found" = the policy reordered the messages: the later-sent
+# "second" was released before "first". Under mypolicy this happens
+# every run (deterministic overtake); under the dumb passthrough it
+# never does — so the A/B over this pair demonstrates that the plugin
+# actually drove the schedule.
+test "$(cat "$NMZ_WORKING_DIR/order.txt")" = "second,first" && exit 1
+exit 0

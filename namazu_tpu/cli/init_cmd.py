@@ -31,6 +31,9 @@ def run(args) -> int:
     cfg = Config.from_file(args.config)
     # fail early on a bad policy name (validation parity: init.go checks
     # the config before touching the filesystem)
+    from namazu_tpu.policy.plugins import load_policy_plugins
+
+    load_policy_plugins(cfg, args.materials)
     policy = create_policy(cfg.get("explore_policy"))
     policy.load_config(cfg)
     policy.shutdown()

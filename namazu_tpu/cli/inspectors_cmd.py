@@ -8,6 +8,7 @@ in-process (no separate orchestrator needed).
 
 from __future__ import annotations
 
+import os
 import sys
 
 from namazu_tpu.inspector.transceiver import new_transceiver
@@ -227,6 +228,11 @@ def _run_fs_preload(args) -> int:
     # auto-assigned port (orchestrator/core.py; same wiring container.py
     # uses) — and a rest_port in the --autopilot config still works
     cfg.set("agent_port", 0)
+    if args.autopilot:
+        from namazu_tpu.policy.plugins import load_policy_plugins
+
+        load_policy_plugins(
+            cfg, os.path.dirname(os.path.abspath(args.autopilot)))
     policy = create_policy(cfg.get("explore_policy"))
     policy.load_config(cfg)
     orc = Orchestrator(cfg, policy, collect_trace=True)

@@ -7,6 +7,7 @@ processes/hosts and there is no experiment loop (no trace recording).
 
 from __future__ import annotations
 
+import os
 import signal as _signal
 import threading
 
@@ -34,6 +35,13 @@ def run(args) -> int:
     elif int(cfg.get("rest_port", -1)) < 0:
         cfg.set("rest_port", DEFAULT_REST_PORT)
 
+    from namazu_tpu.policy.plugins import load_policy_plugins
+
+    # no storage here: relative plugin paths resolve against the
+    # config file's directory
+    load_policy_plugins(
+        cfg, os.path.dirname(os.path.abspath(args.config))
+        if args.config else None)
     policy = create_policy(cfg.get("explore_policy"))
     policy.load_config(cfg)
     orchestrator = Orchestrator(cfg, policy, collect_trace=False)

@@ -54,6 +54,9 @@ def run(args) -> int:
     init_log(os.path.join(working_dir, "nmz.log"))
     factory = CmdFactory(working_dir=working_dir, materials_dir=materials_dir)
 
+    from namazu_tpu.policy.plugins import load_policy_plugins
+
+    load_policy_plugins(cfg, materials_dir)
     policy = create_policy(cfg.get("explore_policy"))
     policy.load_config(cfg)
     policy.set_history_storage(storage)

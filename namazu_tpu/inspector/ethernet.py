@@ -148,11 +148,22 @@ class ProxyLink:
         framed = hasattr(insp.parser, "segment")
 
         def writer() -> None:
+            # once the stream is dead (unframed drop closed it, or a
+            # send failed) the writer keeps consuming rel_q in drain
+            # mode until the reader's None sentinel: deferred events
+            # queued behind the break must be forgotten, not stranded —
+            # their correlation state would leak and late actions would
+            # land in channels nobody reads (ADVICE r4)
+            draining = False
             while True:
                 item = rel_q.get()
                 if item is None:
                     break
                 data, ch, event = item
+                if draining:
+                    if ch is not None:
+                        insp.trans.forget(event)
+                    continue
                 if ch is not None:
                     try:
                         action = ch.get(timeout=insp.action_timeout)
@@ -163,7 +174,7 @@ class ProxyLink:
                             src_entity, dst_entity, insp.action_timeout)
                         action = None
                     if isinstance(action, PacketFaultAction):
-                        insp.drop_count += 1
+                        insp.count_drop()
                         if framed:
                             continue  # skip one whole message
                         log.info(
@@ -175,12 +186,13 @@ class ProxyLink:
                                 s.shutdown(socket.SHUT_RDWR)
                             except OSError:
                                 pass
-                        break
+                        draining = True
+                        continue
                 if data:
                     try:
                         dst.sendall(data)
                     except OSError:
-                        break
+                        draining = True
 
         wt = threading.Thread(
             target=writer, daemon=True,
@@ -342,7 +354,7 @@ class UdpProxyLink:
                             insp.action_timeout)
                 action = None
             if isinstance(action, PacketFaultAction):
-                insp.drop_count += 1  # the fault: datagram never forwarded
+                insp.count_drop()  # the fault: datagram never forwarded
                 continue
             try:
                 forward(data)
@@ -380,6 +392,18 @@ class EthernetProxyInspector:
         self.drop_count = 0
         self._conn_counter = 0
         self._conn_lock = threading.Lock()
+        # reader threads and release workers bump these concurrently;
+        # unguarded += lost increments under contention (ADVICE r4 —
+        # HookSwitchInspector already guards its counters)
+        self._stats_lock = threading.Lock()
+
+    def count_drop(self) -> None:
+        with self._stats_lock:
+            self.drop_count += 1
+
+    def _count_packet(self) -> None:
+        with self._stats_lock:
+            self.packet_count += 1
 
     def next_conn_id(self) -> int:
         with self._conn_lock:
@@ -447,7 +471,7 @@ class EthernetProxyInspector:
             if hint is None:
                 out.append((data, None, None))
                 continue
-            self.packet_count += 1
+            self._count_packet()
             event = PacketEvent.create(
                 self.entity_id, src_entity, dst_entity,
                 payload=data[:128], hint=hint,
@@ -473,7 +497,7 @@ class EthernetProxyInspector:
                 hint = self.parser(data, src_entity, dst_entity)
             if hint is None:
                 return (data, None, None)
-        self.packet_count += 1
+        self._count_packet()
         event = PacketEvent.create(
             self.entity_id, src_entity, dst_entity,
             payload=data[:128], hint=hint or "",

@@ -82,19 +82,26 @@ def decode_ethernet(frame: bytes) -> Packet:
         return Packet()
     ihl = (ver_ihl & 0xF) * 4
     proto = frame[off + 9]
+    # clip to the IPv4 total length: sub-60-byte frames arrive with
+    # ethernet trailer padding after the IP datagram, and a payload
+    # slice taken to the frame end would digest the padding — the same
+    # protocol message would then hash into different replay-hint
+    # buckets depending on whether the capture path pads (ADVICE r4)
+    (total_len,) = struct.unpack_from("!H", frame, off + 2)
+    end = min(len(frame), off + max(total_len, ihl))
     src_ip = ".".join(str(b) for b in frame[off + 12:off + 16])
     dst_ip = ".".join(str(b) for b in frame[off + 16:off + 20])
     l4 = off + ihl
-    if proto == PROTO_TCP and len(frame) >= l4 + 20:
+    if proto == PROTO_TCP and end >= l4 + 20:
         sport, dport, seq, ack = struct.unpack_from("!HHII", frame, l4)
         data_off = (frame[l4 + 12] >> 4) * 4
         flags = frame[l4 + 13] & (FIN | SYN | RST | PSH | ACK)
         return Packet(src_ip, dst_ip, proto, sport, dport, seq, ack,
-                      flags, bytes(frame[l4 + data_off:]))
-    if proto == PROTO_UDP and len(frame) >= l4 + 8:
+                      flags, bytes(frame[l4 + data_off:end]))
+    if proto == PROTO_UDP and end >= l4 + 8:
         sport, dport = struct.unpack_from("!HH", frame, l4)
         return Packet(src_ip, dst_ip, proto, sport, dport,
-                      payload=bytes(frame[l4 + 8:]))
+                      payload=bytes(frame[l4 + 8:end]))
     return Packet(src_ip, dst_ip, proto)
 
 

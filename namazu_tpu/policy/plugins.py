@@ -1,0 +1,83 @@
+"""Config-driven out-of-tree policy loading.
+
+The reference's plugin story is "compile your own main() that calls
+RegisterPolicy then delegates to the CLI"
+(/root/reference/example/template/mypolicy.go:73-80) — workable for Go,
+but it means every custom policy ships a whole binary. Python can do
+better: the ``policy_plugins`` config key names modules or ``.py`` files
+(relative paths resolve against the experiment's materials dir, so
+``init`` versions the plugin with the experiment) that ``run`` imports
+before creating the policy; each plugin registers itself at import via
+:func:`namazu_tpu.policy.register_policy`, exactly like the built-ins.
+
+The reference-style flow still works too — a plugin file with a
+``__main__`` block delegating to ``cli_main`` is its own driver
+(examples/template/materials/mypolicy.py shows both).
+"""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import os
+import sys
+from typing import Optional
+
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("policy.plugins")
+
+#: absolute paths already executed — loads are idempotent so that
+#: multiple ``run`` invocations inside one process (the ab harness, the
+#: test suite) don't re-execute module bodies and trip the registry's
+#: duplicate-name guard
+_LOADED: set = set()
+
+
+def load_policy_plugins(cfg, materials_dir: Optional[str] = None) -> None:
+    """Import every entry of the config's ``policy_plugins`` list.
+
+    Entries ending in ``.py`` are loaded as files (relative to
+    ``materials_dir`` when given); anything else is imported as a module
+    path. A broken plugin fails the run loudly — a silently missing
+    policy would let the experiment fall back to nothing.
+    """
+    plugins = cfg.get("policy_plugins", []) or []
+    if isinstance(plugins, str):
+        plugins = [plugins]
+    for spec in plugins:
+        spec = str(spec)
+        if spec.endswith(".py"):
+            path = spec
+            if not os.path.isabs(path) and materials_dir:
+                cand = os.path.join(materials_dir, path)
+                if os.path.exists(cand):
+                    path = cand
+            path = os.path.abspath(path)
+            if path in _LOADED:
+                continue
+            if not os.path.exists(path):
+                raise FileNotFoundError(
+                    f"policy plugin {spec!r} not found (looked at "
+                    f"{path}; relative paths resolve against the "
+                    "materials dir)")
+            name = ("nmz_policy_plugin_"
+                    + os.path.splitext(os.path.basename(path))[0])
+            loader_spec = importlib.util.spec_from_file_location(name, path)
+            module = importlib.util.module_from_spec(loader_spec)
+            # registered in sys.modules BEFORE exec so dataclasses,
+            # pickling, and self-imports inside the plugin resolve
+            sys.modules[name] = module
+            try:
+                loader_spec.loader.exec_module(module)
+            except BaseException:
+                sys.modules.pop(name, None)
+                raise
+            _LOADED.add(path)
+            log.info("loaded policy plugin %s", path)
+        else:
+            if spec in _LOADED:
+                continue
+            importlib.import_module(spec)
+            _LOADED.add(spec)
+            log.info("loaded policy plugin module %s", spec)

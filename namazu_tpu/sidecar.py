@@ -40,6 +40,8 @@ import socket
 import threading
 from typing import Dict, Optional, Tuple
 
+import numpy as np
+
 from namazu_tpu.endpoint.agent import read_frame, write_frame
 from namazu_tpu.storage import load_storage
 from namazu_tpu.utils.log import get_logger
@@ -122,19 +124,53 @@ class SearchService:
         fp = json.dumps(params, sort_keys=True)
         with self._lock:
             cached = self._searches.get(key)
-            if cached is not None and cached[0] == fp:
-                return cached[1], False
-            search = build_search_from_params(params)
-            if checkpoint and os.path.exists(checkpoint):
-                try:
-                    search.load(checkpoint)
-                    log.info("loaded checkpoint %s (gen %d)",
-                             checkpoint, search.generations_run)
-                except Exception:
-                    log.exception("checkpoint %s not loadable; fresh "
-                                  "search", checkpoint)
+        if cached is not None and cached[0] == fp:
+            search = cached[1]
+            self._maybe_reload(search, checkpoint)
+            return search, False
+        # build OUTSIDE the global lock: jit construction can take
+        # seconds and must not block ping or other keys' requests — the
+        # caller already holds this key's lock, which serializes
+        # same-key requests (ADVICE r4)
+        search = build_search_from_params(params)
+        if checkpoint and os.path.exists(checkpoint):
+            try:
+                search.load(checkpoint)
+                log.info("loaded checkpoint %s (gen %d)",
+                         checkpoint, search.generations_run)
+            except Exception:
+                log.exception("checkpoint %s not loadable; fresh "
+                              "search", checkpoint)
+        with self._lock:
             self._searches[key] = (fp, search)
-            return search, True
+        return search, True
+
+    def _maybe_reload(self, search, checkpoint: str) -> None:
+        """Reload a cached search whose on-disk checkpoint is AHEAD of
+        it: when a sidecar request fails the policy falls back to an
+        in-process evolve and saves, so serving the next request from
+        the stale in-memory state would overwrite those generations at
+        the next save (lost update, ADVICE r4). generations_run is
+        monotonic, so disk-ahead detection is one npz field read."""
+        if not checkpoint or not os.path.exists(checkpoint):
+            return
+        try:
+            with np.load(checkpoint) as z:
+                disk_gen = (int(z["generations_run"])
+                            if "generations_run" in z else -1)
+        except Exception:
+            return  # unreadable/corrupt: keep the live state
+        if disk_gen > search.generations_run:
+            try:
+                search.load(checkpoint)
+                log.info(
+                    "reloaded checkpoint %s: disk at gen %d, cached "
+                    "search at %d (in-process fallback ran between "
+                    "requests)", checkpoint, disk_gen,
+                    search.generations_run)
+            except Exception:
+                log.exception("newer checkpoint %s not loadable; "
+                              "keeping cached state", checkpoint)
 
     def _key_lock(self, key: str) -> threading.Lock:
         with self._lock:

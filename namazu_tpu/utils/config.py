@@ -30,6 +30,12 @@ DEFAULTS: Dict[str, Any] = {
     "run": "",
     "validate": "",
     "clean": "",
+    # out-of-tree policy plugins: modules or .py files (relative paths
+    # resolve against the materials dir) imported before the policy is
+    # created; each registers itself via register_policy
+    # (namazu_tpu/policy/plugins.py; reference counterpart:
+    # example/template/mypolicy.go's compile-your-own-main flow)
+    "policy_plugins": [],
     # endpoints: -1 = disabled, 0 = auto-assign, >0 = fixed port
     "rest_port": -1,
     "agent_port": -1,  # framed-TCP guest-agent endpoint (reference: pbPort)

@@ -55,6 +55,18 @@ def test_decode_ethernet_headers():
     assert decode_ethernet(b"").src_entity == "_nmz_unknown_entity"
 
 
+def test_decode_ethernet_clips_trailer_padding():
+    """Sub-60-byte frames arrive with ethernet trailer padding after the
+    IP datagram; the payload (and thus content_hint) must stop at the
+    IPv4 total length or the same message hashes into different
+    replay-hint buckets depending on the capture path (ADVICE r4)."""
+    f = tcp_frame("10.0.0.1", 2888, "10.0.0.2", 3888, seq=7, payload=b"v")
+    padded = f + b"\x00" * (60 - len(f)) if len(f) < 60 else f + b"\x00\x00"
+    a, b = decode_ethernet(f), decode_ethernet(padded)
+    assert a.payload == b.payload == b"v"
+    assert a.content_hint() == b.content_hint()
+
+
 def test_retrans_watcher_matches_reference_semantics():
     w = TcpRetransWatcher()
     a = decode_ethernet(tcp_frame("1.1.1.1", 1, "2.2.2.2", 2, seq=10))

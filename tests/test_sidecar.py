@@ -94,6 +94,34 @@ def test_checkpoint_interchangeable_with_in_process(server, history,
     assert local.generations_run >= 4
 
 
+def test_cached_search_reloads_newer_checkpoint(server, history, tmp_path):
+    """A failed sidecar request makes the policy evolve in-process and
+    save; the sidecar's next request for that key must reload the newer
+    on-disk checkpoint instead of overwriting it with its stale cached
+    state (lost update, ADVICE r4)."""
+    from namazu_tpu.models.ingest import IngestParams, ingest_history
+    from namazu_tpu.sidecar import build_search_from_params
+
+    ckpt = str(tmp_path / "c.npz")
+    addr = f"127.0.0.1:{server.port}"
+    r1 = request(addr, search_req(history, ckpt))
+    assert r1["ok"]
+
+    # simulate the in-process fallback evolving past the cached state
+    s = build_search_from_params(SEARCH_PARAMS)
+    s.load(ckpt)
+    refs = ingest_history(s, history, IngestParams(**INGEST_PARAMS))
+    s.run(refs, generations=6)
+    s.save(ckpt)
+    disk_gen = s.generations_run
+    assert disk_gen > r1["generations_run"]
+
+    r2 = request(addr, search_req(history, ckpt))
+    assert r2["ok"]
+    # reloaded from disk, then ran this request's 4 generations on top
+    assert r2["generations_run"] == disk_gen + 4
+
+
 def test_unknown_op_and_bad_storage(server):
     addr = f"127.0.0.1:{server.port}"
     assert not request(addr, {"op": "nope"})["ok"]
