@@ -168,7 +168,16 @@ def main() -> None:
         t0 = time.perf_counter()
         score_chain(pop.delays).block_until_ready()
         best_dt = min(best_dt, time.perf_counter() - t0)
-    device_rate = P * iters / best_dt  # schedules scored per second
+
+    # publish through the observability registry and read the reported
+    # figure back from it: the bench's JSON line and live telemetry
+    # (GET /metrics, nmz_scorer_schedules_per_sec) share one source of
+    # truth and can never disagree
+    from namazu_tpu import obs
+
+    obs.configure(True)  # the bench is a telemetry producer by definition
+    obs.scorer_throughput("bench", P * iters / best_dt)
+    device_rate = obs.scorer_throughput_value("bench")
 
     # numpy baseline on a small slice, per-schedule rate extrapolated
     nb = 64

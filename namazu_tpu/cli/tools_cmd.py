@@ -111,6 +111,20 @@ def register(sub) -> None:
     pv2.add_argument("--json-out", default="")
     pv2.set_defaults(func=ab_variance)
 
+    pm = tsub.add_parser(
+        "metrics",
+        help="dump an observability metrics registry as JSON "
+             "(doc/observability.md); a live orchestrator's metrics "
+             "need --url — without it the dump is THIS process's own "
+             "registry (embedded orchestrators, tests)",
+    )
+    pm.add_argument("--url", default="",
+                    help="scrape a running orchestrator's REST endpoint "
+                         "(e.g. http://127.0.0.1:10080); omit to dump "
+                         "this process's in-memory registry, which for "
+                         "a plain CLI invocation is empty")
+    pm.set_defaults(func=metrics_dump)
+
     pi = tsub.add_parser(
         "import-reference-trace",
         help="convert a reference-format experiment dir (per-action JSON "
@@ -121,6 +135,22 @@ def register(sub) -> None:
     pi.add_argument("source", help="reference experiment dir with %%08x runs")
     pi.add_argument("storage", help="storage dir to create (must not exist)")
     pi.set_defaults(func=import_reference_trace)
+
+
+def metrics_dump(args) -> int:
+    """One JSON document: the process-local registry, or a live
+    orchestrator's via its REST ``/metrics.json`` route."""
+    if args.url:
+        import urllib.request
+
+        url = args.url.rstrip("/") + "/metrics.json"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            print(json.dumps(json.loads(r.read()), sort_keys=True))
+        return 0
+    from namazu_tpu import obs
+
+    print(json.dumps(obs.registry_jsonable(), sort_keys=True))
+    return 0
 
 
 def import_reference_trace(args) -> int:

@@ -13,6 +13,7 @@ import queue
 import threading
 from typing import Dict, Optional
 
+from namazu_tpu import obs
 from namazu_tpu.signal.action import Action
 from namazu_tpu.signal.control import Control
 from namazu_tpu.signal.event import Event
@@ -76,6 +77,8 @@ class EndpointHub:
                 )
             self._entity_route[event.entity_id] = endpoint_name
         event.mark_arrived()
+        obs.mark(event, "intercepted")
+        obs.event_intercepted(endpoint_name, event.entity_id)
         self.event_queue.put(event)
 
     def post_control(self, control: Control) -> None:

@@ -24,6 +24,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib.parse import urlparse, parse_qs
 
+from namazu_tpu import obs
 from namazu_tpu.endpoint.hub import Endpoint
 from namazu_tpu.signal.action import Action
 from namazu_tpu.signal.base import SignalError, signal_from_jsonable
@@ -80,14 +81,15 @@ class ActionQueue:
                     return None
                 self._cond.wait(remaining)
 
-    def delete(self, uuid: str) -> bool:
+    def delete(self, uuid: str) -> Optional[Action]:
+        """Remove and return the action with ``uuid``, or None."""
         with self._cond:
             for i, a in enumerate(self._items):
                 if a.uuid == uuid:
                     del self._items[i]
                     self._cond.notify_all()
-                    return True
-            return False
+                    return a
+            return None
 
     def __len__(self) -> int:
         with self._cond:
@@ -126,8 +128,13 @@ class RestEndpoint(Endpoint):
 
             def _reply(self, code: int, body: Optional[dict] = None) -> None:
                 data = json.dumps(body).encode() if body is not None else b""
+                self._reply_raw(code, data, "application/json")
+
+            def _reply_raw(self, code: int, data: bytes,
+                           content_type: str) -> None:
+                obs.rest_request(self.command, code)
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 if data:
@@ -175,6 +182,13 @@ class RestEndpoint(Endpoint):
 
             def do_GET(self) -> None:
                 url = urlparse(self.path)
+                if url.path == "/metrics":
+                    # Prometheus text exposition of the process registry
+                    return self._reply_raw(
+                        200, obs.render_prometheus().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                if url.path == "/metrics.json":
+                    return self._reply(200, obs.registry_jsonable())
                 m = _ACTIONS_RE.match(url.path)
                 if not (m and m.group(2) is None):
                     return self._reply(404, {"error": f"no route {url.path}"})
@@ -190,7 +204,10 @@ class RestEndpoint(Endpoint):
                 if not (m and m.group(2)):
                     return self._reply(404, {"error": f"no route {url.path}"})
                 entity, uuid = m.group(1), m.group(2)
-                if endpoint._queue_for(entity).delete(uuid):
+                action = endpoint._queue_for(entity).delete(uuid)
+                if action is not None:
+                    obs.mark(action, "acked")
+                    obs.rest_ack(entity, obs.latency(action, "dispatched"))
                     self._reply(200, {})
                 else:
                     self._reply(404, {"error": f"no action {uuid} for {entity}"})

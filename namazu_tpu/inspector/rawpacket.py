@@ -86,9 +86,17 @@ def decode_ethernet(frame: bytes) -> Packet:
     # ethernet trailer padding after the IP datagram, and a payload
     # slice taken to the frame end would digest the padding — the same
     # protocol message would then hash into different replay-hint
-    # buckets depending on whether the capture path pads (ADVICE r4)
+    # buckets depending on whether the capture path pads (ADVICE r4).
+    # GSO/TSO captures are the exception: offloaded super-frames carry
+    # total_len == 0 (or a value smaller than the headers they visibly
+    # contain); such a length is unknown, not authoritative — fall back
+    # to the frame end so ports/seq/payload keep decoding
     (total_len,) = struct.unpack_from("!H", frame, off + 2)
-    end = min(len(frame), off + max(total_len, ihl))
+    min_l4 = 20 if proto == PROTO_TCP else 8 if proto == PROTO_UDP else 0
+    if total_len == 0 or total_len < ihl + min_l4:
+        end = len(frame)
+    else:
+        end = min(len(frame), off + max(total_len, ihl))
     src_ip = ".".join(str(b) for b in frame[off + 12:off + 16])
     dst_ip = ".".join(str(b) for b in frame[off + 16:off + 20])
     l4 = off + ihl

@@ -50,13 +50,18 @@ class PoolEntry(NamedTuple):
 
 
 def trace_digest(enc: EncodedTrace) -> str:
-    """Content digest of the masked trace — identity + times, padding
-    excluded so the same run hashes identically under different encode
-    lengths."""
+    """Content digest of the masked trace: the hint/entity SEQUENCE,
+    timing excluded. Absolute arrival timestamps differ on every run,
+    so a timing-sensitive digest counts failing RUNS, not failure
+    MODES — under it the novelty anneal's ``distinct_failure_signatures``
+    progress variable just mirrors run count and the anneal fires on
+    noise. Two runs that interleaved the same events in the same order
+    are one signature. Padding is excluded so the same run hashes
+    identically under different encode lengths."""
     m = enc.mask
     h = hashlib.sha256()
     h.update(enc.hint_ids[m].tobytes())
-    h.update(enc.arrival[m].tobytes())
+    h.update(enc.entity_ids[m].tobytes())
     return h.hexdigest()[:32]
 
 
@@ -112,18 +117,18 @@ def pool_load(pool_dir: str, H: int,
     for name in os.listdir(pool_dir):
         if not name.endswith(".npz"):
             continue
-        digest = name[:-4]
-        if digest in exclude:
+        if name[:-4] in exclude:  # fast path: current-format filenames
             continue
         path = os.path.join(pool_dir, name)
         try:
-            files.append((os.path.getmtime(path), digest, path))
+            files.append((os.path.getmtime(path), path))
         except OSError:
             continue
     files.sort(reverse=True)  # newest first
     entries: List[PoolEntry] = []
+    seen_digests: Set[str] = set()
     incompatible = 0
-    for _, digest, path in files:
+    for _, path in files:
         if len(entries) >= max_entries:
             break
         try:
@@ -136,10 +141,21 @@ def pool_load(pool_dir: str, H: int,
                 ents = z["entity_ids"]
                 mask = z["mask"]
                 fb = z["faultable"]
+                realized = EncodedTrace(ids, ents, z["released"], mask,
+                                        faultable=fb)
+                # digest recomputed from CONTENT, never trusted from the
+                # filename: entries written before a digest-format change
+                # keep their old names, and a filename digest would
+                # bypass every downstream dedupe keyed on the current
+                # format (duplicate surrogate positives, burned ring
+                # slots) — recomputing re-keys old pools transparently
+                digest = trace_digest(realized)
+                if digest in exclude or digest in seen_digests:
+                    continue
+                seen_digests.add(digest)
                 entries.append(PoolEntry(
                     digest=digest,
-                    realized=EncodedTrace(ids, ents, z["released"], mask,
-                                          faultable=fb),
+                    realized=realized,
                     arrival=EncodedTrace(ids, ents, z["arrival"], mask,
                                          faultable=fb),
                     seed=np.array(z["seed"]) if "seed" in z else None,

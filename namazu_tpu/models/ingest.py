@@ -167,6 +167,19 @@ def ingest_history(search, storage, p: IngestParams) -> List:
     seeds = seeds[::-1] + [e.seed for e in pooled if e.seed is not None]
     if seeds:
         search.seed_population(seeds[: p.max_seed_genomes])
+    for e in pooled:
+        # same treatment as an in-storage failure: archive embedding
+        # (novelty + surrogate positive) and failure-signature target —
+        # once per distinct signature (re-requests must not duplicate
+        # surrogate positives or evict diverse runs from the archive).
+        # Pooled entries go in FIRST: the failure archive is a ring, and
+        # adding them after the storage's own failures could wrap around
+        # and evict exactly the signatures most relevant to THIS
+        # experiment — the storage's own must always survive a full pool
+        if search.has_failure_signature(e.digest):
+            continue
+        search.add_executed_trace(e.realized, reproduced=True)
+        search.add_failure_trace(e.realized)
     failures, successes = [], []
     for enc, enc_rt, ok, _ in encoded:
         # "failure" = the run reproduced the bug (validate failed); the
@@ -177,15 +190,6 @@ def ingest_history(search, storage, p: IngestParams) -> List:
             failures.append(enc)
         else:
             successes.append(enc)
-    for e in pooled:
-        # same treatment as an in-storage failure: archive embedding
-        # (novelty + surrogate positive) and failure-signature target —
-        # once per distinct signature (re-requests must not duplicate
-        # surrogate positives or evict diverse runs from the archive)
-        if search.has_failure_signature(e.digest):
-            continue
-        search.add_executed_trace(e.realized, reproduced=True)
-        search.add_failure_trace(e.realized)
     if p.reference_mode == "envelope" and successes:
         return [te.envelope_trace(successes)]
     pool = successes if successes else failures

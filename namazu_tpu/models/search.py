@@ -10,10 +10,12 @@ the reference has no equivalent for (SURVEY.md section 5.4).
 from __future__ import annotations
 
 import os
+import time
 from typing import NamedTuple, Optional
 
 import numpy as np
 
+from namazu_tpu import obs
 from namazu_tpu.models.ga import GAConfig
 from namazu_tpu.ops import trace_encoding as te
 from namazu_tpu.ops.schedule import ScoreWeights
@@ -231,6 +233,19 @@ class SearchBase:
         into the novelty archive / surrogate training set."""
         return digest in self._failure_digest_set
 
+    def _record_progress(self, generations: int, elapsed: float,
+                         schedules_scored: int, best_fitness: float) -> None:
+        """Publish one run()'s worth of search telemetry (obs plane):
+        generations/sec, jitted-scorer schedules/s, best fitness, and the
+        archive occupancies — live counterparts of bench.py's metric."""
+        obs.search_round(
+            self.BACKEND, generations, elapsed,
+            schedules=schedules_scored, best_fitness=best_fitness,
+            archive_entries=min(self._archive_n, self.cfg.archive_size),
+            failure_entries=min(self._failure_n, self.cfg.failure_size),
+            distinct_failures=self.distinct_failure_signatures(),
+        )
+
     def labeled_archive(self):
         """(feats [N,K], labels [N]) of the populated archive slots whose
         outcome is known (NaN labels — pre-surrogate checkpoints — are
@@ -445,12 +460,17 @@ class ScheduleSearch(SearchBase):
         coin = None if self._coin is None else jnp.asarray(self._coin)
         nov_scale = jnp.asarray(self.novelty_scale(), jnp.float32)
         state = self._state
+        t0 = time.perf_counter()
         for _ in range(generations):
             state = self._step(state, self._key, trace, pairs, archive,
                                failures, coin, nov_scale)
         state.best_fitness.block_until_ready()
+        elapsed = time.perf_counter() - t0
         self._state = state
         self.generations_run += generations
+        self._record_progress(generations, elapsed,
+                              generations * self.population,
+                              float(state.best_fitness))
         picked = self._surrogate_pick(trace, pairs, archive, failures,
                                       nov_scale)
         return picked if picked is not None else self.best()
@@ -693,6 +713,7 @@ class MCTSSearch(SearchBase):
                  else jnp.asarray(self._seed_tables))
 
         searches = max(1, generations // 64)
+        t0 = time.perf_counter()
         for _ in range(searches):
             self._key, sub = jax.random.split(self._key)
             fit, d, f = self._run(sub, trace, pairs, archive, failures,
@@ -702,7 +723,12 @@ class MCTSSearch(SearchBase):
                 self._best_fitness = fit
                 self._best_delays = np.asarray(d)
                 self._best_faults = np.asarray(f)
-        self.generations_run += searches * self.mcts_cfg.simulations
+        elapsed = time.perf_counter() - t0
+        sims = searches * self.mcts_cfg.simulations
+        self.generations_run += sims
+        self._record_progress(sims, elapsed,
+                              sims * self.mcts_cfg.rollouts,
+                              self._best_fitness)
         return self.best()
 
     def best(self) -> BestSchedule:

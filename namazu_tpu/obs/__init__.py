@@ -1,0 +1,75 @@
+"""Observability plane: metrics registry + event-lifecycle spans.
+
+``namazu_tpu.obs`` is the one import the rest of the stack uses:
+
+* :mod:`namazu_tpu.obs.metrics` — thread-safe registry (counters,
+  gauges, fixed-bucket histograms), Prometheus text renderer, global
+  enable/disable with a shared no-op fallback;
+* :mod:`namazu_tpu.obs.spans` — lifecycle stamping (interception ->
+  decision -> dispatch -> ack) and the domain metric vocabulary.
+
+Exposure: ``GET /metrics`` (Prometheus text) and ``GET /metrics.json``
+on the REST endpoint (endpoint/rest.py), plus ``nmz-tpu tools metrics``
+(cli/tools_cmd.py). Disable with ``obs_enabled = false`` in the
+experiment config. Metric names and label conventions are documented in
+doc/observability.md.
+"""
+
+from __future__ import annotations
+
+from namazu_tpu.obs import metrics
+from namazu_tpu.obs.metrics import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    MetricError,
+    MetricsRegistry,
+    NullRegistry,
+    configure,
+    enabled,
+    get,
+    registry,
+    reset,
+    set_registry,
+)
+from namazu_tpu.obs.spans import (  # noqa: F401
+    action_dispatched,
+    carry,
+    event_intercepted,
+    latency,
+    mark,
+    policy_decision,
+    queue_dwell,
+    rest_ack,
+    rest_request,
+    sched_queue_depth,
+    sched_queue_wait,
+    schedule_install,
+    scorer_throughput,
+    scorer_throughput_value,
+    search_round,
+    sidecar_request,
+    span,
+)
+
+
+def configure_from_config(config) -> None:
+    """Apply the ``obs_enabled`` config key to the process-global flag
+    (called by the orchestrator before any endpoint starts).
+
+    Only an EXPLICIT key touches the flag: the switch is process-global
+    (default on), and in multi-orchestrator processes — the ab harness,
+    the test suite — a second orchestrator built from a default config
+    must not silently re-enable telemetry someone disabled (or freeze
+    the counters a live ``/metrics`` is serving)."""
+    if config.is_set("obs_enabled"):
+        metrics.configure(bool(config.get("obs_enabled")))
+
+
+def render_prometheus() -> str:
+    """Prometheus text of the default registry (the /metrics body)."""
+    return metrics.registry().render_prometheus()
+
+
+def registry_jsonable() -> dict:
+    """JSON form of the default registry (the /metrics.json body and
+    the ``nmz-tpu tools metrics`` dump)."""
+    return metrics.registry().to_jsonable()

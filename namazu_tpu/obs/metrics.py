@@ -1,0 +1,448 @@
+"""Thread-safe metrics registry: counters, gauges, fixed-bucket histograms.
+
+Stdlib only — no prometheus_client dependency (the container must not
+grow one). The model is deliberately the Prometheus one so the text
+exposition (:meth:`MetricsRegistry.render_prometheus`, served by
+``GET /metrics`` on the REST endpoint) scrapes with any standard
+collector:
+
+* a *family* = (name, kind, label names), registered get-or-create and
+  idempotent, so call sites never coordinate registration order;
+* a *child* = one (label values) sample inside a family, with its own
+  lock — concurrent increments from inspector/policy/search threads
+  never lose updates (the GIL does not make ``+=`` atomic);
+* histograms use fixed upper-bound buckets chosen at registration,
+  rendered cumulatively with the conventional ``+Inf`` terminal.
+
+Enable/disable is process-global (``configure``, read by the
+``obs_enabled`` config key via the orchestrator): when disabled,
+``get()`` hands back a :class:`NullRegistry` whose instruments are one
+shared no-op singleton, and every recording helper in
+``namazu_tpu/obs/spans.py`` bails on the first ``enabled()`` check — the
+per-event critical path pays one global read, nothing else.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import re
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "NullRegistry",
+    "MetricError", "DEFAULT_BUCKETS", "NOOP",
+    "configure", "enabled", "get", "registry", "set_registry", "reset",
+]
+
+#: latency buckets (seconds) tuned to the delays this system injects:
+#: sub-ms scheduling overheads up to the 100 ms-class fuzz intervals,
+#: with a coarse tail for stragglers.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+class MetricError(Exception):
+    """Registration conflict or invalid metric usage."""
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
+
+
+def _format_value(v: float) -> str:
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class Counter:
+    """Monotonically increasing sample."""
+
+    KIND = "counter"
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise MetricError("counters only go up")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def _samples(self, name: str, labelstr: str) -> Iterable[str]:
+        yield f"{name}{labelstr} {_format_value(self.value)}"
+
+    def _jsonable(self) -> Any:
+        return self.value
+
+
+class Gauge:
+    """Sample that can go both ways."""
+
+    KIND = "gauge"
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    _samples = Counter._samples
+    _jsonable = Counter._jsonable
+
+
+class Histogram:
+    """Fixed-bucket histogram; buckets are upper bounds in ascending
+    order, rendered cumulatively with the ``+Inf`` terminal bucket."""
+
+    KIND = "histogram"
+
+    def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        ups = tuple(sorted(float(b) for b in buckets))
+        if not ups:
+            raise MetricError("histogram needs at least one bucket")
+        self._uppers = ups
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(ups) + 1)  # +1 = the +Inf overflow
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        idx = bisect.bisect_left(self._uppers, v)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Cumulative bucket counts keyed by upper bound, plus sum/count."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        cum, acc = [], 0
+        for upper, c in zip(self._uppers, counts):
+            acc += c
+            cum.append((upper, acc))
+        return {"buckets": cum, "sum": s, "count": total}
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def _samples(self, name: str, labelstr: str) -> Iterable[str]:
+        snap = self.snapshot()
+        base = labelstr[1:-1] if labelstr else ""  # strip { }
+        for upper, cum in snap["buckets"]:
+            sep = "," if base else ""
+            yield (f'{name}_bucket{{{base}{sep}le="{_format_value(upper)}"}}'
+                   f" {cum}")
+        sep = "," if base else ""
+        yield f'{name}_bucket{{{base}{sep}le="+Inf"}} {snap["count"]}'
+        yield f"{name}_sum{labelstr} {_format_value(snap['sum'])}"
+        yield f"{name}_count{labelstr} {snap['count']}"
+
+    def _jsonable(self) -> Any:
+        snap = self.snapshot()
+        return {
+            "buckets": [[_format_value(u), c] for u, c in snap["buckets"]],
+            "sum": snap["sum"],
+            "count": snap["count"],
+        }
+
+
+class _Family:
+    """One named metric with a fixed label-name set; children are the
+    per-label-value samples."""
+
+    def __init__(self, cls, name: str, help: str,
+                 labelnames: Tuple[str, ...], **child_kw):
+        self.cls = cls
+        self.name = name
+        self.help = help
+        self.labelnames = labelnames
+        self._child_kw = child_kw
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], Any] = {}
+
+    def labels(self, **kw):
+        if set(kw) != set(self.labelnames):
+            raise MetricError(
+                f"{self.name}: labels {sorted(kw)} != declared "
+                f"{sorted(self.labelnames)}")
+        key = tuple(str(kw[n]) for n in self.labelnames)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.get(key)
+                if child is None:
+                    child = self._children[key] = self.cls(**self._child_kw)
+        return child
+
+    # unlabeled convenience: family IS its single child
+    def _default(self):
+        if self.labelnames:
+            raise MetricError(f"{self.name} declares labels "
+                              f"{self.labelnames}; use .labels(...)")
+        return self.labels()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default().dec(amount)
+
+    def set(self, value: float) -> None:
+        self._default().set(value)
+
+    def observe(self, value: float) -> None:
+        self._default().observe(value)
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+    def _items(self) -> List[Tuple[Tuple[str, ...], Any]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+    def render(self) -> Iterable[str]:
+        if self.help:
+            yield f"# HELP {self.name} {self.help}"
+        else:
+            yield f"# HELP {self.name}"
+        yield f"# TYPE {self.name} {self.cls.KIND}"
+        for key, child in self._items():
+            if key:
+                pairs = ",".join(
+                    f'{n}="{_escape_label_value(v)}"'
+                    for n, v in zip(self.labelnames, key))
+                labelstr = "{" + pairs + "}"
+            else:
+                labelstr = ""
+            yield from child._samples(self.name, labelstr)
+
+    def jsonable(self) -> Dict[str, Any]:
+        samples = []
+        for key, child in self._items():
+            samples.append({
+                "labels": dict(zip(self.labelnames, key)),
+                "value": child._jsonable(),
+            })
+        return {
+            "name": self.name,
+            "type": self.cls.KIND,
+            "help": self.help,
+            "samples": samples,
+        }
+
+
+class MetricsRegistry:
+    """Name -> family table; all accessors are get-or-create and
+    idempotent so concurrent first-use from any thread is safe."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    def _get(self, cls, name: str, help: str,
+             labelnames: Iterable[str], **child_kw) -> _Family:
+        names = tuple(labelnames)
+        fam = self._families.get(name)
+        if fam is None:
+            # name/label validation only on the creation path: call
+            # sites re-fetch families per event, and re-matching two
+            # regexes per recording would tax exactly the hot path the
+            # module header promises is cheap
+            if not _NAME_RE.match(name):
+                raise MetricError(f"bad metric name {name!r}")
+            for n in names:
+                if not _LABEL_RE.match(n):
+                    raise MetricError(f"bad label name {n!r}")
+            with self._lock:
+                fam = self._families.get(name)
+                if fam is None:
+                    fam = self._families[name] = _Family(
+                        cls, name, help, names, **child_kw)
+        if fam.cls is not cls or fam.labelnames != names:
+            raise MetricError(
+                f"{name} already registered as {fam.cls.KIND} with labels "
+                f"{fam.labelnames}; got {cls.KIND} with {names}")
+        return fam
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Iterable[str] = ()) -> _Family:
+        return self._get(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Iterable[str] = ()) -> _Family:
+        return self._get(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Iterable[str] = (),
+                  buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> _Family:
+        return self._get(Histogram, name, help, labelnames, buckets=buckets)
+
+    # -- read side -------------------------------------------------------
+
+    def sample(self, name: str, **labels):
+        """The live child instrument for one (name, label values), or
+        None when it does not exist (read-only: never creates)."""
+        fam = self._families.get(name)
+        if fam is None:
+            return None
+        key = tuple(str(labels[n]) for n in fam.labelnames
+                    if n in labels)
+        if len(key) != len(fam.labelnames):
+            return None
+        return fam._children.get(key)
+
+    def value(self, name: str, **labels) -> Optional[float]:
+        """Current value of one counter/gauge sample (histograms have no
+        scalar value; use :meth:`sample` and its ``count``/``sum``)."""
+        child = self.sample(name, **labels)
+        return None if child is None else getattr(child, "value", None)
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: List[str] = []
+        with self._lock:
+            fams = sorted(self._families.values(), key=lambda f: f.name)
+        for fam in fams:
+            lines.extend(fam.render())
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def to_jsonable(self) -> Dict[str, Any]:
+        with self._lock:
+            fams = sorted(self._families.values(), key=lambda f: f.name)
+        return {"metrics": [f.jsonable() for f in fams]}
+
+    def dump_json(self) -> str:
+        return json.dumps(self.to_jsonable(), sort_keys=True)
+
+
+class _Noop:
+    """Shared do-nothing instrument: every method does nothing and
+    ``labels`` returns the same singleton — the disabled path allocates
+    nothing per call."""
+
+    def labels(self, **kw):
+        return self
+
+    def inc(self, amount: float = 1.0) -> None:
+        pass
+
+    dec = inc
+    set = inc
+    observe = inc
+
+    value = 0.0
+    count = 0
+    sum = 0.0
+
+
+NOOP = _Noop()
+
+
+class NullRegistry:
+    """What ``get()`` returns while observability is disabled: every
+    instrument accessor hands back the shared :data:`NOOP`."""
+
+    def counter(self, *a, **kw) -> _Noop:
+        return NOOP
+
+    gauge = counter
+    histogram = counter
+
+    def sample(self, name: str, **labels) -> None:
+        return None
+
+    def value(self, name: str, **labels) -> Optional[float]:
+        return None
+
+    def render_prometheus(self) -> str:
+        return ""
+
+    def to_jsonable(self) -> Dict[str, Any]:
+        return {"metrics": []}
+
+    def dump_json(self) -> str:
+        return json.dumps(self.to_jsonable(), sort_keys=True)
+
+
+_NULL = NullRegistry()
+_enabled = True
+_registry = MetricsRegistry()
+
+
+def configure(on: bool) -> None:
+    """Process-global switch (the ``obs_enabled`` config key lands
+    here via the orchestrator). Disabling hides the registry from
+    ``get()``; existing samples are kept, not cleared."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def get():
+    """The default registry when enabled, the shared no-op otherwise —
+    the one call every recording site routes through."""
+    return _registry if _enabled else _NULL
+
+
+def registry() -> MetricsRegistry:
+    """The real default registry regardless of the enabled flag (the
+    /metrics handler renders it even mid-toggle)."""
+    return _registry
+
+
+def set_registry(r: MetricsRegistry) -> MetricsRegistry:
+    """Swap the process-global registry (tests); returns the old one."""
+    global _registry
+    old, _registry = _registry, r
+    return old
+
+
+def reset() -> None:
+    """Fresh empty default registry (tests)."""
+    set_registry(MetricsRegistry())

@@ -1,0 +1,299 @@
+"""Event-lifecycle spans + the domain metric vocabulary.
+
+One home for every metric name the system emits (the schema
+``doc/observability.md`` documents), and the span-stamping helpers that
+thread an event's lifecycle through the stack:
+
+====================  =====================================================
+span                  stamped by
+====================  =====================================================
+``intercepted``       EndpointHub.post_event — the moment an inspector's
+                      event enters the orchestrator process
+``enqueued``          Orchestrator._event_loop — handed to the active
+                      policy (queue-dwell starts here)
+``decided``           Orchestrator._event_loop — queue_event returned,
+                      i.e. the policy chose this event's delay/priority
+``dispatched``        Orchestrator._action_loop — the answering action
+                      left for its endpoint (or ran orchestrator-side)
+``acked``             RestEndpoint DELETE — the inspector acknowledged
+                      the action over the wire
+====================  =====================================================
+
+Spans are monotonic-clock floats stored in a per-signal dict
+(``sig._obs_spans``); :func:`carry` copies them from the cause event onto
+its answering action (signal/action.py ``Action.for_event``) so latencies
+survive the event->action hand-off. Every helper here starts with the
+``metrics.enabled()`` check — the disabled per-event cost is one global
+read and a function call, nothing else (the micro-assert in
+tests/test_obs.py pins this down).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from namazu_tpu.obs import metrics
+
+SPANS_ATTR = "_obs_spans"
+
+# -- metric name schema (see doc/observability.md) ----------------------
+
+EVENTS_INTERCEPTED = "nmz_events_intercepted_total"
+QUEUE_DWELL = "nmz_event_queue_dwell_seconds"
+POLICY_DECISIONS = "nmz_policy_decisions_total"
+DECISION_LATENCY = "nmz_policy_decision_latency_seconds"
+ACTIONS_DISPATCHED = "nmz_actions_dispatched_total"
+EVENT_E2E = "nmz_event_e2e_seconds"
+REST_REQUESTS = "nmz_rest_requests_total"
+REST_ACKS = "nmz_rest_acks_total"
+REST_ACK_LATENCY = "nmz_rest_ack_latency_seconds"
+SCHED_QUEUE_DEPTH = "nmz_sched_queue_depth"
+SCHED_QUEUE_WAIT = "nmz_sched_queue_wait_seconds"
+SEARCH_GENERATIONS = "nmz_search_generations_total"
+SEARCH_GEN_RATE = "nmz_search_generations_per_sec"
+SEARCH_BEST_FITNESS = "nmz_search_best_fitness"
+SEARCH_ARCHIVE = "nmz_search_archive_entries"
+SEARCH_INSTALLS = "nmz_search_installs_total"
+SCORER_THROUGHPUT = "nmz_scorer_schedules_per_sec"
+SIDECAR_REQUESTS = "nmz_sidecar_requests_total"
+
+
+#: distinct ``entity`` label values admitted per registry before new
+#: entities fold into "_other" — inspectors can mint an entity per
+#: observed process/connection, and unbounded label cardinality would
+#: grow the registry (and every /metrics scrape) without limit over a
+#: long experiment
+MAX_ENTITY_LABELS = 64
+
+_entity_lock = threading.Lock()
+
+
+def _entity_label(reg, entity: str) -> str:
+    # locked: hub/orchestrator/policy/REST threads all admit entities
+    # concurrently, and a racy lazy-init or check-then-add would split
+    # one entity's samples across its own series and "_other"
+    with _entity_lock:
+        seen = getattr(reg, "_obs_entity_labels", None)
+        if seen is None:
+            seen = reg._obs_entity_labels = set()
+        if entity in seen:
+            return entity
+        if len(seen) >= MAX_ENTITY_LABELS:
+            return "_other"
+        seen.add(entity)
+        return entity
+
+
+# -- span stamping ------------------------------------------------------
+
+def mark(sig, name: str, now: Optional[float] = None) -> None:
+    """Stamp ``sig`` with the monotonic time of lifecycle point ``name``."""
+    if not metrics.enabled():
+        return
+    spans = getattr(sig, SPANS_ATTR, None)
+    if spans is None:
+        spans = {}
+        setattr(sig, SPANS_ATTR, spans)
+    spans[name] = time.monotonic() if now is None else now
+
+
+def span(sig, name: str) -> Optional[float]:
+    spans = getattr(sig, SPANS_ATTR, None)
+    return spans.get(name) if spans else None
+
+
+def latency(sig, since: str, now: Optional[float] = None) -> Optional[float]:
+    """Seconds elapsed since span ``since`` was stamped, or None."""
+    t0 = span(sig, since)
+    if t0 is None:
+        return None
+    return (time.monotonic() if now is None else now) - t0
+
+
+def carry(dst, src) -> None:
+    """Attach the cause event's span dict to its answering action.
+
+    The dict is SHARED, not copied: the orchestrator's event loop may
+    still be stamping ``decided`` while a zero-delay dequeue is already
+    constructing the action on another thread — sharing makes every
+    stamp visible on both signals regardless of that race (dict access
+    is GIL-atomic)."""
+    if not metrics.enabled():
+        return
+    spans = getattr(src, SPANS_ATTR, None)
+    if spans is not None:
+        setattr(dst, SPANS_ATTR, spans)
+
+
+# -- recording helpers (control plane) ----------------------------------
+
+def event_intercepted(endpoint: str, entity: str) -> None:
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.counter(
+        EVENTS_INTERCEPTED,
+        "events entering the orchestrator, by transport endpoint",
+        ("endpoint", "entity"),
+    ).labels(endpoint=endpoint, entity=_entity_label(reg, entity)).inc()
+
+
+def policy_decision(policy: str, entity: str,
+                    decision_latency: Optional[float]) -> None:
+    """One policy decision (delay/priority chosen for an event)."""
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.counter(
+        POLICY_DECISIONS,
+        "events a policy decided a schedule for",
+        ("policy", "entity"),
+    ).labels(policy=policy, entity=_entity_label(reg, entity)).inc()
+    if decision_latency is not None:
+        reg.histogram(
+            DECISION_LATENCY,
+            "interception -> policy decision (hub queue + queue_event)",
+            ("policy",),
+        ).labels(policy=policy).observe(decision_latency)
+
+
+def queue_dwell(policy: str, entity: str,
+                seconds: Optional[float]) -> None:
+    """How long an event sat in the policy's delay queue (the injected
+    fuzz delay plus scheduling overhead)."""
+    if seconds is None or not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.histogram(
+        QUEUE_DWELL,
+        "policy enqueue -> release (injected delay + overhead)",
+        ("policy", "entity"),
+    ).labels(policy=policy,
+             entity=_entity_label(reg, entity)).observe(seconds)
+
+
+def action_dispatched(kind: str, e2e: Optional[float]) -> None:
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.counter(
+        ACTIONS_DISPATCHED,
+        "actions leaving the orchestrator action loop",
+        ("kind",),
+    ).labels(kind=kind).inc()
+    if e2e is not None:
+        reg.histogram(
+            EVENT_E2E,
+            "interception -> action dispatch, end to end",
+        ).observe(e2e)
+
+
+def rest_request(method: str, code: int) -> None:
+    if not metrics.enabled():
+        return
+    metrics.get().counter(
+        REST_REQUESTS, "REST endpoint requests", ("method", "code"),
+    ).labels(method=method, code=str(code)).inc()
+
+
+def rest_ack(entity: str, ack_latency: Optional[float]) -> None:
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.counter(
+        REST_ACKS, "actions acknowledged over REST", ("entity",),
+    ).labels(entity=_entity_label(reg, entity)).inc()
+    if ack_latency is not None:
+        reg.histogram(
+            REST_ACK_LATENCY,
+            "action dispatch -> REST DELETE acknowledgment",
+        ).observe(ack_latency)
+
+
+def sched_queue_depth(queue: str, depth: int) -> None:
+    if not metrics.enabled():
+        return
+    metrics.get().gauge(
+        SCHED_QUEUE_DEPTH, "items pending in a ScheduledQueue", ("queue",),
+    ).labels(queue=queue).set(depth)
+
+
+def sched_queue_wait(queue: str, seconds: float) -> None:
+    if not metrics.enabled():
+        return
+    metrics.get().histogram(
+        SCHED_QUEUE_WAIT,
+        "realized put -> get delay inside a ScheduledQueue",
+        ("queue",),
+    ).labels(queue=queue).observe(seconds)
+
+
+# -- recording helpers (search plane) -----------------------------------
+
+def search_round(backend: str, generations: int, elapsed: float,
+                 schedules: float, best_fitness: float,
+                 archive_entries: int, failure_entries: int,
+                 distinct_failures: int) -> None:
+    """One search.run() call's worth of progress."""
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.counter(
+        SEARCH_GENERATIONS, "GA generations (or MCTS simulations) run",
+        ("backend",),
+    ).labels(backend=backend).inc(generations)
+    if elapsed > 0:
+        reg.gauge(
+            SEARCH_GEN_RATE, "generations/sec of the last search round",
+            ("backend",),
+        ).labels(backend=backend).set(generations / elapsed)
+        reg.gauge(
+            SCORER_THROUGHPUT,
+            "schedules scored per second by the jitted scorer",
+            ("source",),
+        ).labels(source=backend).set(schedules / elapsed)
+    reg.gauge(
+        SEARCH_BEST_FITNESS, "best fitness seen so far", ("backend",),
+    ).labels(backend=backend).set(best_fitness)
+    arch = reg.gauge(
+        SEARCH_ARCHIVE, "archive ring occupancy", ("backend", "archive"),
+    )
+    arch.labels(backend=backend, archive="novelty").set(archive_entries)
+    arch.labels(backend=backend, archive="failure").set(failure_entries)
+    arch.labels(backend=backend,
+                archive="failure_distinct").set(distinct_failures)
+
+
+def schedule_install(source: str) -> None:
+    """A delay/fault table was installed on the policy hot path."""
+    if not metrics.enabled():
+        return
+    metrics.get().counter(
+        SEARCH_INSTALLS, "delay-table installs on the policy", ("source",),
+    ).labels(source=source).inc()
+
+
+def scorer_throughput(source: str, rate: float) -> None:
+    """Jitted-scorer throughput sample (bench.py and the search plane
+    publish through the same gauge so they can never disagree)."""
+    if not metrics.enabled():
+        return
+    metrics.get().gauge(
+        SCORER_THROUGHPUT,
+        "schedules scored per second by the jitted scorer",
+        ("source",),
+    ).labels(source=source).set(rate)
+
+
+def scorer_throughput_value(source: str) -> Optional[float]:
+    return metrics.registry().value(SCORER_THROUGHPUT, source=source)
+
+
+def sidecar_request(op: str, ok: bool) -> None:
+    if not metrics.enabled():
+        return
+    metrics.get().counter(
+        SIDECAR_REQUESTS, "search sidecar requests", ("op", "ok"),
+    ).labels(op=op, ok=str(bool(ok)).lower()).inc()

@@ -24,6 +24,7 @@ import queue
 import threading
 from typing import Optional
 
+from namazu_tpu import obs
 from namazu_tpu.endpoint.hub import EndpointHub
 from namazu_tpu.endpoint.local import LocalEndpoint
 from namazu_tpu.policy.base import POLICY_DONE, ExplorePolicy, create_policy
@@ -48,6 +49,7 @@ class Orchestrator:
         hub: Optional[EndpointHub] = None,
     ):
         self.config = config
+        obs.configure_from_config(config)
         self.policy = policy
         self.collect_trace = collect_trace
         self.trace = SingleTrace()
@@ -146,10 +148,17 @@ class Orchestrator:
             if ev is _STOP:
                 return
             target = self.policy if self.enabled else self.dumb
+            obs.mark(ev, "enqueued")
             try:
                 target.queue_event(ev)
             except Exception:
                 log.exception("policy %s rejected event %r", target.name, ev)
+            else:
+                # queue_event returning means the policy chose this
+                # event's delay/priority — the decision point
+                obs.mark(ev, "decided")
+                obs.policy_decision(target.name, ev.entity_id,
+                                    obs.latency(ev, "intercepted"))
 
     def _forward_loop_factory(self, policy: ExplorePolicy):
         def loop() -> None:
@@ -173,6 +182,11 @@ class Orchestrator:
                 continue
             action: Action = item  # type: ignore[assignment]
             action.mark_triggered()
+            obs.mark(action, "dispatched")
+            obs.action_dispatched(
+                "orchestrator" if action.orchestrator_side_only
+                else "forwarded",
+                obs.latency(action, "intercepted"))
             if self.collect_trace:
                 self.trace.append(action)
             if action.orchestrator_side_only:

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from namazu_tpu.models.ga import GAConfig, Population, ga_generation, init_population
+from namazu_tpu.parallel.mesh import shard_map as compat_shard_map
 from namazu_tpu.ops.schedule import (
     ScoreWeights,
     TraceArrays,
@@ -154,14 +155,14 @@ def make_multiaxis_island_step(
             P(),  # novelty anneal scale (replicated scalar)
         )
 
-    sharded_fault = jax.shard_map(
+    sharded_fault = compat_shard_map(
         _local_step,
         mesh=mesh,
         in_specs=base_specs(fault_trace_spec) + (P(),),  # + fault coin
         out_specs=(pop_spec, P(), P(), P()),
         check_vma=False,
     )
-    sharded_nofault = jax.shard_map(
+    sharded_nofault = compat_shard_map(
         _local_step,
         mesh=mesh,
         in_specs=base_specs(nofault_trace_spec),

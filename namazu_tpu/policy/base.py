@@ -6,6 +6,7 @@ import queue
 import threading
 from typing import Callable, Dict, Iterable, Optional, TYPE_CHECKING
 
+from namazu_tpu import obs
 from namazu_tpu.signal.action import Action
 from namazu_tpu.signal.event import Event
 from namazu_tpu.utils.sched_queue import QueueClosed, ScheduledQueue
@@ -90,7 +91,7 @@ class QueueBackedPolicy(ExplorePolicy):
 
     def __init__(self, seed: Optional[int] = None) -> None:
         super().__init__()
-        self._queue = ScheduledQueue(seed=seed)
+        self._queue = ScheduledQueue(seed=seed, obs_name=self.name)
         self._started = False
         self._start_lock = threading.Lock()
         self._dequeue_thread: Optional[threading.Thread] = None
@@ -108,6 +109,8 @@ class QueueBackedPolicy(ExplorePolicy):
                 event = self._queue.get()
             except QueueClosed:
                 return
+            obs.queue_dwell(self.name, event.entity_id,
+                            obs.latency(event, "enqueued"))
             self._emit(self._action_for(event))
 
     def _action_for(self, event: Event) -> Action:

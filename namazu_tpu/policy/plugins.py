@@ -17,6 +17,7 @@ The reference-style flow still works too — a plugin file with a
 
 from __future__ import annotations
 
+import hashlib
 import importlib
 import importlib.util
 import os
@@ -27,11 +28,21 @@ from namazu_tpu.utils.log import get_logger
 
 log = get_logger("policy.plugins")
 
-#: absolute paths already executed — loads are idempotent so that
-#: multiple ``run`` invocations inside one process (the ab harness, the
-#: test suite) don't re-execute module bodies and trip the registry's
-#: duplicate-name guard
+#: plugins already executed, keyed by CONTENT digest (basename +
+#: sha256 of the file, or the module path) — loads are idempotent so
+#: that multiple ``run`` invocations inside one process (the ab
+#: harness, the test suite) don't re-execute module bodies and trip the
+#: registry's duplicate-name guard. Content keying matters because
+#: ``init`` copies the plugin into every storage's materials dir: the
+#: same plugin loaded from two storages is one plugin, not a duplicate
+#: registration
 _LOADED: set = set()
+
+
+def _plugin_digest(path: str) -> str:
+    with open(path, "rb") as f:
+        sha = hashlib.sha256(f.read()).hexdigest()
+    return f"{os.path.basename(path)}:{sha}"
 
 
 def load_policy_plugins(cfg, materials_dir: Optional[str] = None) -> None:
@@ -54,15 +65,19 @@ def load_policy_plugins(cfg, materials_dir: Optional[str] = None) -> None:
                 if os.path.exists(cand):
                     path = cand
             path = os.path.abspath(path)
-            if path in _LOADED:
-                continue
             if not os.path.exists(path):
                 raise FileNotFoundError(
                     f"policy plugin {spec!r} not found (looked at "
                     f"{path}; relative paths resolve against the "
                     "materials dir)")
+            digest = _plugin_digest(path)
+            if digest in _LOADED:
+                continue
+            # content-suffixed module name: two DIFFERENT plugins sharing
+            # a basename must not evict each other from sys.modules
             name = ("nmz_policy_plugin_"
-                    + os.path.splitext(os.path.basename(path))[0])
+                    + os.path.splitext(os.path.basename(path))[0]
+                    + "_" + digest.rsplit(":", 1)[1][:12])
             loader_spec = importlib.util.spec_from_file_location(name, path)
             module = importlib.util.module_from_spec(loader_spec)
             # registered in sys.modules BEFORE exec so dataclasses,
@@ -73,7 +88,7 @@ def load_policy_plugins(cfg, materials_dir: Optional[str] = None) -> None:
             except BaseException:
                 sys.modules.pop(name, None)
                 raise
-            _LOADED.add(path)
+            _LOADED.add(digest)
             log.info("loaded policy plugin %s", path)
         else:
             if spec in _LOADED:

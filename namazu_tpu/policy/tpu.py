@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Optional
 
+from namazu_tpu import obs
 from namazu_tpu.policy.base import QueueBackedPolicy, register_policy
 from namazu_tpu.policy.replayable import fnv64a, hint_delay
 from namazu_tpu.signal.action import ProcSetSchedAction
@@ -374,6 +375,8 @@ class TPUSearchPolicy(QueueBackedPolicy):
             # cannot outlive the join window and lose its tail
             if i and gap > 0 and not self._stop_reorder.is_set():
                 time.sleep(gap)
+            obs.queue_dwell(self.name, event.entity_id,
+                            obs.latency(event, "enqueued"))
             self._emit(self._action_for(event))
 
     def _reorder_loop(self) -> None:
@@ -561,6 +564,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
             return False
         self._delays = delays
         self._faults = faults
+        obs.schedule_install("checkpoint")
         log.info("installed checkpointed schedule (fitness %.4f) from %s",
                  fit, ckpt)
         return True
@@ -632,6 +636,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
                 if _np.isfinite(b.fitness):
                     self._delays = b.delays
                     self._faults = b.faults
+                    obs.schedule_install("checkpoint")
                     log.info(
                         "installed checkpointed schedule (fitness %.4f) "
                         "before this run's search", b.fitness)
@@ -642,6 +647,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
             best = search.run(references, generations=self.generations)
             self._delays = best.delays
             self._faults = best.faults
+            obs.schedule_install("search")
             log.info("installed searched schedule (fitness %.4f, gen %d)",
                      best.fitness, search.generations_run)
             if ckpt:
@@ -715,6 +721,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
             return
         self._delays = _np.asarray(resp["delays"], _np.float32)
         self._faults = _np.asarray(resp["faults"], _np.float32)
+        obs.schedule_install("sidecar")
         log.info("installed sidecar schedule (fitness %.4f, gen %d)",
                  resp["fitness"], resp["generations_run"])
 

@@ -42,6 +42,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from namazu_tpu import obs
 from namazu_tpu.endpoint.agent import read_frame, write_frame
 from namazu_tpu.storage import load_storage
 from namazu_tpu.utils.log import get_logger
@@ -115,10 +116,13 @@ class SearchService:
     def handle(self, req: dict) -> dict:
         op = req.get("op")
         if op == "ping":
-            return {"ok": True, "searches": len(self._searches)}
-        if op == "search":
-            return self._search(req)
-        return {"ok": False, "error": f"unknown op {op!r}"}
+            resp = {"ok": True, "searches": len(self._searches)}
+        elif op == "search":
+            resp = self._search(req)
+        else:
+            resp = {"ok": False, "error": f"unknown op {op!r}"}
+        obs.sidecar_request(str(op), bool(resp.get("ok")))
+        return resp
 
     def _get_search(self, key: str, params: dict, checkpoint: str):
         fp = json.dumps(params, sort_keys=True)

@@ -19,6 +19,7 @@ import subprocess
 import time
 from typing import Any, Dict, Optional
 
+from namazu_tpu.obs import spans as obs_spans
 from namazu_tpu.signal.base import Signal, SignalType, signal_class
 from namazu_tpu.signal.event import Event
 
@@ -64,7 +65,7 @@ class Action(Signal):
     @classmethod
     def for_event(cls, event: Event, option: Optional[Dict[str, Any]] = None) -> "Action":
         """Construct an action answering ``event``."""
-        return cls(
+        action = cls(
             entity_id=event.entity_id,
             option=option,
             event_uuid=event.uuid,
@@ -72,6 +73,10 @@ class Action(Signal):
             event_hint=event.replay_hint(),
             event_arrived=event.arrived,
         )
+        # lifecycle spans survive the event -> action hand-off so the
+        # dispatch/ack stages can report end-to-end latencies
+        obs_spans.carry(action, event)
+        return action
 
     def mark_triggered(self, now: Optional[float] = None) -> None:
         self.triggered_time = time.time() if now is None else now

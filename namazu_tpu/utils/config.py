@@ -15,7 +15,11 @@ from __future__ import annotations
 
 import json
 import re
-import tomllib
+
+try:
+    import tomllib
+except ImportError:  # Python < 3.11: tomli is the same parser/API
+    import tomli as tomllib  # type: ignore[no-redef]
 from typing import Any, Dict, Optional
 
 DEFAULTS: Dict[str, Any] = {
@@ -41,6 +45,10 @@ DEFAULTS: Dict[str, Any] = {
     "agent_port": -1,  # framed-TCP guest-agent endpoint (reference: pbPort)
     # do not start the exploration policy until REST /control enables it
     "skip_init_orchestration": False,
+    # observability plane (namazu_tpu/obs): event-lifecycle spans,
+    # metrics registry, GET /metrics on the REST endpoint. Disabling
+    # reduces the per-event hot path to one flag check (obs/metrics.py)
+    "obs_enabled": True,
     # container mode
     "container": {},
 }
@@ -111,6 +119,14 @@ class Config:
             return self._lookup(DEFAULTS, path)
         except KeyError:
             return default
+
+    def is_set(self, path: str) -> bool:
+        """Whether ``path`` was given explicitly (not just a DEFAULT)."""
+        try:
+            self._lookup(self._data, path)
+            return True
+        except KeyError:
+            return False
 
     def set(self, path: str, value: Any) -> None:
         segs = path.split(".")

@@ -33,19 +33,27 @@ import threading
 import time
 from typing import Any, Optional, Tuple
 
+from namazu_tpu import obs
+
 
 class QueueClosed(Exception):
     """Raised by get() once the queue is closed and drained."""
 
 
 class ScheduledQueue:
-    def __init__(self, seed: Optional[int] = None, time_scale: float = 1.0):
-        """``time_scale`` < 1 compresses all delays (useful in tests)."""
+    def __init__(self, seed: Optional[int] = None, time_scale: float = 1.0,
+                 obs_name: str = ""):
+        """``time_scale`` < 1 compresses all delays (useful in tests).
+        ``obs_name`` labels this queue's depth gauge and realized-wait
+        histogram in the metrics registry ("" = uninstrumented)."""
         self._rng = random.Random(seed)
         self._time_scale = float(time_scale)
+        self._obs_name = obs_name
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
-        self._heap: list[Tuple[float, int, Any]] = []
+        # (release_time, seq, put_time, item); the unique seq tiebreak
+        # means comparisons never reach put_time/item
+        self._heap: list[Tuple[float, int, float, Any]] = []
         self._seq = itertools.count()
         self._closed = False
 
@@ -58,12 +66,17 @@ class ScheduledQueue:
             delay = min_delay
         else:
             delay = self._rng.uniform(min_delay, max_delay)
-        release = time.monotonic() + delay * self._time_scale
+        now = time.monotonic()
+        release = now + delay * self._time_scale
         with self._cond:
             if self._closed:
                 raise QueueClosed
-            heapq.heappush(self._heap, (release, next(self._seq), item))
+            heapq.heappush(self._heap, (release, next(self._seq), now, item))
             self._cond.notify()
+            if self._obs_name:
+                # published under _cond, like get()'s: an unlocked
+                # publish could overwrite a newer depth with a stale one
+                obs.sched_queue_depth(self._obs_name, len(self._heap))
 
     def put_at(self, item: Any, delay: float) -> None:
         """Enqueue with an exact delay (used by deterministic replay)."""
@@ -82,7 +95,14 @@ class ScheduledQueue:
                 if self._heap:
                     release = self._heap[0][0]
                     if release <= now:
-                        return heapq.heappop(self._heap)[2]
+                        _, _, put_ts, item = heapq.heappop(self._heap)
+                        if self._obs_name:
+                            # metric locks are leaves; safe under _cond
+                            obs.sched_queue_depth(self._obs_name,
+                                                  len(self._heap))
+                            obs.sched_queue_wait(self._obs_name,
+                                                 now - put_ts)
+                        return item
                     wait = release - now
                 elif self._closed:
                     raise QueueClosed
@@ -108,7 +128,8 @@ class ScheduledQueue:
         with self._cond:
             self._closed = True
             if immediate and self._heap:
-                self._heap = [(0.0, seq, item) for (_, seq, item) in self._heap]
+                self._heap = [(0.0, seq, put_ts, item)
+                              for (_, seq, put_ts, item) in self._heap]
                 heapq.heapify(self._heap)
             self._cond.notify_all()
 

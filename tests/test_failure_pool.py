@@ -51,6 +51,26 @@ def test_digest_ignores_padding():
     assert trace_digest(a) != trace_digest(_enc(1))
 
 
+def test_digest_is_timing_invariant():
+    """Two runs that interleaved the same events in the same order are
+    ONE failure mode: absolute arrival timestamps differ every run, so
+    a timing-sensitive digest would count failing runs, not distinct
+    signatures — and the novelty anneal would anneal on noise."""
+    a = _enc(0)
+    shifted = te.EncodedTrace(
+        a.hint_ids, a.entity_ids,
+        a.arrival + 123.456,  # same interleaving, another wall-clock
+        a.mask,
+    )
+    assert trace_digest(a) == trace_digest(shifted)
+    # but a different event SEQUENCE is a different signature
+    reordered = te.EncodedTrace(
+        a.hint_ids[::-1].copy(), a.entity_ids[::-1].copy(),
+        a.arrival, a.mask,
+    )
+    assert trace_digest(a) != trace_digest(reordered)
+
+
 def test_pool_roundtrip_and_idempotence(tmp_path):
     pool = str(tmp_path / "pool")
     enc = _enc(0)
@@ -67,6 +87,32 @@ def test_pool_roundtrip_and_idempotence(tmp_path):
     np.testing.assert_allclose(e.seed, seed)
     # exclusion: loading with the digest excluded returns nothing
     assert pool_load(pool, H, exclude={d1}) == []
+
+
+def test_pool_load_rekeys_old_format_filenames(tmp_path):
+    """Entries written before a digest-format change keep their old
+    filenames; the loader must re-key them from CONTENT so downstream
+    dedupe (has_failure_signature, exclude=own) still matches — a
+    filename digest would bypass it and duplicate surrogate positives
+    on every ingest."""
+    import os
+
+    pool = str(tmp_path / "pool")
+    enc = _enc(0)
+    d = pool_add(pool, enc, enc, None, H)
+    # simulate an old-format file: same content, stale digest filename
+    os.rename(os.path.join(pool, f"{d}.npz"),
+              os.path.join(pool, "deadbeef" + "0" * 24 + ".npz"))
+    entries = pool_load(pool, H)
+    assert len(entries) == 1
+    assert entries[0].digest == trace_digest(enc)  # content, not filename
+    # content-level exclusion still works despite the stale name
+    assert pool_load(pool, H, exclude={trace_digest(enc)}) == []
+    # a re-add of the same signature under its new name does not load
+    # as a second entry
+    pool_add(pool, enc, enc, None, H)
+    assert pool_size(pool) == 2  # two files on disk...
+    assert len(pool_load(pool, H)) == 1  # ...one signature loaded
 
 
 def test_pool_skips_other_bucket_count(tmp_path):

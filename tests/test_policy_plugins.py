@@ -1,0 +1,94 @@
+"""Config-driven policy plugin loading (namazu_tpu/policy/plugins.py):
+content-digest idempotence across storages, and the failure mode it
+exists to prevent — ``init`` copies the plugin into every storage's
+materials dir, so the identical file loaded from two paths is ONE
+plugin, not a duplicate registration."""
+
+import pytest
+
+from namazu_tpu.policy.base import PolicyError, create_policy, known_policies
+from namazu_tpu.policy.plugins import load_policy_plugins
+from namazu_tpu.utils.config import Config
+
+_PLUGIN_SRC = """\
+from namazu_tpu.policy.base import ExplorePolicy, register_policy
+
+
+class {cls}(ExplorePolicy):
+    NAME = "{name}"
+
+    def queue_event(self, event):
+        self.action_out.put(event.default_action())
+
+
+register_policy({cls}.NAME, {cls})
+"""
+
+
+def _write_plugin(path, name, cls="PluginPolicy"):
+    path.write_text(_PLUGIN_SRC.format(name=name, cls=cls))
+    return str(path)
+
+
+def test_identical_plugin_in_two_storages_loads_once(tmp_path):
+    """The same plugin content at two absolute paths (two storages'
+    materials dirs) must not re-execute and trip the duplicate-name
+    registry guard."""
+    a = tmp_path / "storage_a" / "materials"
+    b = tmp_path / "storage_b" / "materials"
+    a.mkdir(parents=True)
+    b.mkdir(parents=True)
+    name = "obs_pr_test_twin"
+    _write_plugin(a / "twin.py", name)
+    _write_plugin(b / "twin.py", name)
+
+    cfg = Config({"policy_plugins": ["twin.py"]})
+    load_policy_plugins(cfg, materials_dir=str(a))
+    assert name in known_policies()
+    # second storage, identical copy: a no-op, NOT a PolicyError
+    load_policy_plugins(cfg, materials_dir=str(b))
+    assert isinstance(create_policy(name), object)
+
+
+def test_different_plugins_same_basename_both_load(tmp_path):
+    """Two DIFFERENT plugins that happen to share a basename are two
+    plugins — content keying must not conflate them, and their backing
+    modules must not evict each other."""
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    a.mkdir()
+    b.mkdir()
+    _write_plugin(a / "mine.py", "obs_pr_test_same_base_a", cls="PolA")
+    _write_plugin(b / "mine.py", "obs_pr_test_same_base_b", cls="PolB")
+    load_policy_plugins(Config({"policy_plugins": ["mine.py"]}),
+                        materials_dir=str(a))
+    load_policy_plugins(Config({"policy_plugins": ["mine.py"]}),
+                        materials_dir=str(b))
+    assert "obs_pr_test_same_base_a" in known_policies()
+    assert "obs_pr_test_same_base_b" in known_policies()
+
+
+def test_missing_plugin_fails_loudly(tmp_path):
+    cfg = Config({"policy_plugins": ["nope.py"]})
+    with pytest.raises(FileNotFoundError):
+        load_policy_plugins(cfg, materials_dir=str(tmp_path))
+
+
+def test_duplicate_name_from_different_content_still_guarded(tmp_path):
+    """Content keying must not weaken the registry guard: two plugins
+    with DIFFERENT content both registering the same policy name is a
+    real conflict and still fails."""
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    a.mkdir()
+    b.mkdir()
+    _write_plugin(a / "p.py", "obs_pr_test_conflict")
+    # different bytes (extra comment), same registered name
+    (b / "p.py").write_text(
+        _PLUGIN_SRC.format(name="obs_pr_test_conflict",
+                           cls="PluginPolicy") + "# v2\n")
+    load_policy_plugins(Config({"policy_plugins": ["p.py"]}),
+                        materials_dir=str(a))
+    with pytest.raises(PolicyError):
+        load_policy_plugins(Config({"policy_plugins": ["p.py"]}),
+                            materials_dir=str(b))

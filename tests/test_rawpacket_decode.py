@@ -1,0 +1,57 @@
+"""Raw-packet decoding edge cases that need no ZMQ backend (the wire
+tests live in test_hookswitch.py): GSO/TSO captures whose IPv4
+``total_len`` is 0 or truncated must still decode ports/seq/payload."""
+
+import struct
+
+from namazu_tpu.inspector.rawpacket import (
+    PROTO_TCP,
+    PROTO_UDP,
+    PSH,
+    ACK,
+    decode_ethernet,
+)
+
+
+def _frame(total_len, payload=b"", proto=PROTO_TCP):
+    eth = b"\x02" * 6 + b"\x04" * 6 + struct.pack("!H", 0x0800)
+    ip = struct.pack(
+        "!BBHHHBBH4s4s", 0x45, 0, total_len, 0, 0, 64, proto, 0,
+        bytes([10, 0, 0, 1]), bytes([10, 0, 0, 2]),
+    )
+    if proto == PROTO_TCP:
+        l4 = struct.pack("!HHIIBBHHH", 2888, 3888, 7, 1,
+                         5 << 4, PSH | ACK, 8192, 0, 0)
+    else:
+        l4 = struct.pack("!HHHH", 2888, 3888, 8 + len(payload), 0)
+    return eth + ip + l4 + payload
+
+
+def test_gso_total_len_zero_decodes_tcp():
+    """Offloaded super-frames carry total_len == 0; the length is
+    unknown, not authoritative — the decoder must fall back to the
+    frame end instead of truncating everything away."""
+    pkt = decode_ethernet(_frame(total_len=0, payload=b"hello"))
+    assert pkt.proto == PROTO_TCP
+    assert (pkt.src_port, pkt.dst_port, pkt.seq) == (2888, 3888, 7)
+    assert pkt.payload == b"hello"
+
+
+def test_truncated_total_len_decodes_udp():
+    """total_len smaller than the headers the frame visibly contains is
+    equally bogus (partial GSO); fall back to the frame end."""
+    pkt = decode_ethernet(
+        _frame(total_len=21, payload=b"xyz", proto=PROTO_UDP))
+    assert pkt.proto == PROTO_UDP
+    assert (pkt.src_port, pkt.dst_port) == (2888, 3888)
+    assert pkt.payload == b"xyz"
+
+
+def test_valid_total_len_still_clips_trailer_padding():
+    """The GSO fallback must not regress the sub-60-byte trailer-padding
+    clip: a well-formed total_len still bounds the payload slice."""
+    f = _frame(total_len=20 + 20 + 5, payload=b"hello")
+    padded = f + b"\x00" * 9  # ethernet trailer padding
+    assert decode_ethernet(padded).payload == b"hello"
+    assert decode_ethernet(f).content_hint() == \
+        decode_ethernet(padded).content_hint()
