@@ -28,8 +28,44 @@ import numpy as np
 PROBE_TIMEOUT_S = int(os.environ.get("NMZ_BENCH_PROBE_TIMEOUT", "120"))
 PROBE_TRIES = int(os.environ.get("NMZ_BENCH_PROBE_TRIES", "3"))
 PROBE_RETRY_SLEEP_S = int(os.environ.get("NMZ_BENCH_PROBE_SLEEP", "45"))
+# staleness bound on the folded-in last-good chip figure (round-5
+# ADVICE): a committed CPU-fallback artifact must not carry a TPU
+# number that predates a regression indefinitely — default 14 days
+LAST_GOOD_MAX_AGE_S = float(
+    os.environ.get("NMZ_BENCH_LAST_GOOD_MAX_AGE_S", str(14 * 86400)))
 LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "BENCH_TPU_LAST_GOOD.json")
+
+
+def _code_revision() -> str:
+    """Short git revision of the working tree ("" when unavailable) —
+    recorded into the last-good artifact so a stale chip figure can be
+    traced to the code that produced it."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            timeout=10, capture_output=True, text=True,
+        )
+        return out.stdout.strip() if out.returncode == 0 else ""
+    except Exception:
+        return ""
+
+
+def _last_good_age_s(rec: dict) -> float | None:
+    """Age in seconds of a last-good record, None when it carries no
+    parseable timestamp (pre-timestamp records: treat as unknown)."""
+    ts = rec.get("timestamp")
+    if not ts:
+        return None
+    try:
+        then = datetime.datetime.fromisoformat(ts)
+    except ValueError:
+        return None
+    now = datetime.datetime.now(datetime.timezone.utc)
+    if then.tzinfo is None:
+        then = then.replace(tzinfo=datetime.timezone.utc)
+    return max(0.0, (now - then).total_seconds())
 
 
 def _device_init_hangs() -> bool:
@@ -237,14 +273,34 @@ def main() -> None:
             "best_value": round(best, 1),
             "timestamp": datetime.datetime.now(
                 datetime.timezone.utc).isoformat(timespec="seconds"),
+            "revision": _code_revision(),
         })
     else:
         last_good = _load_last_good()
         if last_good is not None:
             # fold the chip number into the fallback line so the round's
             # committed artifact carries a TPU figure even when the
-            # tunnel was wedged at capture time
-            out["tpu_last_good"] = last_good
+            # tunnel was wedged at capture time — but never one older
+            # than the staleness bound (it could predate a regression)
+            age_s = _last_good_age_s(last_good)
+            stale = age_s is None or age_s > LAST_GOOD_MAX_AGE_S
+            annotated = dict(
+                last_good,
+                age_s=None if age_s is None else round(age_s, 1),
+                revision=last_good.get("revision", ""),
+            )
+            if stale:
+                out["tpu_last_good_rejected"] = dict(
+                    annotated,
+                    warning=("last-good record has no parseable timestamp"
+                             if age_s is None else
+                             f"last-good record is {age_s / 86400:.1f} "
+                             f"days old (bound "
+                             f"{LAST_GOOD_MAX_AGE_S / 86400:.1f} days); "
+                             "re-measure on the chip"),
+                )
+            else:
+                out["tpu_last_good"] = annotated
     print(json.dumps(out))
 
 

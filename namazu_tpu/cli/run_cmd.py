@@ -51,6 +51,10 @@ def run(args) -> int:
     storage = load_storage(storage_dir)
     working_dir = storage.create_new_working_dir()
     materials_dir = os.path.join(storage_dir, "materials")
+    # correlate this run's log lines, metrics, and flight-recorder trace
+    # (GET /traces/<run_id>) with the on-disk run dir via one key
+    if not cfg.is_set("run_id"):
+        cfg.set("run_id", os.path.basename(os.path.normpath(working_dir)))
     init_log(os.path.join(working_dir, "nmz.log"))
     factory = CmdFactory(working_dir=working_dir, materials_dir=materials_dir)
 

@@ -125,6 +125,51 @@ def register(sub) -> None:
                          "a plain CLI invocation is empty")
     pm.set_defaults(func=metrics_dump)
 
+    pt = tsub.add_parser(
+        "trace",
+        help="flight-recorder traces (doc/observability.md): list "
+             "recorded runs, dump one as NDJSON, export Chrome-trace "
+             "JSON for chrome://tracing / ui.perfetto.dev, or diff two "
+             "runs' realized dispatch orders",
+    )
+    ttsub = pt.add_subparsers(dest="trace_tool", required=True)
+
+    def _url_arg(sp):
+        sp.add_argument("--url", default="",
+                        help="a running orchestrator's REST endpoint "
+                             "(e.g. http://127.0.0.1:10080); omit to "
+                             "read this process's in-memory recorder "
+                             "(embedded orchestrators, tests)")
+
+    ptl = ttsub.add_parser("list", help="recorded-run summaries")
+    _url_arg(ptl)
+    ptl.set_defaults(func=trace_list)
+
+    ptd = ttsub.add_parser(
+        "dump", help="one run's records as NDJSON (diffable: one JSON "
+                     "line per event, run-relative timestamps)")
+    ptd.add_argument("run_id", nargs="?", default="latest",
+                     help="run id (default: latest)")
+    _url_arg(ptd)
+    ptd.set_defaults(func=trace_dump)
+
+    pte = ttsub.add_parser(
+        "export", help="one run as Chrome-trace/Perfetto JSON")
+    pte.add_argument("run_id", nargs="?", default="latest",
+                     help="run id (default: latest)")
+    pte.add_argument("--out", default="",
+                     help="write to this file instead of stdout")
+    _url_arg(pte)
+    pte.set_defaults(func=trace_export)
+
+    ptf = ttsub.add_parser(
+        "diff", help="unified diff of two runs' realized dispatch "
+                     "orders (empty output = same interleaving)")
+    ptf.add_argument("run_a")
+    ptf.add_argument("run_b")
+    _url_arg(ptf)
+    ptf.set_defaults(func=trace_diff)
+
     pi = tsub.add_parser(
         "import-reference-trace",
         help="convert a reference-format experiment dir (per-action JSON "
@@ -150,6 +195,105 @@ def metrics_dump(args) -> int:
     from namazu_tpu import obs
 
     print(json.dumps(obs.registry_jsonable(), sort_keys=True))
+    return 0
+
+
+def _http_get(url: str, timeout: float = 10.0) -> bytes:
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read()
+    except urllib.error.HTTPError as e:
+        # surface the server's error body (e.g. "no recorded run X")
+        # instead of a raw traceback — parity with the local path's
+        # friendly _local_run_or_die message
+        body = e.read().decode(errors="replace")
+        try:
+            msg = json.loads(body).get("error", body)
+        except ValueError:
+            msg = body
+        raise SystemExit(f"error: {url}: HTTP {e.code}: {msg}") from None
+
+
+def _local_run_or_die(run_id: str):
+    from namazu_tpu import obs
+
+    run = obs.trace_run(run_id)
+    if run is None:
+        known = [s["run_id"] for s in obs.trace_summaries()]
+        raise SystemExit(
+            f"no recorded run {run_id!r} in this process's recorder "
+            f"(known: {known}); a live orchestrator's traces need --url")
+    return run
+
+
+def trace_list(args) -> int:
+    if args.url:
+        doc = json.loads(_http_get(args.url.rstrip("/") + "/traces"))
+    else:
+        from namazu_tpu import obs
+
+        doc = {"runs": obs.trace_summaries()}
+    print(json.dumps(doc, sort_keys=True))
+    return 0
+
+
+def trace_dump(args) -> int:
+    if args.url:
+        text = _http_get(
+            args.url.rstrip("/")
+            + f"/traces/{args.run_id}?format=ndjson").decode()
+    else:
+        from namazu_tpu.obs import export
+
+        text = export.to_ndjson(_local_run_or_die(args.run_id))
+    sys.stdout.write(text)
+    return 0
+
+
+def trace_export(args) -> int:
+    if args.url:
+        text = _http_get(
+            args.url.rstrip("/") + f"/traces/{args.run_id}").decode()
+    else:
+        from namazu_tpu.obs import export
+
+        text = json.dumps(
+            export.chrome_trace(_local_run_or_die(args.run_id)),
+            sort_keys=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"wrote {args.out} (load it in chrome://tracing or "
+              "https://ui.perfetto.dev)")
+    else:
+        print(text)
+    return 0
+
+
+def trace_diff(args) -> int:
+    from namazu_tpu.obs import export
+
+    if args.url:
+        base = args.url.rstrip("/")
+        orders = [
+            export.order_lines_from_docs([
+                json.loads(line) for line in _http_get(
+                    f"{base}/traces/{rid}?format=ndjson"
+                ).decode().splitlines() if line.strip()])
+            for rid in (args.run_a, args.run_b)
+        ]
+        diff = export.diff_order(orders[0], orders[1],
+                                 args.run_a, args.run_b)
+    else:
+        diff = export.diff_runs(_local_run_or_die(args.run_a),
+                                _local_run_or_die(args.run_b))
+    if diff:
+        print(diff)
+        return 1  # like diff(1): nonzero when the orders differ
+    print("runs executed the same dispatch order")
     return 0
 
 

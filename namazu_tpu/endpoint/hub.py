@@ -79,6 +79,7 @@ class EndpointHub:
         event.mark_arrived()
         obs.mark(event, "intercepted")
         obs.event_intercepted(endpoint_name, event.entity_id)
+        obs.record_intercepted(event, endpoint_name)
         self.event_queue.put(event)
 
     def post_control(self, control: Control) -> None:

@@ -11,6 +11,12 @@ Capability parity with /root/reference/nmz/endpoint/rest
 * ``DELETE /api/v3/actions/{entity}/{uuid}``— acknowledge/remove an action
 * ``POST /api/v3/control?op=enableOrchestration|disableOrchestration``
 
+Operator surface at the server root (not under the API root — that is
+the inspector wire): ``GET /metrics`` + ``/metrics.json`` (PR 1),
+``GET /healthz`` (liveness + active run id), ``GET /traces`` (recorded
+run summaries) and ``GET /traces/<run_id>`` (Chrome-trace JSON;
+``?format=ndjson`` for the diffable line format) — doc/observability.md.
+
 Implementation: stdlib ThreadingHTTPServer — one thread per in-flight
 request, which long-polling requires anyway; no third-party HTTP stack.
 """
@@ -20,6 +26,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib.parse import urlparse, parse_qs
@@ -39,6 +46,7 @@ API_ROOT = "/api/v3"
 _EVENTS_RE = re.compile(rf"^{API_ROOT}/events/([^/]+)/([^/]+)$")
 _ACTIONS_RE = re.compile(rf"^{API_ROOT}/actions/([^/]+)(?:/([^/]+))?$")
 _CONTROL_RE = re.compile(rf"^{API_ROOT}/control$")
+_TRACES_RE = re.compile(r"^/traces(?:/([^/]+))?$")
 
 
 class ActionQueue:
@@ -108,6 +116,7 @@ class RestEndpoint(Endpoint):
         self._queues_lock = threading.Lock()
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._started_mono = time.monotonic()  # /healthz uptime anchor
 
     # -- lifecycle -------------------------------------------------------
 
@@ -119,6 +128,7 @@ class RestEndpoint(Endpoint):
 
     def start(self) -> None:
         endpoint = self
+        self._started_mono = time.monotonic()
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -189,6 +199,17 @@ class RestEndpoint(Endpoint):
                         "text/plain; version=0.0.4; charset=utf-8")
                 if url.path == "/metrics.json":
                     return self._reply(200, obs.registry_jsonable())
+                if url.path == "/healthz":
+                    return self._reply(200, {
+                        "status": "ok",
+                        "run_id": obs.current_run_id(),
+                        "uptime_s": round(
+                            time.monotonic() - endpoint._started_mono, 3),
+                        "endpoint": endpoint.NAME,
+                    })
+                m = _TRACES_RE.match(url.path)
+                if m:
+                    return self._get_traces(m.group(1), parse_qs(url.query))
                 m = _ACTIONS_RE.match(url.path)
                 if not (m and m.group(2) is None):
                     return self._reply(404, {"error": f"no route {url.path}"})
@@ -197,6 +218,26 @@ class RestEndpoint(Endpoint):
                 if action is None:
                     return self._reply(204)
                 self._reply(200, action.to_jsonable())
+
+            def _get_traces(self, run_id, query) -> None:
+                """Flight-recorder surface: run list, or one run as
+                Chrome-trace JSON / NDJSON (obs/export.py)."""
+                if run_id is None:
+                    return self._reply(200, {"runs": obs.trace_summaries()})
+                run = obs.trace_run(run_id)
+                if run is None:
+                    return self._reply(
+                        404, {"error": f"no recorded run {run_id}"})
+                fmt = (query.get("format") or ["chrome"])[0]
+                if fmt == "ndjson":
+                    return self._reply_raw(
+                        200, obs.export.to_ndjson(run).encode(),
+                        "application/x-ndjson")
+                if fmt != "chrome":
+                    return self._reply(
+                        400, {"error": f"unknown format {fmt!r}; known: "
+                              "chrome, ndjson"})
+                self._reply(200, obs.export.chrome_trace(run))
 
             def do_DELETE(self) -> None:
                 url = urlparse(self.path)
@@ -207,6 +248,7 @@ class RestEndpoint(Endpoint):
                 action = endpoint._queue_for(entity).delete(uuid)
                 if action is not None:
                     obs.mark(action, "acked")
+                    obs.record_acked(action)
                     obs.rest_ack(entity, obs.latency(action, "dispatched"))
                     self._reply(200, {})
                 else:

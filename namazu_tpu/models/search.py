@@ -245,6 +245,10 @@ class SearchBase:
             failure_entries=min(self._failure_n, self.cfg.failure_size),
             distinct_failures=self.distinct_failure_signatures(),
         )
+        # flight recorder: the round lands on the run's search track and
+        # advances the generation id that tags each policy decision
+        obs.record_generation(self.BACKEND, generations, elapsed,
+                              best_fitness)
 
     def labeled_archive(self):
         """(feats [N,K], labels [N]) of the populated archive slots whose
@@ -454,26 +458,39 @@ class ScheduleSearch(SearchBase):
         both outcomes, in which case the evolved population's top-k by
         fitness are re-ranked by predicted P(reproduce) and the winner is
         returned (the candidate worth the next wall-clock replay)."""
-        _encs, trace, pairs, archive, failures = self._device_inputs(encoded)
+        # per-phase wall-time breakdown (nmz_search_phase_seconds +
+        # jax.profiler.TraceAnnotation when a profiler session is live):
+        # "encode" = host->device staging, "evolve" = the fused
+        # mutate/score/select/migrate loop (its in-step phases are
+        # jax.named_scope-annotated in parallel/islands.py, visible in a
+        # device profile), "extract"/"surrogate" = best extraction
+        with obs.search_phase("encode"):
+            _encs, trace, pairs, archive, failures = \
+                self._device_inputs(encoded)
         import jax.numpy as jnp
 
         coin = None if self._coin is None else jnp.asarray(self._coin)
         nov_scale = jnp.asarray(self.novelty_scale(), jnp.float32)
         state = self._state
         t0 = time.perf_counter()
-        for _ in range(generations):
-            state = self._step(state, self._key, trace, pairs, archive,
-                               failures, coin, nov_scale)
-        state.best_fitness.block_until_ready()
+        with obs.search_phase("evolve"):
+            for _ in range(generations):
+                state = self._step(state, self._key, trace, pairs, archive,
+                                   failures, coin, nov_scale)
+            state.best_fitness.block_until_ready()
         elapsed = time.perf_counter() - t0
         self._state = state
         self.generations_run += generations
         self._record_progress(generations, elapsed,
                               generations * self.population,
                               float(state.best_fitness))
-        picked = self._surrogate_pick(trace, pairs, archive, failures,
-                                      nov_scale)
-        return picked if picked is not None else self.best()
+        with obs.search_phase("surrogate"):
+            picked = self._surrogate_pick(trace, pairs, archive, failures,
+                                          nov_scale)
+        if picked is not None:
+            return picked
+        with obs.search_phase("extract"):
+            return self.best()
 
     def novelty_scale(self) -> float:
         """Annealed multiplier on ``weights.novelty`` (see

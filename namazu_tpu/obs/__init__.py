@@ -1,4 +1,4 @@
-"""Observability plane: metrics registry + event-lifecycle spans.
+"""Observability plane: metrics registry + spans + flight recorder.
 
 ``namazu_tpu.obs`` is the one import the rest of the stack uses:
 
@@ -6,18 +6,41 @@
   gauges, fixed-bucket histograms), Prometheus text renderer, global
   enable/disable with a shared no-op fallback;
 * :mod:`namazu_tpu.obs.spans` — lifecycle stamping (interception ->
-  decision -> dispatch -> ack) and the domain metric vocabulary.
+  decision -> dispatch -> ack), the domain metric vocabulary, and the
+  search-plane phase profiler (``search_phase``);
+* :mod:`namazu_tpu.obs.recorder` — the flight recorder: bounded per-run
+  event-timeline capture with run-correlated structured records;
+* :mod:`namazu_tpu.obs.export` — Chrome-trace/Perfetto + NDJSON
+  exporters and the dispatch-order differ over recorded runs.
 
-Exposure: ``GET /metrics`` (Prometheus text) and ``GET /metrics.json``
-on the REST endpoint (endpoint/rest.py), plus ``nmz-tpu tools metrics``
-(cli/tools_cmd.py). Disable with ``obs_enabled = false`` in the
-experiment config. Metric names and label conventions are documented in
+Exposure: ``GET /metrics`` + ``/metrics.json``, ``GET /traces`` +
+``/traces/<run_id>``, and ``GET /healthz`` on the REST endpoint
+(endpoint/rest.py), plus ``nmz-tpu tools metrics`` and ``nmz-tpu tools
+trace {list,dump,diff,export}`` (cli/tools_cmd.py). Disable with
+``obs_enabled = false`` in the experiment config. Metric names, the
+trace record schema, and run-id correlation rules are documented in
 doc/observability.md.
 """
 
 from __future__ import annotations
 
-from namazu_tpu.obs import metrics
+from namazu_tpu.obs import export, metrics, recorder  # noqa: F401
+from namazu_tpu.obs.recorder import (  # noqa: F401
+    FlightRecorder,
+    begin_run,
+    current_generation_id,
+    current_run_id,
+    end_run,
+    record_acked,
+    record_decided,
+    record_decision,
+    record_dispatched,
+    record_enqueued,
+    record_generation,
+    record_install,
+    record_intercepted,
+    record_released,
+)
 from namazu_tpu.obs.metrics import (  # noqa: F401
     DEFAULT_BUCKETS,
     MetricError,
@@ -45,6 +68,7 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     schedule_install,
     scorer_throughput,
     scorer_throughput_value,
+    search_phase,
     search_round,
     sidecar_request,
     span,
@@ -73,3 +97,14 @@ def registry_jsonable() -> dict:
     """JSON form of the default registry (the /metrics.json body and
     the ``nmz-tpu tools metrics`` dump)."""
     return metrics.registry().to_jsonable()
+
+
+def trace_summaries() -> list:
+    """Recorded-run summaries (the ``GET /traces`` body)."""
+    return recorder.recorder().summaries()
+
+
+def trace_run(run_id: str):
+    """The recorded :class:`~namazu_tpu.obs.recorder.RunTrace` for
+    ``run_id`` ("latest" = most recently begun), or None."""
+    return recorder.recorder().run(run_id)

@@ -30,6 +30,7 @@ tests/test_obs.py pins this down).
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Optional
@@ -57,7 +58,9 @@ SEARCH_BEST_FITNESS = "nmz_search_best_fitness"
 SEARCH_ARCHIVE = "nmz_search_archive_entries"
 SEARCH_INSTALLS = "nmz_search_installs_total"
 SCORER_THROUGHPUT = "nmz_scorer_schedules_per_sec"
+SEARCH_PHASE = "nmz_search_phase_seconds"
 SIDECAR_REQUESTS = "nmz_sidecar_requests_total"
+ENTITY_LABEL_OVERFLOW = "nmz_entity_label_overflow_total"
 
 
 #: distinct ``entity`` label values admitted per registry before new
@@ -81,6 +84,14 @@ def _entity_label(reg, entity: str) -> str:
         if entity in seen:
             return entity
         if len(seen) >= MAX_ENTITY_LABELS:
+            # the fold is itself observable: a dashboard showing flat
+            # per-entity series while this counter climbs is sampling a
+            # collapsed label space, not a quiet system
+            reg.counter(
+                ENTITY_LABEL_OVERFLOW,
+                "entity label admissions folded into _other "
+                "(MAX_ENTITY_LABELS cap hit)",
+            ).inc()
             return "_other"
         seen.add(entity)
         return entity
@@ -289,6 +300,51 @@ def scorer_throughput(source: str, rate: float) -> None:
 
 def scorer_throughput_value(source: str) -> Optional[float]:
     return metrics.registry().value(SCORER_THROUGHPUT, source=source)
+
+
+#: cached jax.profiler.TraceAnnotation class, resolved lazily so the
+#: control plane never imports jax (policy/base.py's contract); False =
+#: probed and unavailable (no-op fallback, e.g. CPU-only builds)
+_trace_annotation_cls = None
+
+
+def _trace_annotation(name: str):
+    global _trace_annotation_cls
+    cls = _trace_annotation_cls
+    if cls is None:
+        try:
+            from jax.profiler import TraceAnnotation as cls
+        except Exception:  # pragma: no cover - jax-less deployments
+            cls = False
+        _trace_annotation_cls = cls
+    if cls is False:
+        return contextlib.nullcontext()
+    return cls(name)
+
+
+@contextlib.contextmanager
+def search_phase(phase: str):
+    """Time one search-plane phase (ingest / evolve / extract / install
+    / surrogate) into ``nmz_search_phase_seconds{phase=...}`` and, when
+    jax's profiler is importable, annotate the region into any active
+    device profile via ``jax.profiler.TraceAnnotation`` (no-op without a
+    profiler session, no-op fallback when jax is absent). Finer-grained
+    in-step phases (mutate/score/select/migrate) are annotated with
+    ``jax.named_scope`` inside the jitted island step
+    (parallel/islands.py), where host-side timers cannot reach."""
+    if not metrics.enabled():
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        with _trace_annotation(f"nmz:{phase}"):
+            yield
+    finally:
+        metrics.get().histogram(
+            SEARCH_PHASE,
+            "wall time per search-plane phase",
+            ("phase",),
+        ).labels(phase=phase).observe(time.perf_counter() - t0)
 
 
 def sidecar_request(op: str, ok: bool) -> None:

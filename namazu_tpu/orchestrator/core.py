@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import uuid as _uuid
 from typing import Optional
 
 from namazu_tpu import obs
@@ -50,6 +51,11 @@ class Orchestrator:
     ):
         self.config = config
         obs.configure_from_config(config)
+        # the correlation key for this run's logs, metrics and flight-
+        # recorder trace (GET /traces/<run_id>); `run` passes the run
+        # dir's name so on-disk artifacts join on the same id
+        self.run_id = str(config.get("run_id") or "") \
+            or _uuid.uuid4().hex[:12]
         self.policy = policy
         self.collect_trace = collect_trace
         self.trace = SingleTrace()
@@ -101,6 +107,7 @@ class Orchestrator:
         if self._started:
             return
         self._started = True
+        obs.begin_run(self.run_id)
         self.hub.start()
         self.policy.start()
         self.dumb.start()
@@ -138,6 +145,9 @@ class Orchestrator:
         self._threads["control"].join(timeout=10)
         self.hub.shutdown()
         log.debug("orchestrator shut down; trace length %d", len(self.trace))
+        # close the flight-recorder run LAST: the drains above still
+        # stamp released/dispatched records against it
+        obs.end_run(self.run_id)
         return self.trace
 
     # -- loops -----------------------------------------------------------
@@ -149,6 +159,7 @@ class Orchestrator:
                 return
             target = self.policy if self.enabled else self.dumb
             obs.mark(ev, "enqueued")
+            obs.record_enqueued(ev, target.name)
             try:
                 target.queue_event(ev)
             except Exception:
@@ -157,6 +168,7 @@ class Orchestrator:
                 # queue_event returning means the policy chose this
                 # event's delay/priority — the decision point
                 obs.mark(ev, "decided")
+                obs.record_decided(ev, target.name)
                 obs.policy_decision(target.name, ev.entity_id,
                                     obs.latency(ev, "intercepted"))
 
@@ -183,10 +195,10 @@ class Orchestrator:
             action: Action = item  # type: ignore[assignment]
             action.mark_triggered()
             obs.mark(action, "dispatched")
-            obs.action_dispatched(
-                "orchestrator" if action.orchestrator_side_only
-                else "forwarded",
-                obs.latency(action, "intercepted"))
+            kind = ("orchestrator" if action.orchestrator_side_only
+                    else "forwarded")
+            obs.record_dispatched(action, kind)
+            obs.action_dispatched(kind, obs.latency(action, "intercepted"))
             if self.collect_trace:
                 self.trace.append(action)
             if action.orchestrator_side_only:

@@ -85,21 +85,27 @@ def make_multiaxis_island_step(
 
     def _local_step(key, pop, trace, pairs, archive, failure_feats,
                     novelty_scale, coin=None):
+        # named scopes mark the per-phase op regions in any captured
+        # device profile (xprof/perfetto) — the in-jit counterpart of the
+        # host-side obs.search_phase timers (obs/spans.py): host timers
+        # can only see the whole fused dispatch, these label its parts
         for ax in axes:
             key = jax.random.fold_in(key, jax.lax.axis_index(ax))
 
-        fitness, _feats = score_population_multi(
-            pop.delays, trace, pairs, archive, failure_feats, weights,
-            faults=None if coin is None else pop.faults, coin=coin,
-            novelty_scale=novelty_scale,
-        )
+        with jax.named_scope("nmz_score"):
+            fitness, _feats = score_population_multi(
+                pop.delays, trace, pairs, archive, failure_feats, weights,
+                faults=None if coin is None else pop.faults, coin=coin,
+                novelty_scale=novelty_scale,
+            )
         # local best before evolution (elites survive anyway)
         best_i = jnp.argmax(fitness)
         local_best_fit = fitness[best_i]
         local_best_d = pop.delays[best_i]
         local_best_f = pop.faults[best_i]
 
-        new_pop = ga_generation(key, pop, fitness, cfg)
+        with jax.named_scope("nmz_mutate"):
+            new_pop = ga_generation(key, pop, fitness, cfg)
 
         # Migration: after ga_generation the island's elites occupy rows
         # [0:n_elite) of new_pop (sorted best-first), so migrants are the
@@ -117,24 +123,26 @@ def make_multiaxis_island_step(
             if mesh.shape[ax] > 1 and kk > 0:
                 plan.append((ax, kk, offset))
                 offset += kk
-        for ax, kk, off in plan:
-            n_ax = mesh.shape[ax]
-            perm = [(j, (j + 1) % n_ax) for j in range(n_ax)]
-            mig_d = jax.lax.ppermute(new_pop.delays[:kk], ax, perm)
-            mig_f = jax.lax.ppermute(new_pop.faults[:kk], ax, perm)
-            dst = rows - off - kk
-            new_pop = Population(
-                delays=new_pop.delays.at[dst:dst + kk].set(mig_d),
-                faults=new_pop.faults.at[dst:dst + kk].set(mig_f),
-            )
+        with jax.named_scope("nmz_migrate"):
+            for ax, kk, off in plan:
+                n_ax = mesh.shape[ax]
+                perm = [(j, (j + 1) % n_ax) for j in range(n_ax)]
+                mig_d = jax.lax.ppermute(new_pop.delays[:kk], ax, perm)
+                mig_f = jax.lax.ppermute(new_pop.faults[:kk], ax, perm)
+                dst = rows - off - kk
+                new_pop = Population(
+                    delays=new_pop.delays.at[dst:dst + kk].set(mig_d),
+                    faults=new_pop.faults.at[dst:dst + kk].set(mig_f),
+                )
 
         # replicated global best: gather one candidate per island, axis by
         # axis (innermost first, so ICI gathers before any DCN hop)
-        all_fit, all_d, all_f = local_best_fit, local_best_d, local_best_f
-        for ax in reversed(axes):
-            all_fit = jax.lax.all_gather(all_fit, ax)
-            all_d = jax.lax.all_gather(all_d, ax)
-            all_f = jax.lax.all_gather(all_f, ax)
+        with jax.named_scope("nmz_select"):
+            all_fit, all_d, all_f = local_best_fit, local_best_d, local_best_f
+            for ax in reversed(axes):
+                all_fit = jax.lax.all_gather(all_fit, ax)
+                all_d = jax.lax.all_gather(all_d, ax)
+                all_f = jax.lax.all_gather(all_f, ax)
         all_fit = all_fit.reshape(-1)
         all_d = all_d.reshape(-1, all_d.shape[-1])
         all_f = all_f.reshape(-1, all_f.shape[-1])

@@ -109,6 +109,7 @@ class QueueBackedPolicy(ExplorePolicy):
                 event = self._queue.get()
             except QueueClosed:
                 return
+            obs.record_released(event, self.name)
             obs.queue_dwell(self.name, event.entity_id,
                             obs.latency(event, "enqueued"))
             self._emit(self._action_for(event))
@@ -123,6 +124,15 @@ class QueueBackedPolicy(ExplorePolicy):
         t = self._dequeue_thread
         if t is not None:
             t.join(timeout=10)
+        # dwell is normally observed at dequeue; events still resident
+        # here (worker never started, died, or outlived the join window)
+        # would otherwise vanish from the histogram — exactly the
+        # long-stuck tail an operator most needs to see
+        for event in self._queue.drain_remaining():
+            entity = getattr(event, "entity_id", "")
+            if entity:
+                obs.queue_dwell(self.name, entity,
+                                obs.latency(event, "enqueued"))
         super().shutdown()
 
 

@@ -292,10 +292,17 @@ class TPUSearchPolicy(QueueBackedPolicy):
             return False
         return float(self._coin_table()[bucket]) < p
 
+    def _table_source(self) -> str:
+        """Where the current hot-path table values come from — the
+        flight recorder's causal tag for each decision."""
+        return "hash" if self._delays is None else "table"
+
     def queue_event(self, event: Event) -> None:
         self.start()
         if isinstance(event, ProcSetEvent):
             attrs = self._proc_policy.attrs_for(event.pids)
+            obs.record_decision(event, self.name, kind="procset",
+                                proc_policy=self.proc_policy_name)
             self._emit(ProcSetSchedAction.for_procset(event, attrs))
             return
         if self.release_mode == "reorder":
@@ -307,6 +314,10 @@ class TPUSearchPolicy(QueueBackedPolicy):
                 self._emit(self._action_for(event))
                 return
             prio = self._delay_for(event.replay_hint())
+            obs.record_decision(
+                event, self.name, mode="reorder", priority=prio,
+                source=self._table_source(),
+                generation=obs.current_generation_id())
             now = self._now()
             with self._pending_lock:
                 if self._anchor is None:
@@ -319,7 +330,11 @@ class TPUSearchPolicy(QueueBackedPolicy):
                 # drain again (idempotent) so the event is not stranded
                 self._drain_pending(gap=0.0)
             return
-        self._queue.put_at(event, self._delay_for(event.replay_hint()))
+        delay = self._delay_for(event.replay_hint())
+        obs.record_decision(event, self.name, mode="delay", delay=delay,
+                            source=self._table_source(),
+                            generation=obs.current_generation_id())
+        self._queue.put_at(event, delay)
 
     def _action_for(self, event: Event):
         if self._fault_for(event.replay_hint()):
@@ -375,6 +390,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
             # cannot outlive the join window and lose its tail
             if i and gap > 0 and not self._stop_reorder.is_set():
                 time.sleep(gap)
+            obs.record_released(event, self.name)
             obs.queue_dwell(self.name, event.entity_id,
                             obs.latency(event, "enqueued"))
             self._emit(self._action_for(event))
@@ -565,6 +581,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self._delays = delays
         self._faults = faults
         obs.schedule_install("checkpoint")
+        obs.record_install("checkpoint")
         log.info("installed checkpointed schedule (fitness %.4f) from %s",
                  fit, ckpt)
         return True
@@ -637,17 +654,21 @@ class TPUSearchPolicy(QueueBackedPolicy):
                     self._delays = b.delays
                     self._faults = b.faults
                     obs.schedule_install("checkpoint")
+                    obs.record_install("checkpoint")
                     log.info(
                         "installed checkpointed schedule (fitness %.4f) "
                         "before this run's search", b.fitness)
-            references = self._ingest_history(search)
+            with obs.search_phase("ingest"):
+                references = self._ingest_history(search)
             if not references:
                 log.info("no stored history yet; keeping hash-based delays")
                 return
             best = search.run(references, generations=self.generations)
-            self._delays = best.delays
-            self._faults = best.faults
+            with obs.search_phase("install"):
+                self._delays = best.delays
+                self._faults = best.faults
             obs.schedule_install("search")
+            obs.record_install("search")
             log.info("installed searched schedule (fitness %.4f, gen %d)",
                      best.fitness, search.generations_run)
             if ckpt:
@@ -722,6 +743,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self._delays = _np.asarray(resp["delays"], _np.float32)
         self._faults = _np.asarray(resp["faults"], _np.float32)
         obs.schedule_install("sidecar")
+        obs.record_install("sidecar")
         log.info("installed sidecar schedule (fitness %.4f, gen %d)",
                  resp["fitness"], resp["generations_run"])
 

@@ -2,6 +2,14 @@
 
 Parity with /root/reference/nmz/util/log/logutil.go: per-run log file plus
 stderr, debug gated on the ``NMZ_TPU_DEBUG`` environment variable.
+
+Every line is tagged with the active **run id** (``[run-id]``), pushed
+here by the flight recorder (``namazu_tpu/obs/recorder.py begin_run``)
+and by the orchestrator lifecycle — the one key logs, metrics, and
+per-run traces (``GET /traces/<run_id>``) all join on. Outside a run the
+tag renders as ``[-]``. The tag is injected by a logging.Filter on each
+handler (filters on a logger do not propagate to child loggers'
+records; handler filters see everything).
 """
 
 from __future__ import annotations
@@ -13,6 +21,41 @@ from typing import Optional
 
 _INITIALIZED = False
 
+_FORMAT = "%(asctime)s %(levelname).1s [%(run_id)s] %(name)s: %(message)s"
+
+# process-global: one `run` process serves one experiment run, and every
+# worker thread (hub, orchestrator loops, policy workers, REST handlers)
+# belongs to it — a contextvar would NOT propagate to those threads
+_run_id = "-"
+
+
+def set_run_id(run_id: Optional[str]) -> None:
+    """Tag subsequent log lines (and trace/metric correlation) with
+    ``run_id``; None clears back to the idle tag."""
+    global _run_id
+    _run_id = run_id or "-"
+
+
+def get_run_id() -> str:
+    """The active run id, or "-" outside a run."""
+    return _run_id
+
+
+class _RunIdFilter(logging.Filter):
+    """Injects ``record.run_id`` so the formatter can always render it
+    (records from threads that predate set_run_id included)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.run_id = _run_id
+        return True
+
+
+def _make_handler(cls, *args) -> logging.Handler:
+    h = cls(*args)
+    h.setFormatter(logging.Formatter(_FORMAT, "%H:%M:%S"))
+    h.addFilter(_RunIdFilter())
+    return h
+
 
 def init_log(log_file: Optional[str] = None, debug: Optional[bool] = None) -> logging.Logger:
     global _INITIALIZED
@@ -20,18 +63,11 @@ def init_log(log_file: Optional[str] = None, debug: Optional[bool] = None) -> lo
     if debug is None:
         debug = os.environ.get("NMZ_TPU_DEBUG", "") not in ("", "0", "false")
     root.setLevel(logging.DEBUG if debug else logging.INFO)
-    fmt = logging.Formatter(
-        "%(asctime)s %(levelname).1s %(name)s: %(message)s", "%H:%M:%S"
-    )
     if not _INITIALIZED:
-        h = logging.StreamHandler(sys.stderr)
-        h.setFormatter(fmt)
-        root.addHandler(h)
+        root.addHandler(_make_handler(logging.StreamHandler, sys.stderr))
         _INITIALIZED = True
     if log_file:
-        fh = logging.FileHandler(log_file)
-        fh.setFormatter(fmt)
-        root.addHandler(fh)
+        root.addHandler(_make_handler(logging.FileHandler, log_file))
     return root
 
 

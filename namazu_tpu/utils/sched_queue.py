@@ -133,6 +133,20 @@ class ScheduledQueue:
                 heapq.heapify(self._heap)
             self._cond.notify_all()
 
+    def drain_remaining(self) -> list:
+        """Remove and return every still-resident item (FIFO by enqueue
+        order), regardless of ripeness. Shutdown path only: lets the
+        owner account for items its dequeue worker never released (e.g.
+        record their queue-dwell) instead of dropping them silently."""
+        with self._cond:
+            items = [item for (_, _, _, item) in sorted(self._heap,
+                                                        key=lambda e: e[1])]
+            self._heap = []
+            if self._obs_name and items:
+                obs.sched_queue_depth(self._obs_name, 0)
+            self._cond.notify_all()
+            return items
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._heap)

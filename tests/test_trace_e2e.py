@@ -1,0 +1,226 @@
+"""Flight-recorder acceptance (ISSUE 2): a scripted end-to-end run —
+real orchestrator, two entities, the TPU search policy — produces a
+trace retrievable via both ``GET /traces/<run_id>`` and ``nmz-tpu tools
+trace export``, whose Chrome-trace JSON validates (parses, monotonic
+per-track timestamps, every dispatched event has a matching
+policy-decision record); with ``obs_enabled = false`` the same run
+allocates no trace records. Plus ``GET /healthz`` and the run-id
+correlation across logs/trace."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from namazu_tpu import obs
+from namazu_tpu.inspector.transceiver import new_transceiver
+from namazu_tpu.obs import metrics, recorder
+from namazu_tpu.obs.metrics import MetricsRegistry
+from namazu_tpu.orchestrator import Orchestrator
+from namazu_tpu.policy import create_policy
+from namazu_tpu.signal import PacketEvent
+from namazu_tpu.utils.config import Config
+
+N_PER_ENTITY = 3
+ENTITIES = ("e0", "e1")
+
+
+@pytest.fixture(autouse=True)
+def fresh_obs():
+    old_reg = metrics.set_registry(MetricsRegistry())
+    metrics.configure(True)
+    old_rec = recorder.set_recorder(recorder.FlightRecorder())
+    yield
+    metrics.set_registry(old_reg)
+    metrics.configure(True)
+    recorder.set_recorder(old_rec)
+
+
+def _scripted_run(run_id, obs_enabled=True):
+    """Two local entities drive PacketEvents through a real orchestrator
+    running the TPU policy (search thread off: the scripted part is the
+    control plane; hash-fallback delays are deterministic)."""
+    cfg = Config({
+        "rest_port": 0,
+        "obs_enabled": obs_enabled,
+        "run_id": run_id,
+        "explore_policy": "tpu_search",
+        "explore_policy_param": {
+            "search_on_start": False,
+            "max_interval": 30,  # ms: keep the run fast
+            "seed": 7,
+        },
+    })
+    policy = create_policy("tpu_search")
+    policy.load_config(cfg)
+    orc = Orchestrator(cfg, policy, collect_trace=True)
+    orc.start()
+    transceivers = {
+        e: new_transceiver("local://", e, orc.local_endpoint)
+        for e in ENTITIES
+    }
+    for t in transceivers.values():
+        t.start()
+    actions = []
+    for i in range(N_PER_ENTITY):
+        for e in ENTITIES:
+            ev = PacketEvent.create(e, e, "peer", hint=f"h{i}")
+            actions.append(transceivers[e].send_event(ev).get(timeout=10))
+    port = orc.hub.endpoint("rest").port
+    return orc, port, actions
+
+
+def _wait_for_dispatched(run_id, n, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        run = obs.trace_run(run_id)
+        if run is not None:
+            snap = run.snapshot()
+            if sum(1 for r in snap["records"]
+                   if "dispatched" in r["rec"].t) >= n:
+                return
+        time.sleep(0.02)
+
+
+def _validate_chrome(doc):
+    """The acceptance invariants on an exported Chrome-trace document."""
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    per_track = {}
+    for e in doc["traceEvents"]:
+        if e["ph"] in ("X", "b", "e", "i"):
+            per_track.setdefault((e["pid"], e["tid"]), []).append(e["ts"])
+            assert e["ts"] >= 0
+            assert e.get("dur", 0) >= 0
+    for track, stamps in per_track.items():
+        assert stamps == sorted(stamps), f"track {track} not monotonic"
+    # entity/policy spans are async begin/end pairs (overlapping
+    # in-flight events cannot render as nested 'X' slices) — every
+    # begin has its matching end
+    begins = {(e["cat"], e["id"]) for e in doc["traceEvents"]
+              if e["ph"] == "b"}
+    ends = {(e["cat"], e["id"]) for e in doc["traceEvents"]
+            if e["ph"] == "e"}
+    assert begins == ends
+    # every dispatched entity-track event carries its decision record
+    dispatched = [e for e in doc["traceEvents"]
+                  if e.get("cat") == "event" and e["ph"] == "b"
+                  and "dispatched" in e["args"]["t"]]
+    assert len(dispatched) >= len(ENTITIES) * N_PER_ENTITY
+    for e in dispatched:
+        decision = e["args"]["decision"]
+        assert decision.get("mode") == "delay"
+        assert "delay" in decision and "generation" in decision
+        assert decision.get("source") in ("hash", "table")
+        assert e["args"]["policy"] == "tpu_search"
+
+
+def test_e2e_trace_via_rest_and_cli(capsys):
+    orc, port, actions = _scripted_run("e2e-run")
+    try:
+        assert len(actions) == len(ENTITIES) * N_PER_ENTITY
+        _wait_for_dispatched("e2e-run", len(actions))
+        base = f"http://127.0.0.1:{port}"
+
+        # /healthz reports the active run
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            hz = json.loads(r.read())
+        assert hz["status"] == "ok"
+        assert hz["run_id"] == "e2e-run"
+        assert hz["uptime_s"] >= 0
+
+        # /traces lists the run; /traces/<run_id> exports it
+        with urllib.request.urlopen(f"{base}/traces", timeout=10) as r:
+            listing = json.loads(r.read())
+        assert [s["run_id"] for s in listing["runs"]] == ["e2e-run"]
+        with urllib.request.urlopen(f"{base}/traces/e2e-run",
+                                    timeout=10) as r:
+            doc = json.loads(r.read())
+        _validate_chrome(doc)
+        assert doc["metadata"]["run_id"] == "e2e-run"
+
+        # the NDJSON wire format parses line by line
+        with urllib.request.urlopen(
+                f"{base}/traces/e2e-run?format=ndjson", timeout=10) as r:
+            lines = [json.loads(line) for line
+                     in r.read().decode().splitlines()]
+        assert len(lines) >= len(actions)
+        assert all(doc["run_id"] == "e2e-run" for doc in lines)
+
+        # unknown run / unknown format fail cleanly
+        for path, code in (("/traces/nope", 404),
+                           ("/traces/e2e-run?format=xml", 400)):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(base + path, timeout=10)
+            assert exc.value.code == code
+
+        # CLI export against the live orchestrator
+        from namazu_tpu.cli import cli_main
+
+        assert cli_main(["tools", "trace", "export", "e2e-run",
+                         "--url", base]) == 0
+        cli_doc = json.loads(capsys.readouterr().out)
+        _validate_chrome(cli_doc)
+
+        # CLI list + dump also work over the wire
+        assert cli_main(["tools", "trace", "list", "--url", base]) == 0
+        assert [s["run_id"] for s in
+                json.loads(capsys.readouterr().out)["runs"]] == ["e2e-run"]
+        assert cli_main(["tools", "trace", "dump", "e2e-run",
+                         "--url", base]) == 0
+        assert len(capsys.readouterr().out.splitlines()) >= len(actions)
+
+        # a run diffs clean against itself over the wire
+        assert cli_main(["tools", "trace", "diff", "e2e-run", "e2e-run",
+                         "--url", base]) == 0
+        assert "same dispatch order" in capsys.readouterr().out
+    finally:
+        orc.shutdown()
+
+    # after shutdown the run is closed but still exported locally
+    run = obs.trace_run("e2e-run")
+    assert run.summary()["ended"]
+    from namazu_tpu.obs import export
+
+    assert len(export.order_lines(run)) >= len(actions)
+
+
+def test_e2e_obs_disabled_allocates_no_trace():
+    orc, port, actions = _scripted_run("off-run", obs_enabled=False)
+    try:
+        assert len(actions) == len(ENTITIES) * N_PER_ENTITY
+        base = f"http://127.0.0.1:{port}"
+        # healthz still serves (liveness is not telemetry)...
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            assert json.loads(r.read())["status"] == "ok"
+        # ...but no trace was allocated: not the run, not one record
+        with urllib.request.urlopen(f"{base}/traces", timeout=10) as r:
+            assert json.loads(r.read()) == {"runs": []}
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/traces/off-run", timeout=10)
+        assert exc.value.code == 404
+        assert recorder.recorder().runs() == []
+    finally:
+        orc.shutdown()
+
+
+def test_search_round_lands_on_trace_and_tags_decisions():
+    """The search plane's generation counter reaches the trace: rounds
+    appear on the search track and later decisions carry the new id."""
+    rec = recorder.recorder()
+    rec.begin_run("gen-run")
+    obs.record_generation("ga", 64, 0.01, 2.5)
+    assert obs.current_generation_id() == 64
+    obs.record_generation("ga", 64, 0.01, 3.5)
+    assert obs.current_generation_id() == 128
+    run = obs.trace_run("gen-run")
+    gens = run.snapshot()["generations"]
+    assert [(g["gen_start"], g["gen_end"]) for g in gens] == \
+        [(0, 64), (64, 128)]
+    from namazu_tpu.obs import export
+
+    doc = export.chrome_trace(run)
+    search_events = [e for e in doc["traceEvents"]
+                     if e.get("cat") == "search"]
+    assert len(search_events) == 2
