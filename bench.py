@@ -16,6 +16,7 @@ Prints ONE JSON line:
 
 from __future__ import annotations
 
+import argparse
 import datetime
 import json
 import os
@@ -35,6 +36,21 @@ LAST_GOOD_MAX_AGE_S = float(
     os.environ.get("NMZ_BENCH_LAST_GOOD_MAX_AGE_S", str(14 * 86400)))
 LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "BENCH_TPU_LAST_GOOD.json")
+# append-only bench trajectory: one JSON line per completed bench round
+# (revision, timestamp, schedules/s, platform) — the ONE stable input
+# for cross-round analytics and the --gate regression check, replacing
+# archaeology over loose BENCH_r0*.json files
+HISTORY_PATH = os.environ.get(
+    "NMZ_BENCH_HISTORY",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "BENCH_HISTORY.jsonl"))
+# --gate: fail when the fresh measurement falls more than this far below
+# the best recent same-platform history entry
+GATE_DEFAULT_PCT = float(os.environ.get("NMZ_BENCH_GATE_PCT", "30"))
+# history entries (newest, same-platform) the gate baselines against —
+# bounded so a years-long history cannot freeze the baseline on one
+# ancient lucky measurement
+GATE_BASELINE_WINDOW = 20
 
 
 def _code_revision() -> str:
@@ -116,6 +132,77 @@ def _save_last_good(record: dict) -> None:
     os.replace(tmp, LAST_GOOD_PATH)
 
 
+def load_history(path: str = HISTORY_PATH) -> list:
+    """All parseable history records, oldest first (bad lines skipped —
+    an interrupted append must not brick every later gate)."""
+    records = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+    except OSError:
+        pass
+    return records
+
+
+def append_history(record: dict, path: str = HISTORY_PATH) -> None:
+    with open(path, "a") as f:
+        f.write(json.dumps(record, sort_keys=True) + "\n")
+
+
+def gate_record(current: dict, history: list,
+                threshold_pct: float = GATE_DEFAULT_PCT,
+                window: int = GATE_BASELINE_WINDOW):
+    """Regression gate: compare a fresh bench record against the best of
+    the last ``window`` same-platform history entries.
+
+    Returns ``(ok, reasons, baseline)``. A regression is a
+    ``schedules_per_sec`` (or, when both records carry one, ``coverage``)
+    figure more than ``threshold_pct`` percent below the baseline.
+    Cross-platform comparisons are refused by construction — a CPU
+    fallback reading 40k/s must never read as a 99.6% TPU regression
+    (the round-4 lesson all over again).
+    """
+    same = [h for h in history
+            if h.get("platform") == current.get("platform")
+            and h.get("schedules_per_sec")][-window:]
+    reasons = []
+    baseline = {}
+    if not same:
+        return True, [f"no {current.get('platform')!r} history to gate "
+                      "against; pass"], baseline
+    frac = threshold_pct / 100.0
+    base_rate = max(float(h["schedules_per_sec"]) for h in same)
+    baseline["schedules_per_sec"] = base_rate
+    cur_rate = float(current.get("schedules_per_sec") or 0.0)
+    if cur_rate < base_rate * (1.0 - frac):
+        reasons.append(
+            f"schedules/s regression: {cur_rate:.1f} is "
+            f"{100.0 * (1.0 - cur_rate / base_rate):.1f}% below the "
+            f"recent best {base_rate:.1f} (threshold {threshold_pct:g}%)")
+    covs = [float(h["coverage"]) for h in same
+            if h.get("coverage") is not None]
+    if covs and current.get("coverage") is not None:
+        base_cov = max(covs)
+        baseline["coverage"] = base_cov
+        cur_cov = float(current["coverage"])
+        if cur_cov < base_cov * (1.0 - frac):
+            reasons.append(
+                f"coverage regression: {cur_cov:.4f} is "
+                f"{100.0 * (1.0 - cur_cov / base_cov):.1f}% below the "
+                f"recent best {base_cov:.4f} "
+                f"(threshold {threshold_pct:g}%)")
+    return (not reasons), reasons, baseline
+
+
 def numpy_score(delays, hint_ids, arrival, mask, pairs, archive, failures,
                 tau=0.005):
     """Reference single-thread numpy implementation (one genome batch)."""
@@ -136,13 +223,39 @@ def numpy_score(delays, hint_ids, arrival, mask, pairs, archive, failures,
     return d2a - d2f - 0.01 * delays.mean(-1)
 
 
-def main() -> None:
+def parse_args(argv=None) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(
+        description="namazu_tpu scorer benchmark (one JSON line)")
+    ap.add_argument("--gate", action="store_true",
+                    help="after measuring, compare against the bench "
+                         "history and exit 1 on a regression beyond "
+                         "--gate-threshold (CI regression gating)")
+    ap.add_argument("--gate-threshold", type=float,
+                    default=GATE_DEFAULT_PCT, metavar="PCT",
+                    help="allowed percent drop below the recent best "
+                         f"same-platform figure (default {GATE_DEFAULT_PCT:g})")
+    ap.add_argument("--history", default=HISTORY_PATH,
+                    help="bench-history JSONL path (default "
+                         "BENCH_HISTORY.jsonl next to bench.py; env "
+                         "NMZ_BENCH_HISTORY)")
+    ap.add_argument("--coverage", type=float, default=None,
+                    help="optional exploration-coverage figure (the "
+                         "unique-interleaving fraction from `nmz-tpu "
+                         "tools report`) folded into the history record "
+                         "and gated alongside schedules/s")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
     if os.environ.get("NMZ_BENCH_NO_PROBE") != "1" and _device_init_hangs():
-        # re-exec on CPU so the bench always emits its JSON line
+        # re-exec on CPU so the bench always emits its JSON line (argv
+        # forwarded: a gated bench must stay gated through the fallback)
         env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
                    NMZ_BENCH_NO_PROBE="1")
-        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
-                  env)
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__)]
+                  + sys.argv[1:], env)
 
     import jax
     import jax.numpy as jnp
@@ -301,6 +414,39 @@ def main() -> None:
                 )
             else:
                 out["tpu_last_good"] = annotated
+
+    # bench trajectory: every completed round appends one history line;
+    # the gate baselines against the entries that PRECEDED this round
+    if args.coverage is not None:
+        out["coverage"] = args.coverage
+    prior = load_history(args.history)
+    record = {
+        "timestamp": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+        "revision": _code_revision(),
+        "schedules_per_sec": out["value"],
+        "unit": out["unit"],
+        "vs_baseline": out["vs_baseline"],
+        "platform": platform,
+    }
+    if args.coverage is not None:
+        record["coverage"] = args.coverage
+    try:
+        append_history(record, args.history)
+    except OSError as e:  # the JSON line must still come out
+        print(f"# could not append bench history: {e}", file=sys.stderr)
+
+    if args.gate:
+        ok, reasons, baseline = gate_record(
+            record, prior, threshold_pct=args.gate_threshold)
+        out["gate"] = {"ok": ok, "threshold_pct": args.gate_threshold,
+                       "baseline": baseline, "reasons": reasons}
+        print(json.dumps(out))
+        if not ok:
+            for reason in reasons:
+                print(f"# GATE FAILED: {reason}", file=sys.stderr)
+            raise SystemExit(1)
+        return
     print(json.dumps(out))
 
 

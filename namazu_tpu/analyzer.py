@@ -26,7 +26,7 @@ Coverage = Dict[str, float]
 
 
 def load_run_coverage(storage: HistoryStorage, i: int) -> Optional[Coverage]:
-    path = os.path.join(storage._run_dir(i), "coverage.json")  # type: ignore[attr-defined]
+    path = os.path.join(storage.run_dir(i), "coverage.json")
     if not os.path.exists(path):
         return None
     with open(path) as f:

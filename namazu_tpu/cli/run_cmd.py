@@ -65,6 +65,12 @@ def run(args) -> int:
     policy.load_config(cfg)
     policy.set_history_storage(storage)
 
+    # the live GET /analytics route aggregates over this storage (the
+    # same dir `tools report` reads offline — one payload, two surfaces)
+    from namazu_tpu import obs
+
+    obs.set_analytics_storage(os.path.abspath(storage_dir))
+
     orchestrator = Orchestrator(cfg, policy, collect_trace=True)
     orchestrator.start()
 

@@ -1,4 +1,4 @@
-"""``nmz-tpu tools summary|dump-trace|visualize`` — experiment analysis.
+"""``nmz-tpu tools summary|dump-trace|visualize|report|...`` — analysis.
 
 Parity: /root/reference/nmz/cli/tools — ``summary`` (per-run pass/fail and
 over-average times, summary.go:40-77), ``dump-trace`` (pretty-print one
@@ -170,6 +170,31 @@ def register(sub) -> None:
     _url_arg(ptf)
     ptf.set_defaults(func=trace_diff)
 
+    pr = tsub.add_parser(
+        "report",
+        help="experiment analytics report (doc/observability.md): "
+             "cross-run exploration coverage, reproduction-rate stats "
+             "with a Wilson interval, search-plane convergence + stall "
+             "detection, and the analyzer's suspicious-branch ranking — "
+             "as Markdown, JSON, or NDJSON",
+    )
+    pr.add_argument("storage", nargs="?", default="",
+                    help="storage dir to analyze (omit with --url)")
+    pr.add_argument("--url", default="",
+                    help="a running orchestrator's REST endpoint (e.g. "
+                         "http://127.0.0.1:10080): fetch its live "
+                         "/analytics payload instead of reading a "
+                         "storage dir")
+    pr.add_argument("--format", choices=("md", "json", "ndjson"),
+                    default="md")
+    pr.add_argument("--top", type=int, default=20,
+                    help="suspicious-branch rows kept (default 20)")
+    pr.add_argument("--window", type=int, default=8,
+                    help="runs per novelty window (default 8)")
+    pr.add_argument("--out", default="",
+                    help="write to this file instead of stdout")
+    pr.set_defaults(func=report)
+
     pi = tsub.add_parser(
         "import-reference-trace",
         help="convert a reference-format experiment dir (per-action JSON "
@@ -294,6 +319,43 @@ def trace_diff(args) -> int:
         print(diff)
         return 1  # like diff(1): nonzero when the orders differ
     print("runs executed the same dispatch order")
+    return 0
+
+
+def report(args) -> int:
+    """Experiment analytics report — local storage or a live
+    orchestrator's /analytics (same payload either way; the local path
+    additionally folds in THIS process's flight-recorder runs, which for
+    a plain CLI invocation are none)."""
+    from namazu_tpu.obs import analytics, recorder
+    from namazu_tpu.obs import report as report_mod
+
+    if args.url:
+        payload = json.loads(_http_get(
+            args.url.rstrip("/")
+            + f"/analytics?top={args.top}&window={args.window}"))
+    elif args.storage:
+        st = load_storage(args.storage)
+        try:
+            payload = analytics.compute_payload(
+                storage=st, recorder_runs=recorder.recorder().runs(),
+                top=args.top, window=args.window)
+        finally:
+            st.close()
+    else:
+        raise SystemExit("error: give a storage dir or --url")
+    if args.format == "json":
+        text = json.dumps(payload, sort_keys=True) + "\n"
+    elif args.format == "ndjson":
+        text = report_mod.render_ndjson(payload)
+    else:
+        text = report_mod.render_markdown(payload)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out}")
+    else:
+        sys.stdout.write(text)
     return 0
 
 

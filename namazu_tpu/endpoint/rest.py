@@ -14,8 +14,10 @@ Capability parity with /root/reference/nmz/endpoint/rest
 Operator surface at the server root (not under the API root — that is
 the inspector wire): ``GET /metrics`` + ``/metrics.json`` (PR 1),
 ``GET /healthz`` (liveness + active run id), ``GET /traces`` (recorded
-run summaries) and ``GET /traces/<run_id>`` (Chrome-trace JSON;
-``?format=ndjson`` for the diffable line format) — doc/observability.md.
+run summaries), ``GET /traces/<run_id>`` (Chrome-trace JSON;
+``?format=ndjson`` for the diffable line format), and
+``GET /analytics`` (cross-run experiment statistics, ``?format=json``
+default or ``ndjson``) — doc/observability.md.
 
 Implementation: stdlib ThreadingHTTPServer — one thread per in-flight
 request, which long-polling requires anyway; no third-party HTTP stack.
@@ -207,6 +209,8 @@ class RestEndpoint(Endpoint):
                             time.monotonic() - endpoint._started_mono, 3),
                         "endpoint": endpoint.NAME,
                     })
+                if url.path == "/analytics":
+                    return self._get_analytics(parse_qs(url.query))
                 m = _TRACES_RE.match(url.path)
                 if m:
                     return self._get_traces(m.group(1), parse_qs(url.query))
@@ -218,6 +222,43 @@ class RestEndpoint(Endpoint):
                 if action is None:
                     return self._reply(204)
                 self._reply(200, action.to_jsonable())
+
+            def _get_analytics(self, query) -> None:
+                """Experiment-analytics surface (obs/analytics.py): the
+                registered storage's cross-run statistics joined with
+                this process's recorded runs — the same payload
+                ``nmz-tpu tools report`` renders."""
+                fmt = (query.get("format") or ["json"])[0]
+                if fmt not in ("json", "ndjson"):
+                    return self._reply(
+                        400, {"error": f"unknown format {fmt!r}; known: "
+                              "json, ndjson"})
+                # top/window mirror the CLI's --top/--window so a remote
+                # `tools report --url` request is not silently computed
+                # with different parameters than a local one
+                params = {}
+                for name, default in (
+                        ("top", obs.analytics.DEFAULT_TOP),
+                        ("window", obs.analytics.DEFAULT_WINDOW)):
+                    raw = (query.get(name) or [None])[0]
+                    try:
+                        params[name] = default if raw is None \
+                            else max(1, int(raw))
+                    except ValueError:
+                        return self._reply(
+                            400, {"error": f"bad {name}={raw!r} "
+                                  "(want a positive integer)"})
+                try:
+                    payload = obs.analytics_payload(**params)
+                except Exception as e:  # never let a stats bug kill ops
+                    log.exception("analytics payload failed")
+                    return self._reply(
+                        500, {"error": f"analytics failed: {e}"})
+                if fmt == "ndjson":
+                    return self._reply_raw(
+                        200, obs.report.render_ndjson(payload).encode(),
+                        "application/x-ndjson")
+                self._reply(200, payload)
 
             def _get_traces(self, run_id, query) -> None:
                 """Flight-recorder surface: run list, or one run as

@@ -246,9 +246,16 @@ class SearchBase:
             distinct_failures=self.distinct_failure_signatures(),
         )
         # flight recorder: the round lands on the run's search track and
-        # advances the generation id that tags each policy decision
-        obs.record_generation(self.BACKEND, generations, elapsed,
-                              best_fitness)
+        # advances the generation id that tags each policy decision;
+        # archive occupancies ride along so the experiment plane can
+        # reconstruct convergence/novelty trends per round
+        # (obs/analytics.py convergence_stats)
+        obs.record_generation(
+            self.BACKEND, generations, elapsed, best_fitness,
+            archive_entries=min(self._archive_n, self.cfg.archive_size),
+            failure_entries=min(self._failure_n, self.cfg.failure_size),
+            distinct_failures=self.distinct_failure_signatures(),
+        )
 
     def labeled_archive(self):
         """(feats [N,K], labels [N]) of the populated archive slots whose

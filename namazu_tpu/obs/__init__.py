@@ -11,20 +11,26 @@
 * :mod:`namazu_tpu.obs.recorder` — the flight recorder: bounded per-run
   event-timeline capture with run-correlated structured records;
 * :mod:`namazu_tpu.obs.export` — Chrome-trace/Perfetto + NDJSON
-  exporters and the dispatch-order differ over recorded runs.
+  exporters and the dispatch-order differ over recorded runs;
+* :mod:`namazu_tpu.obs.analytics` — the experiment plane: cross-run
+  exploration coverage, reproduction-rate stats, search convergence +
+  stall detection, fault-localization ranking;
+* :mod:`namazu_tpu.obs.report` — Markdown/NDJSON renderers for the
+  analytics payload.
 
 Exposure: ``GET /metrics`` + ``/metrics.json``, ``GET /traces`` +
-``/traces/<run_id>``, and ``GET /healthz`` on the REST endpoint
-(endpoint/rest.py), plus ``nmz-tpu tools metrics`` and ``nmz-tpu tools
-trace {list,dump,diff,export}`` (cli/tools_cmd.py). Disable with
-``obs_enabled = false`` in the experiment config. Metric names, the
-trace record schema, and run-id correlation rules are documented in
-doc/observability.md.
+``/traces/<run_id>``, ``GET /analytics``, and ``GET /healthz`` on the
+REST endpoint (endpoint/rest.py), plus ``nmz-tpu tools metrics``,
+``nmz-tpu tools trace {list,dump,diff,export}``, and ``nmz-tpu tools
+report`` (cli/tools_cmd.py). Disable with ``obs_enabled = false`` in
+the experiment config. Metric names, the trace record schema, the
+analytics payload schema, and run-id correlation rules are documented
+in doc/observability.md.
 """
 
 from __future__ import annotations
 
-from namazu_tpu.obs import export, metrics, recorder  # noqa: F401
+from namazu_tpu.obs import analytics, export, metrics, recorder, report  # noqa: F401
 from namazu_tpu.obs.recorder import (  # noqa: F401
     FlightRecorder,
     begin_run,
@@ -57,6 +63,7 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     action_dispatched,
     carry,
     event_intercepted,
+    experiment_stats,
     latency,
     mark,
     policy_decision,
@@ -70,6 +77,7 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     scorer_throughput_value,
     search_phase,
     search_round,
+    search_stall,
     sidecar_request,
     span,
 )
@@ -108,3 +116,17 @@ def trace_run(run_id: str):
     """The recorded :class:`~namazu_tpu.obs.recorder.RunTrace` for
     ``run_id`` ("latest" = most recently begun), or None."""
     return recorder.recorder().run(run_id)
+
+
+def set_analytics_storage(dir_path) -> None:
+    """Register the experiment storage dir the live ``GET /analytics``
+    route aggregates over (``nmz-tpu run`` calls this with its storage;
+    None unregisters)."""
+    analytics.set_storage_dir(dir_path)
+
+
+def analytics_payload(top: int = analytics.DEFAULT_TOP,
+                      window: int = analytics.DEFAULT_WINDOW) -> dict:
+    """The experiment-analytics document (the ``GET /analytics`` body):
+    the registered storage joined with this process's recorded runs."""
+    return analytics.payload(top=top, window=window)

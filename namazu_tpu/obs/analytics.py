@@ -1,0 +1,517 @@
+"""Experiment analytics: cross-run statistics over a HistoryStorage.
+
+PR 1 (metrics) answers "how many / how fast right now"; PR 2 (flight
+recorder) answers "what order did run X execute". This module is the
+third tier — the *experiment* plane — answering the cross-run questions
+neither instantaneous gauges nor per-run timelines can: is the search
+exploring new interleavings or replaying old ones, is time-to-first-
+reproduction shrinking, has the search plane gone dead, and which
+branches diverge between successful and failed runs.
+
+Four statistic families, one payload (``compute_payload``):
+
+* **coverage** — distinct-interleaving coverage via the search plane's
+  own ``trace_digest`` (models/failure_pool.py: hint/entity sequence,
+  timing-invariant), the unique-digest growth curve, and the novelty
+  rate per window of runs (the saturation signal: a window that adds
+  no new digest means the schedule source is replaying itself);
+* **reproduction** — failure rate with a Wilson 95% interval (run
+  counts are small; a normal approximation would lie), mean runs to
+  reproduce, time-to-first-failure, repros/hour;
+* **convergence** — best-fitness and archive-occupancy trends from the
+  flight recorder's generation records, plus stall detection: the
+  search is stalled when fitness AND novelty both flatline over the
+  last ``STALL_WINDOW`` rounds (either alone is normal — fitness
+  plateaus while the archive diversifies, novelty pauses while fitness
+  climbs);
+* **fault localization** — the analyzer's success/failure divergence
+  ranking (namazu_tpu/analyzer.py), the reference's "Suspicious:" list.
+
+The same payload is served by ``GET /analytics`` on the REST endpoint
+(the orchestrator process registers its storage dir via
+``set_storage_dir``), rendered by ``nmz-tpu tools report``
+(obs/report.py), and published as ``nmz_experiment_*`` gauges so a
+scraper can chart cross-run trends live. The live stall detector
+(``note_search_round``, fed by ``obs.search_round``) trips the
+``nmz_search_stall`` gauge and a run-tagged warning as soon as a search
+goes dead — before the report stage. Schema and metric names:
+doc/observability.md ("Experiment analytics").
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from namazu_tpu.obs import spans
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("obs.analytics")
+
+__all__ = [
+    "DEFAULT_TOP", "DEFAULT_WINDOW", "STALL_WINDOW", "STALL_REL_EPS",
+    "wilson_interval", "detect_stall", "trace_digest_of",
+    "coverage_stats", "reproduction_stats", "entity_stats",
+    "convergence_stats", "suspicious_branches", "compute_payload",
+    "payload", "set_storage_dir", "storage_dir",
+    "StallDetector", "note_search_round", "reset_stall_detector",
+]
+
+#: suspicious-branch rows kept in the payload
+DEFAULT_TOP = 20
+#: runs per novelty window (the saturation curve's resolution)
+DEFAULT_WINDOW = 8
+#: search rounds both fitness and novelty must flatline over to stall
+STALL_WINDOW = 8
+#: relative fitness improvement below which a window counts as flat
+STALL_REL_EPS = 1e-3
+#: per-entity table rows kept before folding into "_other"
+MAX_ENTITY_ROWS = 16
+
+
+# -- building blocks -------------------------------------------------------
+
+def wilson_interval(k: int, n: int, z: float = 1.96) -> Tuple[float, float]:
+    """Wilson score interval for a proportion of ``k`` hits in ``n``
+    trials. Correct at the tiny n this system lives at (10-run
+    experiments), where the normal approximation collapses to [p, p]."""
+    if n <= 0:
+        return (0.0, 0.0)
+    p = k / n
+    denom = 1.0 + z * z / n
+    center = (p + z * z / (2 * n)) / denom
+    half = (z / denom) * math.sqrt(p * (1 - p) / n + z * z / (4 * n * n))
+    return (max(0.0, center - half), min(1.0, center + half))
+
+
+def detect_stall(fitness: List[float],
+                 novelty: Optional[List[float]] = None,
+                 window: int = STALL_WINDOW,
+                 rel_eps: float = STALL_REL_EPS) -> bool:
+    """True when the last ``window`` search rounds improved neither best
+    fitness (relative improvement <= ``rel_eps``) nor novelty (the
+    distinct-failure count is unchanged). ``novelty=None`` (no novelty
+    series recorded) degrades to fitness-only detection."""
+    if len(fitness) < window:
+        return False
+    recent = fitness[-window:]
+    scale = max(1.0, abs(recent[0]))
+    fit_flat = (max(recent) - recent[0]) <= rel_eps * scale
+    if not fit_flat:
+        return False
+    if novelty is None or len(novelty) < window:
+        return True
+    return novelty[-1] <= novelty[-window]
+
+
+def trace_digest_of(trace) -> str:
+    """Content digest of one stored trace — the SAME digest the search
+    plane dedupes failure signatures by (models/failure_pool.py), so
+    "unique interleavings" here and ``failure_distinct`` in the archive
+    gauges count in one currency. Imported lazily: the digest needs the
+    numpy featurizer, and the analytics module itself must stay
+    importable from stdlib-only control-plane processes."""
+    from namazu_tpu.models.failure_pool import trace_digest
+    from namazu_tpu.ops import trace_encoding as te
+
+    return trace_digest(te.encode_trace(trace))
+
+
+# -- per-storage statistics ------------------------------------------------
+
+#: digest memo keyed by (storage dir, run index): a completed run's
+#: trace is immutable, so its digest never changes — without this every
+#: /analytics scrape re-runs the numpy featurizer + sha256 over EVERY
+#: stored run, a per-scrape cost that grows linearly with the experiment
+_digest_cache: Dict[Tuple[str, int], str] = {}
+_digest_cache_lock = threading.Lock()
+_DIGEST_CACHE_MAX = 65536
+
+
+def _run_digest(storage, i: int, trace) -> str:
+    key_dir = getattr(storage, "dir", None)
+    if key_dir is None:  # storage without a stable identity: no memo
+        return trace_digest_of(trace)
+    key = (key_dir, i)
+    with _digest_cache_lock:
+        hit = _digest_cache.get(key)
+    if hit is not None:
+        return hit
+    digest = trace_digest_of(trace)
+    with _digest_cache_lock:
+        if len(_digest_cache) >= _DIGEST_CACHE_MAX:
+            _digest_cache.clear()
+        _digest_cache[key] = digest
+    return digest
+
+
+def coverage_stats(storage, window: int = DEFAULT_WINDOW) -> Dict[str, Any]:
+    """Distinct-interleaving coverage of a storage's recorded runs."""
+    n = storage.nr_stored_histories()
+    digests: List[str] = []
+    missing = 0
+    digest_errors = 0
+    for i in range(n):
+        try:
+            trace = storage.get_stored_history(i)
+        except Exception:
+            missing += 1  # crashed run: no trace.json on disk
+            continue
+        try:
+            digests.append(_run_digest(storage, i, trace))
+        except Exception:
+            # an environment problem (featurizer import, numpy), NOT
+            # empty data — report it as its own bucket so a broken
+            # install cannot masquerade as "N runs without a trace"
+            if not digest_errors:
+                log.exception("trace digest failed for run %d; coverage "
+                              "will undercount", i)
+            digest_errors += 1
+    seen: set = set()
+    curve: List[int] = []
+    for d in digests:
+        seen.add(d)
+        curve.append(len(seen))
+    novelty: List[float] = []
+    prior: set = set()
+    for start in range(0, len(digests), window):
+        chunk = digests[start:start + window]
+        fresh = len({d for d in chunk} - prior)
+        novelty.append(round(fresh / len(chunk), 3))
+        prior.update(chunk)
+    unique = len(seen)
+    return {
+        "runs": len(digests),
+        "runs_without_trace": missing,
+        "digest_errors": digest_errors,
+        "unique_interleavings": unique,
+        "coverage": round(unique / len(digests), 4) if digests else 0.0,
+        "curve": curve,
+        "window": window,
+        "novelty_per_window": novelty,
+        "saturated": len(novelty) >= 2 and novelty[-1] == 0.0,
+    }
+
+
+def reproduction_stats(storage) -> Dict[str, Any]:
+    """Failure (= bug reproduction) statistics across a storage's runs."""
+    n = storage.nr_stored_histories()
+    outcomes: List[Tuple[bool, float]] = []
+    for i in range(n):
+        try:
+            outcomes.append((storage.is_successful(i),
+                             storage.get_required_time(i)))
+        except Exception:
+            continue
+    runs = len(outcomes)
+    failures = sum(1 for ok, _ in outcomes if not ok)
+    total_time = sum(t for _, t in outcomes)
+    lo, hi = wilson_interval(failures, runs)
+    ttff = None
+    first_failure = None
+    acc = 0.0
+    for i, (ok, t) in enumerate(outcomes):
+        acc += t
+        if not ok:
+            ttff, first_failure = round(acc, 3), i
+            break
+    rate = failures / runs if runs else 0.0
+    stats: Dict[str, Any] = {
+        "runs": runs,
+        "failures": failures,
+        "failure_rate": round(rate, 4),
+        "failure_rate_ci95": [round(lo, 4), round(hi, 4)],
+        "mean_runs_to_reproduce": (round(runs / failures, 2)
+                                   if failures else None),
+        # inverse of the rate interval: the pessimistic end of "how many
+        # more runs until the next repro" is what an experiment budget
+        # is planned against
+        "runs_to_reproduce_ci95": ([round(1.0 / hi, 2), round(1.0 / lo, 2)]
+                                   if failures and lo > 0 else None),
+        "time_to_first_failure_s": ttff,
+        "first_failure_run": first_failure,
+        "total_time_s": round(total_time, 3),
+        "repros_per_hour": (round(failures / (total_time / 3600.0), 1)
+                            if total_time > 0 else 0.0),
+    }
+    return stats
+
+
+def entity_stats(storage,
+                 max_rows: int = MAX_ENTITY_ROWS) -> List[Dict[str, Any]]:
+    """Per-entity event totals across all recorded traces, busiest
+    first; entities past ``max_rows`` fold into one ``_other`` row (same
+    cardinality stance as the metric plane's entity-label cap)."""
+    counts: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+    for i in range(storage.nr_stored_histories()):
+        try:
+            trace = storage.get_stored_history(i)
+        except Exception:
+            continue
+        seen_here: set = set()
+        for a in trace:
+            row = counts.get(a.entity_id)
+            if row is None:
+                row = counts[a.entity_id] = {
+                    "entity": a.entity_id, "events": 0,
+                    "classes": set(), "runs": 0,
+                }
+            row["events"] += 1
+            row["classes"].add(a.event_class or a.class_name())
+            if a.entity_id not in seen_here:
+                seen_here.add(a.entity_id)
+                row["runs"] += 1
+    rows = sorted(counts.values(),
+                  key=lambda r: (-r["events"], r["entity"]))
+    out = [{"entity": r["entity"], "events": r["events"],
+            "classes": len(r["classes"]), "runs": r["runs"]}
+           for r in rows[:max_rows]]
+    if len(rows) > max_rows:
+        rest = rows[max_rows:]
+        out.append({
+            "entity": "_other",
+            "events": sum(r["events"] for r in rest),
+            "classes": len(set().union(*(r["classes"] for r in rest))),
+            "runs": max(r["runs"] for r in rest),
+        })
+    return out
+
+
+# -- recorder-derived statistics -------------------------------------------
+
+def convergence_stats(recorder_runs,
+                      window: int = STALL_WINDOW) -> Dict[str, Any]:
+    """Search-plane convergence from the flight recorder's generation
+    records (obs/recorder.py ``record_generation``/``record_install``),
+    concatenated across the recorded runs in ring order."""
+    fitness: Dict[str, List[float]] = {}
+    archive: Dict[str, List[float]] = {}
+    novelty: Dict[str, List[float]] = {}
+    generations: Dict[str, int] = {}
+    installs: Dict[str, int] = {}
+    rounds = 0
+    for run in recorder_runs or []:
+        snap = run.snapshot()
+        for g in snap["generations"]:
+            if g.get("kind") == "generation":
+                rounds += 1
+                b = g.get("backend", "?")
+                fitness.setdefault(b, []).append(
+                    float(g.get("best_fitness", 0.0)))
+                generations[b] = max(generations.get(b, 0),
+                                     int(g.get("gen_end", 0)))
+                if g.get("archive_entries") is not None:
+                    archive.setdefault(b, []).append(
+                        float(g["archive_entries"]))
+                if g.get("distinct_failures") is not None:
+                    novelty.setdefault(b, []).append(
+                        float(g["distinct_failures"]))
+            elif g.get("kind") == "install":
+                src = g.get("source", "?")
+                installs[src] = installs.get(src, 0) + 1
+    backends: Dict[str, Any] = {}
+    for b in sorted(fitness):
+        fit = fitness[b]
+        backends[b] = {
+            "rounds": len(fit),
+            "generations": generations.get(b, 0),
+            "best_fitness": round(max(fit), 6),
+            "fitness_curve": [round(v, 6) for v in fit[-64:]],
+            "archive_curve": [int(v) for v in archive.get(b, [])[-64:]],
+            "novelty_curve": [int(v) for v in novelty.get(b, [])[-64:]],
+            "stalled": detect_stall(fit, novelty.get(b), window=window),
+        }
+    return {
+        "search_rounds": rounds,
+        "installs": dict(sorted(installs.items())),
+        "backends": backends,
+        "stalled": any(v["stalled"] for v in backends.values()),
+    }
+
+
+def suspicious_branches(storage, top: int = DEFAULT_TOP
+                        ) -> List[Dict[str, Any]]:
+    """The analyzer's divergence ranking as payload rows."""
+    from namazu_tpu.analyzer import analyze_storage
+
+    return [
+        {"branch": b, "divergence": round(div, 4),
+         "fail_hit_rate": round(fr, 4), "success_hit_rate": round(sr, 4)}
+        for b, div, fr, sr in analyze_storage(storage, top=top)
+    ]
+
+
+# -- the payload -----------------------------------------------------------
+
+def compute_payload(storage=None, recorder_runs=None,
+                    top: int = DEFAULT_TOP, window: int = DEFAULT_WINDOW,
+                    publish: bool = True) -> Dict[str, Any]:
+    """The full analytics document: deterministic for a given storage +
+    recorder state (no wall-clock stamps — two computations over the
+    same inputs compare equal, which the golden-file test and the
+    REST-vs-CLI parity check both lean on)."""
+    if storage is not None:
+        coverage = coverage_stats(storage, window=window)
+        repro = reproduction_stats(storage)
+        entities = entity_stats(storage)
+        suspicious = suspicious_branches(storage, top=top)
+    else:
+        coverage = {"runs": 0, "runs_without_trace": 0,
+                    "digest_errors": 0,
+                    "unique_interleavings": 0, "coverage": 0.0,
+                    "curve": [], "window": window,
+                    "novelty_per_window": [], "saturated": False}
+        repro = reproduction_stats(_EmptyStorage())
+        entities = []
+        suspicious = []
+    convergence = convergence_stats(recorder_runs, window=STALL_WINDOW)
+    doc = {
+        "schema": "nmz-analytics-v1",
+        "experiment": {
+            "runs": repro["runs"],
+            "failures": repro["failures"],
+            "entities": len(entities),
+            "search_rounds": convergence["search_rounds"],
+        },
+        "coverage": coverage,
+        "reproduction": repro,
+        "entities": entities,
+        "convergence": convergence,
+        "suspicious": suspicious,
+    }
+    if publish:
+        spans.experiment_stats(
+            runs=repro["runs"],
+            failures=repro["failures"],
+            failure_rate=repro["failure_rate"],
+            unique_interleavings=coverage["unique_interleavings"],
+            coverage=coverage["coverage"],
+            novelty_last_window=(coverage["novelty_per_window"][-1]
+                                 if coverage["novelty_per_window"]
+                                 else None),
+            time_to_first_failure_s=repro["time_to_first_failure_s"],
+            mean_runs_to_reproduce=repro["mean_runs_to_reproduce"],
+        )
+    return doc
+
+
+class _EmptyStorage:
+    """Zero-run stand-in so the no-storage payload shares one code path."""
+
+    def nr_stored_histories(self) -> int:
+        return 0
+
+
+# -- process-global wiring (the REST /analytics source) --------------------
+
+_storage_dir: Optional[str] = None
+
+
+def set_storage_dir(dir_path: Optional[str]) -> None:
+    """Register the experiment storage the live ``/analytics`` route
+    aggregates over (``nmz-tpu run`` registers its storage dir; embedded
+    orchestrators and tests may register any initialized storage)."""
+    global _storage_dir
+    _storage_dir = dir_path or None
+
+
+def storage_dir() -> Optional[str]:
+    return _storage_dir
+
+
+def payload(top: int = DEFAULT_TOP,
+            window: int = DEFAULT_WINDOW) -> Dict[str, Any]:
+    """The live analytics document: the registered storage (when one is
+    registered and loadable) joined with this process's flight-recorder
+    runs. Storage trouble degrades to a recorder-only payload rather
+    than failing the route — a mid-experiment scrape must not 500
+    because a run dir is being written."""
+    st = None
+    d = _storage_dir
+    if d:
+        try:
+            from namazu_tpu.storage import load_storage
+
+            st = load_storage(d)
+        except Exception:
+            log.warning("analytics storage %s unreadable; serving "
+                        "recorder-only payload", d, exc_info=True)
+    from namazu_tpu.obs import recorder as _recorder
+
+    try:
+        return compute_payload(storage=st,
+                               recorder_runs=_recorder.recorder().runs(),
+                               top=top, window=window)
+    finally:
+        if st is not None:
+            st.close()
+
+
+# -- live stall detection --------------------------------------------------
+
+class StallDetector:
+    """Per-backend sliding window over (best_fitness, distinct_failures)
+    search rounds; trips when both flatline (``detect_stall``). Fed by
+    ``obs.search_round`` on every round, so a dead search surfaces as
+    the ``nmz_search_stall`` gauge and one run-tagged warning while the
+    experiment is still running — not in the post-hoc report."""
+
+    def __init__(self, window: int = STALL_WINDOW,
+                 rel_eps: float = STALL_REL_EPS) -> None:
+        self.window = window
+        self.rel_eps = rel_eps
+        self._lock = threading.Lock()
+        self._fitness: Dict[str, deque] = {}
+        self._novelty: Dict[str, deque] = {}
+        self._stalled: Dict[str, bool] = {}
+
+    def update(self, backend: str, best_fitness: float,
+               distinct_failures: float) -> Tuple[bool, bool]:
+        """Feed one round; returns (stalled, changed-since-last-round)."""
+        with self._lock:
+            fit = self._fitness.setdefault(
+                backend, deque(maxlen=self.window))
+            nov = self._novelty.setdefault(
+                backend, deque(maxlen=self.window))
+            fit.append(float(best_fitness))
+            nov.append(float(distinct_failures))
+            stalled = detect_stall(list(fit), list(nov),
+                                   window=self.window,
+                                   rel_eps=self.rel_eps)
+            changed = stalled != self._stalled.get(backend, False)
+            self._stalled[backend] = stalled
+            return stalled, changed
+
+
+_stall_detector = StallDetector()
+
+
+def reset_stall_detector(window: int = STALL_WINDOW,
+                         rel_eps: float = STALL_REL_EPS) -> StallDetector:
+    """Fresh detector (tests); returns it."""
+    global _stall_detector
+    _stall_detector = StallDetector(window, rel_eps)
+    return _stall_detector
+
+
+def note_search_round(backend: str, best_fitness: float,
+                      distinct_failures: float) -> bool:
+    """Live stall hook (called by ``obs.search_round``): updates the
+    detector, mirrors the verdict into ``nmz_search_stall{backend}``,
+    and logs the stall/recovery transitions (run-tagged via the log
+    plane's ``[run_id]`` filter)."""
+    stalled, changed = _stall_detector.update(
+        backend, best_fitness, distinct_failures)
+    spans.search_stall(backend, stalled)
+    if changed and stalled:
+        log.warning(
+            "search plane stalled: backend=%s best_fitness and "
+            "distinct-failure novelty both flat over the last %d rounds "
+            "(best=%.6g, distinct_failures=%d) — the schedule source is "
+            "replaying itself", backend, _stall_detector.window,
+            best_fitness, int(distinct_failures))
+    elif changed:
+        log.info("search plane resumed progress (backend=%s)", backend)
+    return stalled

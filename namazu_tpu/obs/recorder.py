@@ -320,6 +320,13 @@ def reset(max_runs: int = 8, max_records: int = 4096) -> FlightRecorder:
 
 
 def begin_run(run_id: Optional[str] = None) -> str:
+    # a new run means a new search: clear the stall detector's
+    # fitness/novelty windows so run A's final plateau (or its absolute
+    # fitness scale) cannot read as run B's stall during B's healthy
+    # early rounds — the ab harness runs many experiments per process
+    from namazu_tpu.obs import analytics
+
+    analytics.reset_stall_detector()
     return _recorder.begin_run(run_id)
 
 
@@ -438,9 +445,16 @@ def record_acked(action, now: Optional[float] = None) -> None:
 
 def record_generation(backend: str, generations: int, elapsed: float,
                       best_fitness: float,
-                      now: Optional[float] = None) -> None:
+                      now: Optional[float] = None,
+                      archive_entries: Optional[int] = None,
+                      failure_entries: Optional[int] = None,
+                      distinct_failures: Optional[int] = None) -> None:
     """One ``search.run()`` round: advances the process generation
-    counter and logs the round on the run's search track."""
+    counter and logs the round on the run's search track. The optional
+    archive occupancies feed the experiment plane's convergence/stall
+    analysis (obs/analytics.py convergence_stats) — recorded only when
+    the caller supplies them, so pre-existing traces and exporters see
+    the same entries as before."""
     if not metrics.enabled():
         return
     gen_end = _recorder.advance_generations(generations)
@@ -448,7 +462,7 @@ def record_generation(backend: str, generations: int, elapsed: float,
     if run is None:
         return
     end = time.monotonic() if now is None else now
-    run.add_generation({
+    entry = {
         "kind": "generation",
         "backend": backend,
         "gen_start": gen_end - generations,
@@ -456,7 +470,14 @@ def record_generation(backend: str, generations: int, elapsed: float,
         "t_start": end - elapsed,
         "t_end": end,
         "best_fitness": best_fitness,
-    })
+    }
+    if archive_entries is not None:
+        entry["archive_entries"] = int(archive_entries)
+    if failure_entries is not None:
+        entry["failure_entries"] = int(failure_entries)
+    if distinct_failures is not None:
+        entry["distinct_failures"] = int(distinct_failures)
+    run.add_generation(entry)
 
 
 def record_install(source: str, generation: Optional[int] = None,

@@ -1,0 +1,174 @@
+"""Render an analytics payload (obs/analytics.py) for humans and tools.
+
+Three forms of one document:
+
+* ``render_markdown`` — the ``nmz-tpu tools report`` default: a
+  self-contained report with per-entity tables, sparkline-style text
+  curves (coverage growth, novelty per window, fitness trend), and the
+  top-N suspicious-branch table;
+* ``render_ndjson`` — one JSON line per section, diffable and greppable
+  (the ``GET /analytics?format=ndjson`` body);
+* plain JSON is just ``json.dumps(payload)`` — no renderer needed.
+
+Everything here is a pure function of the payload: no wall-clock reads,
+no storage access — the golden-file test renders a fixed payload and
+compares bytes.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Sequence
+
+__all__ = ["sparkline", "render_markdown", "render_ndjson"]
+
+_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: Sequence[float]) -> str:
+    """Text sparkline of a numeric series (empty series -> "")."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _BLOCKS[0] * len(vals)
+    span = hi - lo
+    return "".join(
+        _BLOCKS[min(len(_BLOCKS) - 1,
+                    int((v - lo) / span * (len(_BLOCKS) - 1) + 0.5))]
+        for v in vals)
+
+
+def _num(v: Any) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return "yes" if v else "no"
+    if isinstance(v, float):
+        return f"{v:g}"
+    return str(v)
+
+
+def _ci(ci) -> str:
+    if not ci:
+        return "-"
+    return f"{_num(ci[0])} – {_num(ci[1])}"
+
+
+def render_markdown(payload: Dict[str, Any]) -> str:
+    """The full report as GitHub-flavored Markdown."""
+    exp = payload.get("experiment", {})
+    cov = payload.get("coverage", {})
+    rep = payload.get("reproduction", {})
+    conv = payload.get("convergence", {})
+    entities = payload.get("entities", [])
+    suspicious = payload.get("suspicious", [])
+
+    lines: List[str] = []
+    out = lines.append
+    out("# Experiment analytics")
+    out("")
+    out("## Summary")
+    out("")
+    out("| runs | failures | failure rate | unique interleavings "
+        "| coverage | search rounds |")
+    out("|---:|---:|---:|---:|---:|---:|")
+    out(f"| {_num(exp.get('runs'))} | {_num(exp.get('failures'))} "
+        f"| {_num(rep.get('failure_rate'))} "
+        f"| {_num(cov.get('unique_interleavings'))} "
+        f"| {_num(cov.get('coverage'))} "
+        f"| {_num(exp.get('search_rounds'))} |")
+    out("")
+
+    out("## Exploration coverage")
+    out("")
+    extra = ""
+    if cov.get("digest_errors"):
+        extra = f", {_num(cov['digest_errors'])} digest errors"
+    out(f"- unique interleavings: {_num(cov.get('unique_interleavings'))} "
+        f"/ {_num(cov.get('runs'))} runs "
+        f"(coverage {_num(cov.get('coverage'))}, "
+        f"{_num(cov.get('runs_without_trace'))} without a trace{extra})")
+    out(f"- unique-digest growth: `{sparkline(cov.get('curve', []))}` "
+        f"{cov.get('curve', [])}")
+    out(f"- novelty per window (w={_num(cov.get('window'))}): "
+        f"`{sparkline(cov.get('novelty_per_window', []))}` "
+        f"{cov.get('novelty_per_window', [])}")
+    out(f"- saturated: {_num(cov.get('saturated', False))}")
+    out("")
+
+    out("## Reproduction")
+    out("")
+    out(f"- failure rate: {_num(rep.get('failure_rate'))} "
+        f"(Wilson 95% CI {_ci(rep.get('failure_rate_ci95'))})")
+    out(f"- mean runs to reproduce: "
+        f"{_num(rep.get('mean_runs_to_reproduce'))} "
+        f"(CI {_ci(rep.get('runs_to_reproduce_ci95'))})")
+    ttff = rep.get("time_to_first_failure_s")
+    if ttff is None:
+        out("- time to first failure: - (no failures recorded)")
+    else:
+        out(f"- time to first failure: {_num(ttff)} s "
+            f"(run {_num(rep.get('first_failure_run'))})")
+    out(f"- repros/hour: {_num(rep.get('repros_per_hour'))} "
+        f"(total {_num(rep.get('total_time_s'))} s)")
+    out("")
+
+    out("## Per-entity events")
+    out("")
+    if entities:
+        out("| entity | events | classes | runs |")
+        out("|---|---:|---:|---:|")
+        for row in entities:
+            out(f"| {row['entity']} | {row['events']} "
+                f"| {row['classes']} | {row['runs']} |")
+    else:
+        out("- no recorded traces")
+    out("")
+
+    out("## Search convergence")
+    out("")
+    if conv.get("search_rounds"):
+        installs = ", ".join(f"{k}={v}" for k, v
+                             in conv.get("installs", {}).items()) or "-"
+        out(f"- rounds: {_num(conv.get('search_rounds'))}; "
+            f"installs: {installs}")
+        for name, b in conv.get("backends", {}).items():
+            out(f"- `{name}`: best fitness {_num(b.get('best_fitness'))} "
+                f"over {_num(b.get('rounds'))} rounds "
+                f"({_num(b.get('generations'))} generations); "
+                f"fitness `{sparkline(b.get('fitness_curve', []))}` "
+                f"archive `{sparkline(b.get('archive_curve', []))}` "
+                f"novelty `{sparkline(b.get('novelty_curve', []))}`; "
+                f"stalled: {_num(b.get('stalled', False))}")
+        out(f"- stalled: {_num(conv.get('stalled', False))}")
+    else:
+        out("- no search-plane records (run under a search policy with "
+            "observability enabled, or pass --url for a live "
+            "orchestrator)")
+    out("")
+
+    out("## Suspicious branches")
+    out("")
+    if suspicious:
+        out("| branch | divergence | failure hit-rate "
+            "| success hit-rate |")
+        out("|---|---:|---:|---:|")
+        for row in suspicious:
+            out(f"| {row['branch']} | {_num(row['divergence'])} "
+                f"| {_num(row['fail_hit_rate'])} "
+                f"| {_num(row['success_hit_rate'])} |")
+    else:
+        out("- no coverage data recorded (runs write coverage.json — "
+            "see namazu_tpu/analyzer.py)")
+    out("")
+    return "\n".join(lines)
+
+
+def render_ndjson(payload: Dict[str, Any]) -> str:
+    """One JSON line per payload section (insertion order), each
+    ``{"section": name, "data": ...}`` — greppable and diffable."""
+    lines = [json.dumps({"section": k, "data": v}, sort_keys=True)
+             for k, v in payload.items()]
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -59,8 +59,20 @@ SEARCH_ARCHIVE = "nmz_search_archive_entries"
 SEARCH_INSTALLS = "nmz_search_installs_total"
 SCORER_THROUGHPUT = "nmz_scorer_schedules_per_sec"
 SEARCH_PHASE = "nmz_search_phase_seconds"
+SEARCH_STALL = "nmz_search_stall"
 SIDECAR_REQUESTS = "nmz_sidecar_requests_total"
 ENTITY_LABEL_OVERFLOW = "nmz_entity_label_overflow_total"
+
+# experiment plane (cross-run aggregates, set by obs/analytics.py when a
+# payload is computed — GET /analytics, nmz-tpu tools report)
+EXPERIMENT_RUNS = "nmz_experiment_runs"
+EXPERIMENT_FAILURES = "nmz_experiment_failures"
+EXPERIMENT_FAILURE_RATE = "nmz_experiment_failure_rate"
+EXPERIMENT_UNIQUE = "nmz_experiment_unique_interleavings"
+EXPERIMENT_COVERAGE = "nmz_experiment_interleaving_coverage"
+EXPERIMENT_NOVELTY = "nmz_experiment_novelty_last_window"
+EXPERIMENT_TTFF = "nmz_experiment_time_to_first_failure_seconds"
+EXPERIMENT_RUNS_TO_REPRO = "nmz_experiment_mean_runs_to_reproduce"
 
 
 #: distinct ``entity`` label values admitted per registry before new
@@ -275,6 +287,63 @@ def search_round(backend: str, generations: int, elapsed: float,
     arch.labels(backend=backend, archive="failure").set(failure_entries)
     arch.labels(backend=backend,
                 archive="failure_distinct").set(distinct_failures)
+    # live stall detection (obs/analytics.py): fitness + novelty sliding
+    # window per backend; trips nmz_search_stall and a run-tagged
+    # warning while the experiment is still running. Lazy import — the
+    # analytics module imports this one for the metric vocabulary.
+    from namazu_tpu.obs import analytics
+
+    analytics.note_search_round(backend, best_fitness, distinct_failures)
+
+
+def search_stall(backend: str, stalled: bool) -> None:
+    """Mirror the live stall detector's verdict (obs/analytics.py) into
+    ``nmz_search_stall{backend}`` (1 = novelty and fitness both flat
+    over the detector window, 0 = progressing)."""
+    if not metrics.enabled():
+        return
+    metrics.get().gauge(
+        SEARCH_STALL,
+        "search-plane stall detector (1 = fitness and novelty both "
+        "flatlined over the detector window)",
+        ("backend",),
+    ).labels(backend=backend).set(1.0 if stalled else 0.0)
+
+
+def experiment_stats(runs: int, failures: int, failure_rate: float,
+                     unique_interleavings: int, coverage: float,
+                     novelty_last_window: Optional[float],
+                     time_to_first_failure_s: Optional[float],
+                     mean_runs_to_reproduce: Optional[float]) -> None:
+    """Publish one analytics payload's cross-run aggregates as gauges
+    (None values leave their gauge untouched rather than faking a 0)."""
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.gauge(EXPERIMENT_RUNS,
+              "completed runs in the analyzed storage").set(runs)
+    reg.gauge(EXPERIMENT_FAILURES,
+              "failed (= bug-reproducing) runs in the analyzed storage",
+              ).set(failures)
+    reg.gauge(EXPERIMENT_FAILURE_RATE,
+              "failure rate over the analyzed storage").set(failure_rate)
+    reg.gauge(EXPERIMENT_UNIQUE,
+              "distinct interleavings (trace_digest) recorded",
+              ).set(unique_interleavings)
+    reg.gauge(EXPERIMENT_COVERAGE,
+              "unique interleavings / runs").set(coverage)
+    if novelty_last_window is not None:
+        reg.gauge(EXPERIMENT_NOVELTY,
+                  "new-interleaving rate of the last analytics window",
+                  ).set(novelty_last_window)
+    if time_to_first_failure_s is not None:
+        reg.gauge(EXPERIMENT_TTFF,
+                  "cumulative run time until the first failure",
+                  ).set(time_to_first_failure_s)
+    if mean_runs_to_reproduce is not None:
+        reg.gauge(EXPERIMENT_RUNS_TO_REPRO,
+                  "runs per reproduction (inverse failure rate)",
+                  ).set(mean_runs_to_reproduce)
 
 
 def schedule_install(source: str) -> None:

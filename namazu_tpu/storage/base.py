@@ -54,6 +54,12 @@ class HistoryStorage:
 
     # -- queries ---------------------------------------------------------
 
+    def run_dir(self, i: int) -> str:
+        """Path of run ``i``'s working directory — the public accessor
+        for per-run artifacts beyond the trace/result pair (e.g. the
+        analyzer's ``coverage.json``, the run's ``nmz.log``)."""
+        raise NotImplementedError
+
     def nr_stored_histories(self) -> int:
         raise NotImplementedError
 

@@ -39,7 +39,10 @@ class NaiveStorage(HistoryStorage):
     def _meta_path(self) -> str:
         return os.path.join(self.dir, "storage.json")
 
-    def _run_dir(self, i: int) -> str:
+    def run_dir(self, i: int) -> str:
+        """Run ``i``'s working dir (%08x layout, parity naive.go:143-158)
+        — the public accessor for per-run artifacts (coverage.json,
+        nmz.log) beyond the trace/result pair."""
         return os.path.join(self.dir, f"{i:08x}")
 
     def _load_meta(self) -> Dict[str, Any]:
@@ -67,7 +70,7 @@ class NaiveStorage(HistoryStorage):
     # -- per-run ---------------------------------------------------------
 
     def create_new_working_dir(self) -> str:
-        run_dir = self._run_dir(self._next_run)
+        run_dir = self.run_dir(self._next_run)
         os.makedirs(run_dir, exist_ok=False)
         self._next_run += 1
         self._save_meta()
@@ -104,19 +107,19 @@ class NaiveStorage(HistoryStorage):
         # count only runs that completed (have a result)
         n = 0
         for i in range(self._next_run):
-            if os.path.exists(os.path.join(self._run_dir(i), "result.json")):
+            if os.path.exists(os.path.join(self.run_dir(i), "result.json")):
                 n = i + 1
         return n
 
     def _result(self, i: int) -> Dict[str, Any]:
-        path = os.path.join(self._run_dir(i), "result.json")
+        path = os.path.join(self.run_dir(i), "result.json")
         if not os.path.exists(path):
             raise StorageError(f"run {i:08x} has no result")
         with open(path) as f:
             return json.load(f)
 
     def get_stored_history(self, i: int) -> SingleTrace:
-        path = os.path.join(self._run_dir(i), "trace.json")
+        path = os.path.join(self.run_dir(i), "trace.json")
         if not os.path.exists(path):
             raise StorageError(f"run {i:08x} has no trace")
         with open(path) as f:

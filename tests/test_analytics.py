@@ -1,0 +1,453 @@
+"""Experiment analytics plane (ISSUE 3): coverage / reproduction /
+convergence statistics, the stall detector (offline + live gauge), the
+golden-file ``tools report`` rendering, REST ``GET /analytics`` parity
+with the CLI payload, the ``nmz_experiment_*`` gauges, and bench.py's
+history + regression gate."""
+
+import importlib.util
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from namazu_tpu import obs
+from namazu_tpu.obs import analytics, metrics, recorder, report, spans
+from namazu_tpu.obs.metrics import MetricsRegistry
+from namazu_tpu.obs.recorder import RunTrace
+from namazu_tpu.signal import PacketEvent
+from namazu_tpu.storage import new_storage
+from namazu_tpu.utils.trace import SingleTrace
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "analytics_report.md")
+
+
+@pytest.fixture(autouse=True)
+def fresh_obs():
+    old_reg = metrics.set_registry(MetricsRegistry())
+    metrics.configure(True)
+    old_rec = recorder.set_recorder(recorder.FlightRecorder())
+    analytics.reset_stall_detector()
+    analytics.set_storage_dir(None)
+    yield
+    metrics.set_registry(old_reg)
+    metrics.configure(True)
+    recorder.set_recorder(old_rec)
+    analytics.reset_stall_detector()
+    analytics.set_storage_dir(None)
+
+
+def _trace(hints, entity="n0"):
+    t = SingleTrace()
+    for j, h in enumerate(hints):
+        ent = entity if isinstance(entity, str) else entity[j % len(entity)]
+        a = PacketEvent.create(ent, ent, "peer", hint=h).default_action()
+        a.mark_triggered()
+        t.append(a)
+    return t
+
+
+def _build_storage(tmp_path, name="st"):
+    """The acceptance storage: 8 runs (4 success, 4 failure), 5 distinct
+    interleavings, coverage.json on all but one run, deterministic
+    required times (time-to-first-failure = 4.5 s at run 2)."""
+    st = new_storage("naive", str(tmp_path / name))
+    st.create()
+    outcomes = [True, True, False, True, False, True, False, False]
+    times = [1.0, 1.5, 2.0, 1.0, 1.5, 1.0, 2.0, 1.5]
+    for i, (ok, t) in enumerate(zip(outcomes, times)):
+        st.create_new_working_dir()
+        # i % 5 keys the interleaving: 8 runs, 5 distinct digests
+        st.record_new_trace(_trace(
+            [f"h{i % 5}", "h-shared"], entity=("n0", "n1")))
+        st.record_result(ok, t)
+        if i != 7:  # one failing run without coverage (skipped, not fatal)
+            cov = {"common": 1}
+            cov["racy" if not ok else "healthy"] = 1
+            with open(os.path.join(st.run_dir(i), "coverage.json"),
+                      "w") as f:
+                json.dump(cov, f)
+    return st
+
+
+def _build_recorder_run():
+    """A deterministic search track: fitness climbs then flatlines while
+    novelty keeps moving (NOT stalled), plus one install."""
+    run = RunTrace("golden-run", max_records=16, now=0.0, wall=0.0)
+    fitness = [0.1, 0.2, 0.3, 0.4, 0.5, 0.5, 0.5, 0.5, 0.5, 0.5]
+    novelty = [1, 1, 2, 2, 3, 3, 3, 4, 4, 5]
+    for i, (f, n) in enumerate(zip(fitness, novelty)):
+        run.add_generation({
+            "kind": "generation", "backend": "ga",
+            "gen_start": i * 64, "gen_end": (i + 1) * 64,
+            "t_start": float(i), "t_end": i + 0.5,
+            "best_fitness": f, "archive_entries": 4 * (i + 1),
+            "failure_entries": n, "distinct_failures": n,
+        })
+    run.add_generation({"kind": "install", "source": "search",
+                        "generation": 640, "t": 10.0})
+    run.ended_mono = 11.0
+    return run
+
+
+# -- building blocks ------------------------------------------------------
+
+
+def test_wilson_interval_small_n():
+    lo, hi = analytics.wilson_interval(3, 8)
+    assert 0.13 < lo < 0.14 and 0.69 < hi < 0.70
+    assert analytics.wilson_interval(0, 0) == (0.0, 0.0)
+    lo0, hi0 = analytics.wilson_interval(0, 10)
+    assert lo0 == 0.0 and hi0 > 0.0  # zero hits still has upside CI
+
+
+def test_detect_stall_requires_both_flatlines():
+    flat = [0.5] * 8
+    rising = [0.1 * i for i in range(8)]
+    assert analytics.detect_stall(flat, [3.0] * 8)
+    assert not analytics.detect_stall(rising, [3.0] * 8)  # fitness moves
+    assert not analytics.detect_stall(flat, [1, 1, 1, 1, 2, 2, 2, 3])
+    assert not analytics.detect_stall(flat[:4], [3.0] * 4)  # short window
+    assert analytics.detect_stall(flat, None)  # no novelty series
+
+
+def test_coverage_stats_unique_and_novelty(tmp_path):
+    st = _build_storage(tmp_path)
+    cov = analytics.coverage_stats(st, window=4)
+    assert cov["runs"] == 8 and cov["runs_without_trace"] == 0
+    assert cov["unique_interleavings"] == 5
+    assert cov["coverage"] == pytest.approx(5 / 8)
+    assert cov["curve"] == [1, 2, 3, 4, 5, 5, 5, 5]
+    # windows of 4: first window all fresh, second adds only h4's run
+    assert cov["novelty_per_window"] == [1.0, 0.25]
+    assert not cov["saturated"]
+
+
+def test_coverage_saturates_on_pure_replay(tmp_path):
+    st = new_storage("naive", str(tmp_path / "replay"))
+    st.create()
+    for i in range(6):
+        st.create_new_working_dir()
+        st.record_new_trace(_trace(["same"]))
+        st.record_result(True, 1.0)
+    cov = analytics.coverage_stats(st, window=2)
+    assert cov["unique_interleavings"] == 1
+    assert cov["novelty_per_window"] == [0.5, 0.0, 0.0]
+    assert cov["saturated"]
+
+
+def test_reproduction_stats(tmp_path):
+    st = _build_storage(tmp_path)
+    rep = analytics.reproduction_stats(st)
+    assert rep["runs"] == 8 and rep["failures"] == 4
+    assert rep["failure_rate"] == 0.5
+    lo, hi = rep["failure_rate_ci95"]
+    assert lo < 0.5 < hi
+    assert rep["mean_runs_to_reproduce"] == 2.0
+    assert rep["time_to_first_failure_s"] == pytest.approx(4.5)
+    assert rep["first_failure_run"] == 2
+    assert rep["total_time_s"] == pytest.approx(11.5)
+    assert rep["repros_per_hour"] == pytest.approx(4 / (11.5 / 3600), 0.01)
+
+
+def test_convergence_from_recorder_records():
+    conv = analytics.convergence_stats([_build_recorder_run()])
+    assert conv["search_rounds"] == 10
+    assert conv["installs"] == {"search": 1}
+    ga = conv["backends"]["ga"]
+    assert ga["rounds"] == 10 and ga["generations"] == 640
+    assert ga["best_fitness"] == pytest.approx(0.5)
+    assert ga["archive_curve"][-1] == 40
+    # fitness flatlined but novelty kept climbing -> not stalled
+    assert not ga["stalled"] and not conv["stalled"]
+
+
+def test_convergence_stall_when_both_flat():
+    run = RunTrace("stalled", max_records=4, now=0.0, wall=0.0)
+    for i in range(10):
+        run.add_generation({
+            "kind": "generation", "backend": "ga",
+            "gen_start": i, "gen_end": i + 1,
+            "t_start": float(i), "t_end": i + 0.5,
+            "best_fitness": 0.7, "distinct_failures": 2,
+        })
+    conv = analytics.convergence_stats([run])
+    assert conv["backends"]["ga"]["stalled"] and conv["stalled"]
+
+
+def test_coverage_digest_cache_and_error_bucket(tmp_path, monkeypatch):
+    st = _build_storage(tmp_path)
+    analytics.coverage_stats(st, window=4)
+    cached = [k for k in analytics._digest_cache if k[0] == st.dir]
+    assert len(cached) == 8  # immutable runs memoized per (dir, index)
+    # a featurizer failure is its own bucket, not "runs without a trace"
+    st2 = _build_storage(tmp_path, name="st2")
+    monkeypatch.setattr(analytics, "trace_digest_of",
+                        lambda trace: (_ for _ in ()).throw(
+                            ImportError("no numpy")))
+    cov = analytics.coverage_stats(st2, window=4)
+    assert cov["digest_errors"] == 8
+    assert cov["runs_without_trace"] == 0
+    assert cov["runs"] == 0
+
+
+# -- live stall gauge + warning (satellite) -------------------------------
+
+
+def test_live_stall_gauge_and_warning(caplog):
+    analytics.reset_stall_detector(window=4)
+    reg = metrics.registry()
+    import logging
+
+    with caplog.at_level(logging.WARNING,
+                         logger="namazu_tpu.obs.analytics"):
+        for _ in range(4):
+            spans.search_round("ga", generations=8, elapsed=0.1,
+                               schedules=800, best_fitness=0.5,
+                               archive_entries=10, failure_entries=2,
+                               distinct_failures=2)
+    assert reg.value(spans.SEARCH_STALL, backend="ga") == 1.0
+    stall_logs = [r for r in caplog.records
+                  if "search plane stalled" in r.getMessage()]
+    assert len(stall_logs) == 1  # transition-edge logging, not per-round
+    # progress clears the gauge
+    spans.search_round("ga", generations=8, elapsed=0.1, schedules=800,
+                       best_fitness=0.9, archive_entries=11,
+                       failure_entries=3, distinct_failures=3)
+    assert reg.value(spans.SEARCH_STALL, backend="ga") == 0.0
+
+
+def test_stall_detector_resets_at_run_boundary():
+    analytics.reset_stall_detector(window=4)
+    for _ in range(4):
+        spans.search_round("ga", generations=8, elapsed=0.1,
+                           schedules=800, best_fitness=5.0,
+                           archive_entries=10, failure_entries=2,
+                           distinct_failures=2)
+    assert metrics.registry().value(spans.SEARCH_STALL, backend="ga") == 1.0
+    # a new run begins: run A's plateau must not read as run B's stall
+    recorder.begin_run("next-experiment")
+    spans.search_round("ga", generations=8, elapsed=0.1, schedules=800,
+                       best_fitness=0.1, archive_entries=1,
+                       failure_entries=0, distinct_failures=0)
+    assert metrics.registry().value(spans.SEARCH_STALL, backend="ga") == 0.0
+    recorder.end_run("next-experiment")
+
+
+def test_generation_records_carry_archive_fields():
+    rec = recorder.recorder()
+    rec.begin_run("genrec")
+    recorder.record_generation("ga", 16, 0.5, 0.25,
+                               archive_entries=7, failure_entries=3,
+                               distinct_failures=2)
+    recorder.record_generation("ga", 16, 0.5, 0.30)  # old signature
+    snap = obs.trace_run("genrec").snapshot()
+    gens = [g for g in snap["generations"] if g["kind"] == "generation"]
+    assert gens[0]["archive_entries"] == 7
+    assert gens[0]["distinct_failures"] == 2
+    assert "archive_entries" not in gens[1]  # optional stays optional
+    rec.end_run("genrec")
+
+
+# -- payload + gauges -----------------------------------------------------
+
+
+def test_payload_publishes_experiment_gauges(tmp_path):
+    st = _build_storage(tmp_path)
+    analytics.compute_payload(storage=st, window=4)
+    reg = metrics.registry()
+    assert reg.value(spans.EXPERIMENT_RUNS) == 8
+    assert reg.value(spans.EXPERIMENT_FAILURES) == 4
+    assert reg.value(spans.EXPERIMENT_FAILURE_RATE) == 0.5
+    assert reg.value(spans.EXPERIMENT_UNIQUE) == 5
+    assert reg.value(spans.EXPERIMENT_COVERAGE) == pytest.approx(5 / 8)
+    assert reg.value(spans.EXPERIMENT_NOVELTY) == 0.25
+    assert reg.value(spans.EXPERIMENT_TTFF) == pytest.approx(4.5)
+    assert reg.value(spans.EXPERIMENT_RUNS_TO_REPRO) == 2.0
+
+
+def test_empty_payload_shape():
+    doc = analytics.compute_payload()
+    assert doc["experiment"] == {"runs": 0, "failures": 0, "entities": 0,
+                                 "search_rounds": 0}
+    assert doc["suspicious"] == [] and doc["entities"] == []
+    # renders without error in every format
+    assert "# Experiment analytics" in report.render_markdown(doc)
+    assert report.render_ndjson(doc).count("\n") == len(doc)
+
+
+def test_sparkline():
+    assert report.sparkline([]) == ""
+    assert report.sparkline([1, 1, 1]) == "▁▁▁"
+    line = report.sparkline([0, 5, 10])
+    assert line[0] == "▁" and line[-1] == "█" and len(line) == 3
+
+
+# -- the golden report (acceptance) ---------------------------------------
+
+
+def _golden_payload(tmp_path, name="st"):
+    st = _build_storage(tmp_path, name=name)
+    return analytics.compute_payload(
+        storage=st, recorder_runs=[_build_recorder_run()],
+        top=5, window=4, publish=False)
+
+
+def test_report_matches_golden(tmp_path):
+    text = report.render_markdown(_golden_payload(tmp_path))
+    if os.environ.get("NMZ_UPDATE_GOLDEN") == "1":
+        with open(GOLDEN, "w") as f:
+            f.write(text)
+    with open(GOLDEN) as f:
+        assert text == f.read()
+    # the acceptance sections are all present and populated
+    for needle in ("## Exploration coverage", "## Reproduction",
+                   "## Search convergence", "## Suspicious branches",
+                   "racy", "`ga`"):
+        assert needle in text
+
+
+def test_payload_is_deterministic(tmp_path):
+    a = _golden_payload(tmp_path, name="a")
+    b = _golden_payload(tmp_path, name="b")
+    assert a == b
+
+
+# -- REST /analytics parity with the CLI (acceptance) ---------------------
+
+
+def test_rest_analytics_matches_cli_report(tmp_path, capsys):
+    from namazu_tpu.cli import cli_main
+    from namazu_tpu.orchestrator import Orchestrator
+    from namazu_tpu.policy import create_policy
+    from namazu_tpu.utils.config import Config
+
+    storage_dir = str(tmp_path / "st")
+    _build_storage(tmp_path).close()
+    analytics.set_storage_dir(storage_dir)
+
+    cfg = Config({"rest_port": 0, "run_id": "analytics-e2e"})
+    orc = Orchestrator(cfg, create_policy("dumb"))
+    orc.start()
+    try:
+        port = orc.hub.endpoint("rest").port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/analytics", timeout=10) as r:
+            rest_payload = json.loads(r.read())
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/analytics?format=ndjson",
+                timeout=10) as r:
+            nd_lines = [json.loads(line) for line
+                        in r.read().decode().splitlines()]
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/analytics?format=bogus",
+                timeout=10)
+        assert exc.value.code == 400
+        # top/window are honored remotely (the CLI forwards its flags)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/analytics?top=1&window=2",
+                timeout=10) as r:
+            trimmed = json.loads(r.read())
+        assert len(trimmed["suspicious"]) == 1
+        assert trimmed["coverage"]["window"] == 2
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/analytics?top=banana",
+                timeout=10)
+        assert exc.value.code == 400
+    finally:
+        orc.shutdown()
+
+    # same process, same recorder state -> the CLI must produce the
+    # exact payload the live route served
+    assert cli_main(["tools", "report", storage_dir,
+                     "--format", "json"]) == 0
+    cli_payload = json.loads(capsys.readouterr().out)
+    assert cli_payload == rest_payload
+    assert rest_payload["reproduction"]["failures"] == 4
+    assert rest_payload["coverage"]["unique_interleavings"] == 5
+    assert [d["section"] for d in nd_lines] == list(rest_payload)
+    suspects = {row["branch"]: row for row in rest_payload["suspicious"]}
+    assert suspects["racy"]["fail_hit_rate"] == 1.0
+    assert suspects["racy"]["success_hit_rate"] == 0.0
+
+
+def test_cli_report_markdown_and_out_file(tmp_path, capsys):
+    from namazu_tpu.cli import cli_main
+
+    storage_dir = str(tmp_path / "st")
+    _build_storage(tmp_path).close()
+    out = str(tmp_path / "report.md")
+    assert cli_main(["tools", "report", storage_dir, "--out", out]) == 0
+    capsys.readouterr()
+    with open(out) as f:
+        text = f.read()
+    assert "# Experiment analytics" in text
+    assert "## Suspicious branches" in text
+
+
+# -- bench history + gate (acceptance) ------------------------------------
+
+
+def _bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_gate_fails_on_50pct_regression():
+    bench = _bench()
+    history = [{"platform": "tpu", "schedules_per_sec": 10_000_000.0,
+                "revision": "abc", "timestamp": "2026-08-01T00:00:00+00:00"}]
+    current = {"platform": "tpu", "schedules_per_sec": 5_000_000.0}
+    ok, reasons, baseline = bench.gate_record(current, history,
+                                              threshold_pct=30)
+    assert not ok and "schedules/s regression" in reasons[0]
+    assert baseline["schedules_per_sec"] == 10_000_000.0
+
+
+def test_bench_gate_passes_on_parity_and_improvement():
+    bench = _bench()
+    history = [{"platform": "tpu", "schedules_per_sec": 10_000_000.0}]
+    for rate in (10_000_000.0, 9_000_000.0, 12_000_000.0):
+        ok, reasons, _ = bench.gate_record(
+            {"platform": "tpu", "schedules_per_sec": rate}, history,
+            threshold_pct=30)
+        assert ok, reasons
+
+
+def test_bench_gate_ignores_other_platforms():
+    bench = _bench()
+    history = [{"platform": "tpu", "schedules_per_sec": 10_000_000.0}]
+    ok, reasons, _ = bench.gate_record(
+        {"platform": "cpu", "schedules_per_sec": 40_000.0}, history)
+    assert ok and "no 'cpu' history" in reasons[0]
+
+
+def test_bench_gate_coverage_regression():
+    bench = _bench()
+    history = [{"platform": "cpu", "schedules_per_sec": 100.0,
+                "coverage": 0.8}]
+    ok, reasons, _ = bench.gate_record(
+        {"platform": "cpu", "schedules_per_sec": 100.0, "coverage": 0.3},
+        history, threshold_pct=30)
+    assert not ok and "coverage regression" in reasons[0]
+
+
+def test_bench_history_roundtrip_skips_bad_lines(tmp_path):
+    bench = _bench()
+    path = str(tmp_path / "hist.jsonl")
+    bench.append_history({"platform": "cpu",
+                          "schedules_per_sec": 1.0}, path)
+    with open(path, "a") as f:
+        f.write("{torn-write\n")
+    bench.append_history({"platform": "cpu",
+                          "schedules_per_sec": 2.0}, path)
+    records = bench.load_history(path)
+    assert [r["schedules_per_sec"] for r in records] == [1.0, 2.0]
+    assert bench.load_history(str(tmp_path / "missing.jsonl")) == []

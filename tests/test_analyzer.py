@@ -1,0 +1,121 @@
+"""Fault-localization analyzer (namazu_tpu/analyzer.py): divergence
+ranking over mixed success/failure storages, runs with missing
+coverage.json, the empty-storage edge case, and the public
+``HistoryStorage.run_dir`` accessor it reads through."""
+
+import json
+import os
+
+import pytest
+
+from namazu_tpu.analyzer import (
+    analyze_storage,
+    divergence_ranking,
+    load_run_coverage,
+)
+from namazu_tpu.signal import PacketEvent
+from namazu_tpu.storage import new_storage
+from namazu_tpu.utils.trace import SingleTrace
+
+
+def _trace(hints):
+    t = SingleTrace()
+    for h in hints:
+        a = PacketEvent.create("n0", "n0", "peer", hint=h).default_action()
+        a.mark_triggered()
+        t.append(a)
+    return t
+
+
+def _storage(tmp_path, outcomes, coverages):
+    """A naive storage with one run per (successful, coverage) pair;
+    coverage=None leaves the run without a coverage.json."""
+    st = new_storage("naive", str(tmp_path / "st"))
+    st.create()
+    for i, (ok, cov) in enumerate(zip(outcomes, coverages)):
+        st.create_new_working_dir()
+        st.record_new_trace(_trace([f"h{i}"]))
+        st.record_result(ok, 1.0)
+        if cov is not None:
+            with open(os.path.join(st.run_dir(i), "coverage.json"),
+                      "w") as f:
+                json.dump(cov, f)
+    return st
+
+
+def test_run_dir_accessor_is_public_layout():
+    st = new_storage("naive", "/tmp/does-not-need-to-exist")
+    assert st.run_dir(0).endswith("00000000")
+    assert st.run_dir(255).endswith("000000ff")
+
+
+def test_load_run_coverage_missing_is_none(tmp_path):
+    st = _storage(tmp_path, [True], [None])
+    assert load_run_coverage(st, 0) is None
+
+
+def test_load_run_coverage_reads_through_run_dir(tmp_path):
+    st = _storage(tmp_path, [False], [{"b1": 3}])
+    assert load_run_coverage(st, 0) == {"b1": 3.0}
+
+
+def test_divergence_ranking_mixed_storage(tmp_path):
+    # "racy" fires only in failing runs, "healthy" only in successes,
+    # "common" everywhere — the ranking must put the discriminators
+    # first and the common branch last
+    st = _storage(
+        tmp_path,
+        [True, True, False, False],
+        [
+            {"common": 1, "healthy": 1},
+            {"common": 2, "healthy": 1},
+            {"common": 1, "racy": 5},
+            {"common": 3, "racy": 1},
+        ],
+    )
+    ranking = analyze_storage(st)
+    by_branch = {b: (div, fr, sr) for b, div, fr, sr in ranking}
+    assert by_branch["racy"] == (1.0, 1.0, 0.0)
+    assert by_branch["healthy"] == (1.0, 0.0, 1.0)
+    assert by_branch["common"] == (0.0, 1.0, 1.0)
+    # ties sort by branch name, zero-divergence sorts last
+    assert [b for b, *_ in ranking] == ["healthy", "racy", "common"]
+
+
+def test_runs_without_coverage_are_skipped_not_fatal(tmp_path):
+    st = _storage(
+        tmp_path,
+        [True, False, False],
+        [None, {"racy": 1}, None],
+    )
+    ranking = analyze_storage(st)
+    # only the one covered (failing) run contributes: no success side
+    assert ranking == [("racy", 1.0, 1.0, 0.0)]
+
+
+def test_empty_storage_yields_empty_ranking(tmp_path):
+    st = new_storage("naive", str(tmp_path / "empty"))
+    st.create()
+    assert analyze_storage(st) == []
+
+
+def test_incomplete_run_with_coverage_is_skipped(tmp_path):
+    # a crashed run can leave coverage.json without a result.json; the
+    # analyzer must not count it on either side
+    st = _storage(tmp_path, [False], [{"racy": 1}])
+    wd = st.create_new_working_dir()  # no trace/result recorded
+    with open(os.path.join(wd, "coverage.json"), "w") as f:
+        json.dump({"phantom": 1}, f)
+    ranking = analyze_storage(st)
+    assert [b for b, *_ in ranking] == ["racy"]
+
+
+def test_divergence_ranking_pure_math():
+    succ = [{"a": 1}, {"a": 1, "b": 1}]
+    fail = [{"b": 1}, {"b": 2, "c": 1}]
+    ranked = divergence_ranking(succ, fail)
+    by_branch = {b: div for b, div, _, _ in ranked}
+    assert by_branch["a"] == pytest.approx(1.0)
+    assert by_branch["b"] == pytest.approx(0.5)
+    assert by_branch["c"] == pytest.approx(0.5)
+    assert divergence_ranking([], []) == []
