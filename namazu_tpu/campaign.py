@@ -1,0 +1,398 @@
+"""Supervised experiment campaigns: ``nmz-tpu campaign <storage> -n N``.
+
+The tool's whole value proposition is the N-run reproduction loop
+(BASELINE.md: ``for i in $(seq 1 100); do nmz-tpu run d; done``), but a
+bare shell loop has no answer for the exact failure class the tool
+exists to hunt: a hung testee parks the loop forever, a crashed
+inspector burns the remaining N-i runs on a broken environment, and a
+SIGKILL mid-write corrupts the storage every later run trains on. The
+campaign runner is that loop with supervision (doc/robustness.md):
+
+* each run is a child ``nmz-tpu run`` in its OWN session (process
+  group); a per-run wall-clock deadline kills the entire group on
+  expiry, so orphaned testee children cannot outlive their run;
+* per-phase (run/validate/clean) deadlines are forwarded to the child,
+  which enforces them the same way (cli/run_cmd.py, utils/cmd.py);
+* every completed run is classified — ``experiment`` (an outcome,
+  pass or repro), ``timeout`` (a deadline fired), ``infra`` (the
+  harness itself failed). N bounds the SLOTS supervised: a slot that
+  exhausts its retries keeps its failure class and still consumes one
+  of the N (the budget is bounded wall-clock, not bounded outcomes);
+  the final summary reports how many slots actually recorded an
+  experiment outcome;
+* infra-class failures are retried with capped exponential backoff +
+  full jitter (utils/retry.py); K consecutive infra-class run slots
+  abort the campaign (the environment is broken; burning the budget
+  will not unbreak it);
+* after every attempt the resumable ``campaign.json`` checkpoint is
+  atomically rewritten, so a crashed supervisor resumes where it died;
+* SIGINT/SIGTERM request a graceful stop (finish the in-flight run,
+  checkpoint, exit); a second signal kills the in-flight group and
+  aborts immediately.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from namazu_tpu.cli.run_cmd import EXIT_TIMEOUT
+from namazu_tpu.utils.atomic import atomic_write_json
+from namazu_tpu.utils.cmd import CmdFactory, kill_process_group
+from namazu_tpu.utils.log import get_logger
+from namazu_tpu.utils.retry import backoff_delays
+
+log = get_logger("campaign")
+
+CHECKPOINT_NAME = "campaign.json"
+CHECKPOINT_VERSION = 1
+
+#: outcome classes (doc/robustness.md)
+CLASS_EXPERIMENT = "experiment"  # the run recorded an outcome (pass/repro)
+CLASS_TIMEOUT = "timeout"        # a deadline killed the run's process group
+CLASS_INFRA = "infra"            # the harness failed (nonzero exit, signal)
+CLASS_INTERRUPTED = "interrupted"  # operator abort mid-run
+
+#: campaign exit statuses (distinct from run_cmd's, which the child uses)
+EXIT_OK = 0
+EXIT_USAGE = 2
+EXIT_INFRA_STOP = 3     # K consecutive infra-class run slots
+EXIT_INTERRUPTED = 130  # stopped on SIGINT/SIGTERM (128 + SIGINT)
+
+
+@dataclass
+class CampaignSpec:
+    """Everything that parameterizes one supervised campaign."""
+
+    storage_dir: str
+    runs: int
+    # supervisor-side wall-clock deadline for one whole `nmz-tpu run`
+    # child (covers hangs the per-phase deadlines cannot see: a wedged
+    # orchestrator shutdown, a stuck storage flush); 0 = none
+    run_wall_deadline_s: float = 0.0
+    # per-phase deadlines forwarded to the child (0 = none)
+    run_deadline_s: float = 0.0
+    validate_deadline_s: float = 0.0
+    clean_deadline_s: float = 0.0
+    retries: int = 2              # extra attempts per slot on infra/timeout
+    backoff_base_s: float = 1.0
+    backoff_cap_s: float = 30.0
+    max_consecutive_infra: int = 3
+    python: str = sys.executable
+    seed: Optional[int] = None    # jitter RNG seed (tests)
+    extra_run_args: List[str] = field(default_factory=list)
+
+
+class Campaign:
+    """One supervised campaign over one storage dir."""
+
+    def __init__(self, spec: CampaignSpec):
+        self.spec = spec
+        self.state: Dict[str, Any] = {}
+        self._rng = random.Random(spec.seed)
+        self._stop_requested = threading.Event()
+        self._abort = threading.Event()
+        self._child: Optional[subprocess.Popen] = None
+        self._child_lock = threading.Lock()
+
+    # -- checkpoint ------------------------------------------------------
+
+    @property
+    def checkpoint_path(self) -> str:
+        return os.path.join(self.spec.storage_dir, CHECKPOINT_NAME)
+
+    def _fresh_state(self) -> Dict[str, Any]:
+        return {
+            "version": CHECKPOINT_VERSION,
+            "requested_runs": self.spec.runs,
+            "slots": [],            # one entry per finished run slot
+            "consecutive_infra": 0,
+            "stopped_reason": None,  # None while running; "done"/"infra"/
+                                     # "interrupted" when finished
+            "started_at": time.time(),
+            "updated_at": time.time(),
+        }
+
+    def _load_or_init_state(self, resume: bool) -> None:
+        path = self.checkpoint_path
+        if resume and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    state = json.load(f)
+            except (OSError, ValueError) as e:
+                raise CampaignError(
+                    f"unreadable checkpoint {path}: {e}; remove it or "
+                    "rerun with --no-resume") from None
+            if int(state.get("version", -1)) != CHECKPOINT_VERSION:
+                raise CampaignError(
+                    f"checkpoint {path} has version "
+                    f"{state.get('version')!r}, this build writes "
+                    f"{CHECKPOINT_VERSION}; rerun with --no-resume")
+            # a resumed campaign may raise or lower the target; the
+            # completed prefix stands either way
+            state["requested_runs"] = self.spec.runs
+            state["stopped_reason"] = None
+            # the operator re-running IS the claim the environment is
+            # fixed: carrying the counter over would re-stop on infra
+            # before attempting a single run
+            state["consecutive_infra"] = 0
+            self.state = state
+            log.info("resuming campaign from %s: %d slot(s) already done",
+                     path, len(state["slots"]))
+        else:
+            self.state = self._fresh_state()
+        self._checkpoint()
+
+    def _checkpoint(self) -> None:
+        self.state["updated_at"] = time.time()
+        atomic_write_json(self.checkpoint_path, self.state, indent=2,
+                          sort_keys=True)
+
+    # -- signals ---------------------------------------------------------
+
+    def _install_signal_handlers(self):
+        if threading.current_thread() is not threading.main_thread():
+            return None
+        previous = {}
+
+        def handler(signum, frame):
+            if self._stop_requested.is_set():
+                # second signal: the operator means it — kill the
+                # in-flight group and abort
+                log.warning("second signal; aborting the in-flight run")
+                self._abort.set()
+                with self._child_lock:
+                    child = self._child
+                if child is not None:
+                    kill_process_group(child)
+            else:
+                log.warning("stop requested; finishing the in-flight run "
+                            "then checkpointing (signal again to abort)")
+                self._stop_requested.set()
+
+        for signum in (signal.SIGINT, signal.SIGTERM):
+            previous[signum] = signal.signal(signum, handler)
+        return previous
+
+    @staticmethod
+    def _restore_signal_handlers(previous) -> None:
+        if previous:
+            for signum, old in previous.items():
+                signal.signal(signum, old)
+
+    # -- one attempt -----------------------------------------------------
+
+    def _run_argv(self) -> List[str]:
+        spec = self.spec
+        argv = [spec.python, "-m", "namazu_tpu.cli", "run",
+                spec.storage_dir]
+        for flag, value in (("--run-deadline", spec.run_deadline_s),
+                            ("--validate-deadline", spec.validate_deadline_s),
+                            ("--clean-deadline", spec.clean_deadline_s)):
+            if value and value > 0:
+                argv += [flag, str(value)]
+        argv += spec.extra_run_args
+        return argv
+
+    @staticmethod
+    def _child_env() -> Dict[str, str]:
+        # the child must be able to import the framework even when it is
+        # not installed site-wide; CmdFactory.env() owns that logic
+        return CmdFactory().env()
+
+    def _one_attempt(self) -> Dict[str, Any]:
+        """Spawn one ``nmz-tpu run`` child in its own session, enforce
+        the supervisor-side wall deadline, classify the outcome."""
+        spec = self.spec
+        t0 = time.monotonic()
+        child = subprocess.Popen(
+            self._run_argv(), env=self._child_env(),
+            start_new_session=True)
+        with self._child_lock:
+            self._child = child
+        timed_out = False
+        try:
+            deadline = (spec.run_wall_deadline_s
+                        if spec.run_wall_deadline_s > 0 else None)
+            try:
+                child.wait(timeout=deadline)
+            except subprocess.TimeoutExpired:
+                timed_out = True
+                log.warning("run exceeded the %.1fs wall deadline; "
+                            "killing its process group", deadline)
+                kill_process_group(child)
+            except BaseException:
+                kill_process_group(child)
+                raise
+        finally:
+            with self._child_lock:
+                self._child = None
+        wall_s = time.monotonic() - t0
+        rc = child.returncode
+        if timed_out:
+            cls = CLASS_TIMEOUT
+        elif self._abort.is_set():
+            cls = CLASS_INTERRUPTED
+        elif rc == 0:
+            cls = CLASS_EXPERIMENT
+        elif rc == EXIT_TIMEOUT:
+            cls = CLASS_TIMEOUT  # a child-enforced phase deadline fired
+        else:
+            cls = CLASS_INFRA  # nonzero exit or signal death (rc < 0)
+        return {"class": cls, "exit_status": rc,
+                "wall_s": round(wall_s, 3),
+                "wall_deadline_hit": timed_out}
+
+    # -- the supervised loop ---------------------------------------------
+
+    def run(self, resume: bool = True) -> int:
+        spec = self.spec
+        if spec.runs < 1:
+            raise CampaignError(f"runs must be >= 1, got {spec.runs}")
+        if not os.path.exists(os.path.join(spec.storage_dir,
+                                           "config.json")):
+            raise CampaignError(
+                f"{spec.storage_dir} is not an initialized storage "
+                "(no config.json; run `init` first)")
+        self._load_or_init_state(resume)
+        previous_handlers = self._install_signal_handlers()
+        try:
+            return self._loop()
+        finally:
+            self._restore_signal_handlers(previous_handlers)
+            self._checkpoint()
+
+    def _finish(self, reason: str, status: int) -> int:
+        self.state["stopped_reason"] = reason
+        self._checkpoint()
+        counts: Dict[str, int] = {}
+        for slot in self.state["slots"]:
+            counts[slot["class"]] = counts.get(slot["class"], 0) + 1
+        log.info("campaign finished (%s): %d/%d slot(s) done, classes %s",
+                 reason, len(self.state["slots"]),
+                 self.state["requested_runs"], counts or "{}")
+        return status
+
+    def _loop(self) -> int:
+        spec = self.spec
+        state = self.state
+        while len(state["slots"]) < state["requested_runs"]:
+            if self._abort.is_set():
+                return self._finish("interrupted", EXIT_INTERRUPTED)
+            if self._stop_requested.is_set():
+                return self._finish("interrupted", EXIT_INTERRUPTED)
+            if (spec.max_consecutive_infra > 0
+                    and state["consecutive_infra"]
+                    >= spec.max_consecutive_infra):
+                log.error(
+                    "%d consecutive infra-class run slot(s); the "
+                    "environment is broken — stopping the campaign",
+                    state["consecutive_infra"])
+                return self._finish("infra", EXIT_INFRA_STOP)
+            slot_index = len(state["slots"])
+            slot = self._run_slot(slot_index)
+            state["slots"].append(slot)
+            if slot["class"] == CLASS_EXPERIMENT:
+                state["consecutive_infra"] = 0
+            elif slot["class"] == CLASS_INTERRUPTED:
+                self._checkpoint()
+                return self._finish("interrupted", EXIT_INTERRUPTED)
+            else:
+                state["consecutive_infra"] += 1
+            self._checkpoint()
+        if (spec.max_consecutive_infra > 0
+                and state["consecutive_infra"]
+                >= spec.max_consecutive_infra):
+            return self._finish("infra", EXIT_INFRA_STOP)
+        return self._finish("done", EXIT_OK)
+
+    def _run_slot(self, slot_index: int) -> Dict[str, Any]:
+        """One run slot: attempt + bounded infra/timeout retries."""
+        spec = self.spec
+        attempts: List[Dict[str, Any]] = []
+        delays = backoff_delays(max(0, spec.retries),
+                                base=spec.backoff_base_s,
+                                cap=spec.backoff_cap_s, rng=self._rng)
+        while True:
+            log.info("slot %d attempt %d", slot_index, len(attempts) + 1)
+            attempt = self._one_attempt()
+            attempts.append(attempt)
+            slot = {"slot": slot_index, "class": attempt["class"],
+                    "attempts": attempts}
+            if attempt["class"] == CLASS_EXPERIMENT:
+                return slot
+            if (attempt["class"] == CLASS_INTERRUPTED
+                    or self._abort.is_set()):
+                slot["class"] = CLASS_INTERRUPTED
+                return slot
+            if self._stop_requested.is_set():
+                return slot
+            # infra/timeout: retry with backoff while the budget lasts
+            try:
+                delay = next(delays)
+            except StopIteration:
+                return slot
+            # persist the failed attempt before sleeping: a supervisor
+            # crash during the backoff must not forget it
+            self._checkpoint_partial(slot)
+            log.warning("slot %d attempt %d was %s (exit %s); retrying "
+                        "in %.2fs", slot_index, len(attempts),
+                        attempt["class"], attempt["exit_status"], delay)
+            if self._stop_requested.wait(delay):
+                return slot
+
+    def _checkpoint_partial(self, slot: Dict[str, Any]) -> None:
+        """Checkpoint with the in-progress slot appended provisionally
+        (it is rewritten when the slot finishes for real)."""
+        snapshot = dict(self.state)
+        snapshot["slots"] = self.state["slots"] + [
+            dict(slot, in_progress=True)]
+        snapshot["updated_at"] = time.time()
+        atomic_write_json(self.checkpoint_path, snapshot, indent=2,
+                          sort_keys=True)
+
+
+class CampaignError(Exception):
+    pass
+
+
+def load_checkpoint(storage_dir: str) -> Optional[Dict[str, Any]]:
+    """Read a storage's campaign checkpoint (None when absent)."""
+    path = os.path.join(storage_dir, CHECKPOINT_NAME)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def summarize(state: Dict[str, Any]) -> Dict[str, Any]:
+    """Roll a checkpoint up into the counts dashboards/CI gate on."""
+    slots = [s for s in state.get("slots", [])
+             if not s.get("in_progress")]
+    by_class: Dict[str, int] = {}
+    unclassified = 0
+    for s in slots:
+        cls = s.get("class")
+        if cls not in (CLASS_EXPERIMENT, CLASS_TIMEOUT, CLASS_INFRA,
+                       CLASS_INTERRUPTED):
+            unclassified += 1
+        else:
+            by_class[cls] = by_class.get(cls, 0) + 1
+    return {
+        "requested_runs": state.get("requested_runs", 0),
+        "completed_slots": len(slots),
+        "experiment": by_class.get(CLASS_EXPERIMENT, 0),
+        "timeout": by_class.get(CLASS_TIMEOUT, 0),
+        "infra": by_class.get(CLASS_INFRA, 0),
+        "interrupted": by_class.get(CLASS_INTERRUPTED, 0),
+        "unclassified": unclassified,
+        "stopped_reason": state.get("stopped_reason"),
+    }

@@ -15,6 +15,7 @@ from typing import List, Optional
 
 def build_parser() -> argparse.ArgumentParser:
     from namazu_tpu.cli import (
+        campaign_cmd,
         container_cmd,
         init_cmd,
         inspectors_cmd,
@@ -31,6 +32,7 @@ def build_parser() -> argparse.ArgumentParser:
     sub = parser.add_subparsers(dest="command", required=True)
     init_cmd.register(sub)
     run_cmd.register(sub)
+    campaign_cmd.register(sub)
     orchestrator_cmd.register(sub)
     inspectors_cmd.register(sub)
     tools_cmd.register(sub)

@@ -1,0 +1,93 @@
+"""``nmz-tpu campaign <storage> -n N`` — the supervised repro loop.
+
+The resilient replacement for ``for i in $(seq 1 N); do nmz-tpu run d;
+done`` (BASELINE.md): per-run and per-phase deadlines enforced with
+process-group kills, outcome classification (experiment / timeout /
+infra), capped-backoff retries for infra failures, a resumable
+``campaign.json`` checkpoint, and graceful SIGINT/SIGTERM handling.
+Semantics: doc/robustness.md; machinery: namazu_tpu/campaign.py.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from namazu_tpu.campaign import (
+    Campaign,
+    CampaignError,
+    CampaignSpec,
+    EXIT_USAGE,
+    summarize,
+)
+
+
+def register(sub) -> None:
+    p = sub.add_parser(
+        "campaign",
+        help="run N supervised experiments (deadlines, retries, "
+             "resumable checkpoint)",
+    )
+    p.add_argument("storage", help="storage directory created by init")
+    p.add_argument("-n", "--runs", type=int, default=10,
+                   help="run slots to supervise (default 10)")
+    p.add_argument("--wall-deadline", type=float, default=0.0, metavar="S",
+                   help="wall-clock deadline for one whole run child; its "
+                        "entire process group is killed on expiry "
+                        "(0 = none)")
+    for phase in ("run", "validate", "clean"):
+        p.add_argument(f"--{phase}-deadline", type=float, default=0.0,
+                       metavar="S",
+                       help=f"deadline forwarded to the child's {phase} "
+                            "phase (0 = the storage config's "
+                            f"{phase}_deadline_s)")
+    p.add_argument("--retries", type=int, default=2,
+                   help="extra attempts per slot after an infra/timeout "
+                        "failure (default 2)")
+    p.add_argument("--backoff-base", type=float, default=1.0, metavar="S",
+                   help="base of the capped exponential retry backoff "
+                        "(default 1.0s; full jitter)")
+    p.add_argument("--backoff-cap", type=float, default=30.0, metavar="S",
+                   help="retry backoff cap (default 30s)")
+    p.add_argument("--max-consecutive-infra", type=int, default=3,
+                   metavar="K",
+                   help="stop after K consecutive non-experiment run "
+                        "slots (default 3; 0 = never)")
+    p.add_argument("--no-resume", action="store_true",
+                   help="ignore an existing campaign.json and start a "
+                        "fresh campaign")
+    p.add_argument("--json", action="store_true",
+                   help="print the final campaign summary as JSON")
+    p.set_defaults(func=run)
+
+
+def run(args) -> int:
+    spec = CampaignSpec(
+        storage_dir=args.storage,
+        runs=args.runs,
+        run_wall_deadline_s=args.wall_deadline,
+        run_deadline_s=args.run_deadline,
+        validate_deadline_s=args.validate_deadline,
+        clean_deadline_s=args.clean_deadline,
+        retries=args.retries,
+        backoff_base_s=args.backoff_base,
+        backoff_cap_s=args.backoff_cap,
+        max_consecutive_infra=args.max_consecutive_infra,
+    )
+    campaign = Campaign(spec)
+    try:
+        status = campaign.run(resume=not args.no_resume)
+    except CampaignError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_USAGE
+    summary = summarize(campaign.state)
+    if args.json:
+        print(json.dumps(summary, sort_keys=True))
+    else:
+        print(f"campaign {summary['stopped_reason']}: "
+              f"{summary['experiment']} experiment run(s) recorded over "
+              f"{summary['completed_slots']} slot(s) "
+              f"({summary['timeout']} timeout, {summary['infra']} infra, "
+              f"{summary['interrupted']} interrupted); "
+              f"checkpoint {campaign.checkpoint_path}")
+    return status

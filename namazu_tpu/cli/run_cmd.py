@@ -12,8 +12,10 @@ Driven N times by the user (``for i in $(seq 1 100); do nmz-tpu run d; done``)
 from __future__ import annotations
 
 import os
+import subprocess
 import sys
 import time
+from typing import Optional
 
 from namazu_tpu.orchestrator import Orchestrator
 from namazu_tpu.policy import create_policy
@@ -22,11 +24,28 @@ from namazu_tpu.utils.cmd import CmdFactory
 from namazu_tpu.utils.config import Config
 from namazu_tpu.utils.log import init_log
 
+#: exit statuses the campaign supervisor classifies on (doc/robustness.md)
+EXIT_OK = 0
+EXIT_INFRA = 1
+EXIT_TIMEOUT = 124  # a phase deadline expired (same convention as timeout(1))
+
 
 def register(sub) -> None:
     p = sub.add_parser("run", help="run one experiment from a storage dir")
     p.add_argument("storage", help="storage directory created by init")
+    for phase in ("run", "validate", "clean"):
+        p.add_argument(
+            f"--{phase}-deadline", type=float, default=None, metavar="S",
+            help=f"deadline for the {phase} script (seconds; its whole "
+                 f"process group is killed on expiry); default: the "
+                 f"config's {phase}_deadline_s, 0 = none")
     p.set_defaults(func=run)
+
+
+def _deadline(cli_value: Optional[float], cfg: Config, key: str
+              ) -> Optional[float]:
+    v = cli_value if cli_value is not None else float(cfg.get(key, 0) or 0)
+    return v if v and v > 0 else None
 
 
 def run(args) -> int:
@@ -71,48 +90,98 @@ def run(args) -> int:
 
     obs.set_analytics_storage(os.path.abspath(storage_dir))
 
+    run_deadline = _deadline(args.run_deadline, cfg, "run_deadline_s")
+    validate_deadline = _deadline(args.validate_deadline, cfg,
+                                  "validate_deadline_s")
+    clean_deadline = _deadline(args.clean_deadline, cfg, "clean_deadline_s")
+
     orchestrator = Orchestrator(cfg, policy, collect_trace=True)
     orchestrator.start()
 
     successful = False
+    recorded = False
     start = time.monotonic()
+    # the clean script runs in the OUTER finally no matter how the run
+    # ends — a failed validate, a deadline kill, or a Ctrl-C after the
+    # run script must not leak testee state (ports, scratch files,
+    # half-dead processes) into the next run of the campaign loop
     try:
-        run_script = cfg.get("run")
-        if not run_script:
-            print("error: config has no 'run' script", file=sys.stderr)
-            return 1
-        res = factory.run(run_script)
-        if res.returncode != 0:
-            # infra failure, not an experiment outcome: abort without
-            # recording so it cannot pollute repro-rate stats or the
-            # search plane's failure archive (parity: cli/run.go aborts
-            # when the run command errors)
-            print(f"error: run script exited {res.returncode}; "
-                  "not recording this run", file=sys.stderr)
-            return 1
+        try:
+            run_script = cfg.get("run")
+            if not run_script:
+                print("error: config has no 'run' script", file=sys.stderr)
+                return EXIT_INFRA
+            try:
+                res = factory.run(run_script, deadline=run_deadline)
+            except subprocess.TimeoutExpired:
+                print(f"error: run script exceeded its {run_deadline:.1f}s "
+                      "deadline; killed its process group; not recording "
+                      "this run", file=sys.stderr)
+                return EXIT_TIMEOUT
+            if res.returncode != 0:
+                # infra failure, not an experiment outcome: abort without
+                # recording so it cannot pollute repro-rate stats or the
+                # search plane's failure archive (parity: cli/run.go aborts
+                # when the run command errors)
+                print(f"error: run script exited {res.returncode}; "
+                      "not recording this run", file=sys.stderr)
+                return EXIT_INFRA
+        finally:
+            trace = orchestrator.shutdown()
+
+        validate_script = cfg.get("validate")
+        if validate_script:
+            try:
+                successful = factory.run(
+                    validate_script,
+                    deadline=validate_deadline).returncode == 0
+            except subprocess.TimeoutExpired:
+                print("error: validate script exceeded its "
+                      f"{validate_deadline:.1f}s deadline; killed its "
+                      "process group; not recording this run",
+                      file=sys.stderr)
+                return EXIT_TIMEOUT
+        required_time = time.monotonic() - start
+
+        from namazu_tpu.signal.base import HINT_SPACE
+
+        storage.record_new_trace(trace)
+        # stamp the replay-hint format version: a future format bump must
+        # be able to tell (and skip) histories whose recorded event_hint
+        # strings hash into a different bucket space (policy/tpu.py
+        # _ingest_history)
+        storage.record_result(successful, required_time,
+                              metadata={"hint_space": HINT_SPACE})
+        recorded = True
+
+        print(f"run finished: successful={successful} "
+              f"time={required_time:.2f}s trace={len(trace)} actions "
+              f"workdir={working_dir}")
+        return EXIT_OK
     finally:
-        trace = orchestrator.shutdown()
-
-    validate_script = cfg.get("validate")
-    if validate_script:
-        successful = factory.run(validate_script).returncode == 0
-    required_time = time.monotonic() - start
-
-    from namazu_tpu.signal.base import HINT_SPACE
-
-    storage.record_new_trace(trace)
-    # stamp the replay-hint format version: a future format bump must be
-    # able to tell (and skip) histories whose recorded event_hint strings
-    # hash into a different bucket space (policy/tpu.py _ingest_history)
-    storage.record_result(successful, required_time,
-                          metadata={"hint_space": HINT_SPACE})
-    storage.close()
-
-    clean_script = cfg.get("clean")
-    if clean_script:
-        factory.run(clean_script)
-
-    print(f"run finished: successful={successful} "
-          f"time={required_time:.2f}s trace={len(trace)} actions "
-          f"workdir={working_dir}")
-    return 0
+        if not recorded:
+            # deliberate abort (infra failure / deadline / interrupt):
+            # mark the allocated run dir so fsck can tell it from a
+            # crash and analytics never mistakes it for data
+            try:
+                storage.quarantine_current_run(
+                    "run aborted before a result was recorded")
+            except Exception as e:
+                print(f"warning: could not mark aborted run: {e}",
+                      file=sys.stderr)
+        # crash-safe close: a storage backend flushing remote state
+        # (mongodb) must not turn a recorded run into a failed exit
+        try:
+            storage.close()
+        except Exception as e:
+            print(f"warning: storage close failed: {e}", file=sys.stderr)
+        clean_script = cfg.get("clean")
+        if clean_script:
+            try:
+                factory.run(clean_script, deadline=clean_deadline)
+            except subprocess.TimeoutExpired:
+                print("warning: clean script exceeded its "
+                      f"{clean_deadline:.1f}s deadline; killed its "
+                      "process group", file=sys.stderr)
+            except Exception as e:
+                print(f"warning: clean script failed: {e}", file=sys.stderr)

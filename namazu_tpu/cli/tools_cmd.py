@@ -195,6 +195,22 @@ def register(sub) -> None:
                     help="write to this file instead of stdout")
     pr.set_defaults(func=report)
 
+    pf = tsub.add_parser(
+        "fsck",
+        help="storage integrity check (doc/robustness.md): list "
+             "quarantined (INCOMPLETE) runs, crash-incomplete runs not "
+             "yet marked, and orphan atomic-write temp files; --repair "
+             "quarantines the incomplete runs and sweeps the temps",
+    )
+    pf.add_argument("storage")
+    pf.add_argument("--repair", action="store_true",
+                    help="quarantine unmarked incomplete runs and remove "
+                         "orphan *.tmp files (run only on a quiescent "
+                         "storage — an in-flight run looks incomplete)")
+    pf.add_argument("--json", action="store_true",
+                    help="print the full report as JSON")
+    pf.set_defaults(func=fsck)
+
     pi = tsub.add_parser(
         "import-reference-trace",
         help="convert a reference-format experiment dir (per-action JSON "
@@ -357,6 +373,48 @@ def report(args) -> int:
     else:
         sys.stdout.write(text)
     return 0
+
+
+def fsck(args) -> int:
+    """Integrity report over a storage's run dirs. Exit 1 only for
+    UNHANDLED states — unmarked incomplete dirs, missing dirs, stray
+    atomic-write temps (found-and-repaired still exits 1 so scripts
+    notice the storage needed repair). Already-quarantined runs are
+    reported but are a handled state (a supervised abort marks its own
+    dir; doc/robustness.md), so they alone exit 0."""
+    st = load_storage(args.storage)
+    try:
+        if not hasattr(st, "fsck"):
+            print(f"error: storage backend {type(st).__name__} has no "
+                  "fsck support", file=sys.stderr)
+            return 2
+        report = st.fsck(repair=args.repair)
+    finally:
+        st.close()
+    findings = (len(report["incomplete_unmarked"])
+                + len(report.get("repaired_runs", []))
+                + len(report["missing_dirs"])
+                + len(report["tmp_artifacts"]))
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+        return 1 if findings else 0
+    print(f"{report['dir']}: {report['next_run']} run dir(s) allocated, "
+          f"{report['complete']} complete, "
+          f"{len(report['quarantined'])} quarantined")
+    for i in report["quarantined"]:
+        print(f"  quarantined: {i:08x} (INCOMPLETE marker)")
+    for i in report["incomplete_unmarked"]:
+        print(f"  incomplete (unmarked): {i:08x} — no result recorded")
+    for i in report["missing_dirs"]:
+        print(f"  missing dir: {i:08x}")
+    for path in report["tmp_artifacts"]:
+        print(f"  stray temp: {path}")
+    if args.repair:
+        print("repaired: incomplete runs quarantined, stray temps removed")
+    elif findings:
+        print("rerun with --repair to quarantine incomplete runs and "
+              "sweep stray temps")
+    return 1 if findings else 0
 
 
 def import_reference_trace(args) -> int:

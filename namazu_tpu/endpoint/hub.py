@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Dict, Optional
+import time
+from typing import Dict, Optional, Set
 
 from namazu_tpu import obs
 from namazu_tpu.signal.action import Action
@@ -46,6 +47,13 @@ class EndpointHub:
         self.control_queue: "queue.Queue[Control]" = queue.Queue()
         self._endpoints: Dict[str, Endpoint] = {}
         self._entity_route: Dict[str, str] = {}
+        # liveness bookkeeping for the orchestrator's watchdog: monotonic
+        # time of each entity's last inbound event
+        self._last_seen: Dict[str, float] = {}
+        # entities already warned about, per failure class — one WARNING
+        # per entity, not one per dropped action (a dead entity can shed
+        # thousands of drops over a long experiment)
+        self._warned_unroutable: Set[str] = set()
         self._lock = threading.Lock()
 
     # -- endpoint registration ------------------------------------------
@@ -76,6 +84,10 @@ class EndpointHub:
                     event.entity_id, prev, endpoint_name,
                 )
             self._entity_route[event.entity_id] = endpoint_name
+            self._last_seen[event.entity_id] = time.monotonic()
+            # an entity that speaks again is routable again: re-arm its
+            # one-shot unroutable warning
+            self._warned_unroutable.discard(event.entity_id)
         event.mark_arrived()
         obs.mark(event, "intercepted")
         obs.event_intercepted(endpoint_name, event.entity_id)
@@ -90,7 +102,35 @@ class EndpointHub:
     def send_action(self, action: Action) -> None:
         with self._lock:
             name = self._entity_route.get(action.entity_id)
+            first_drop = (name is None
+                          and action.entity_id not in self._warned_unroutable)
+            if first_drop:
+                self._warned_unroutable.add(action.entity_id)
         if name is None:
-            log.warning("no endpoint for entity %s; dropping %r", action.entity_id, action)
+            obs.action_unroutable(action.entity_id)
+            if first_drop:
+                log.warning(
+                    "no endpoint for entity %s; dropping %r (repeats "
+                    "counted in %s, logged at DEBUG)",
+                    action.entity_id, action, "nmz_actions_unroutable_total")
+            else:
+                log.debug("no endpoint for entity %s; dropping %r",
+                          action.entity_id, action)
             return
         self._endpoints[name].send_action(action)
+
+    # -- liveness (the orchestrator's watchdog reads these) -------------
+
+    def last_seen(self) -> Dict[str, float]:
+        """Snapshot of entity -> monotonic last-inbound-event time."""
+        with self._lock:
+            return dict(self._last_seen)
+
+    def stalled_entities(self, timeout_s: float,
+                         now: Optional[float] = None) -> Dict[str, float]:
+        """Entities silent for more than ``timeout_s``, with their
+        silence duration."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return {e: now - t for e, t in self._last_seen.items()
+                    if now - t > timeout_s}

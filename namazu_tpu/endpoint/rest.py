@@ -29,6 +29,7 @@ import json
 import re
 import threading
 import time
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib.parse import urlparse, parse_qs
@@ -119,6 +120,27 @@ class RestEndpoint(Endpoint):
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_mono = time.monotonic()  # /healthz uptime anchor
+        # event-POST dedup ring: the transceiver retries a POST whose
+        # 200 was lost in flight (doc/robustness.md), so an uuid seen
+        # twice means the first attempt already reached the hub — ack
+        # without re-posting, or one network blip doubles an event in
+        # the trace. Bounded: uuids are unique per event, so a small
+        # recent window is enough to cover the retry horizon.
+        self._seen_event_uuids: "OrderedDict[str, None]" = OrderedDict()
+        self._seen_lock = threading.Lock()
+
+    _SEEN_EVENT_CAP = 4096
+
+    def note_event_uuid(self, uuid: str) -> bool:
+        """Record an inbound event uuid; True if it was already seen
+        (i.e. this POST is a retry duplicate)."""
+        with self._seen_lock:
+            if uuid in self._seen_event_uuids:
+                return True
+            self._seen_event_uuids[uuid] = None
+            while len(self._seen_event_uuids) > self._SEEN_EVENT_CAP:
+                self._seen_event_uuids.popitem(last=False)
+            return False
 
     # -- lifecycle -------------------------------------------------------
 
@@ -177,6 +199,10 @@ class RestEndpoint(Endpoint):
                         400,
                         {"error": "url entity/uuid do not match event body"},
                     )
+                if endpoint.note_event_uuid(sig.uuid):
+                    # retry of a POST whose 200 was lost: the event is
+                    # already in the hub — idempotent ack
+                    return self._reply(200, {"duplicate": True})
                 endpoint.hub.post_event(sig, endpoint.NAME)
                 self._reply(200, {})
 

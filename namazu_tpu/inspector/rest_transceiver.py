@@ -21,23 +21,47 @@ from namazu_tpu.signal.action import Action
 from namazu_tpu.signal.base import signal_from_jsonable
 from namazu_tpu.signal.event import Event
 from namazu_tpu.utils.log import get_logger
+from namazu_tpu.utils.retry import retry_call
 
 log = get_logger("transceiver.rest")
 
 
 class RestTransceiver(Transceiver):
     def __init__(self, entity_id: str, orchestrator_url: str,
-                 backoff_step: float = 0.5, backoff_max: float = 5.0):
+                 backoff_step: float = 0.5, backoff_max: float = 5.0,
+                 post_attempts: int = 4):
         super().__init__(entity_id)
         self.base = orchestrator_url.rstrip("/") + API_ROOT
         self.backoff_step = backoff_step
         self.backoff_max = backoff_max
+        self.post_attempts = post_attempts
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     # -- outbound --------------------------------------------------------
 
     def _post(self, event: Event) -> None:
+        """POST the event, riding out transient transport hiccups with
+        bounded backoff + jitter: the receive loop already backs off,
+        but this path used to raise straight into inspector code on one
+        dropped connection — killing the inspector over a blip the next
+        attempt would have absorbed. Exhausted retries still raise (the
+        orchestrator is genuinely gone)."""
+        retry_call(
+            lambda: self._post_once(event),
+            exceptions=(urllib.error.URLError, OSError),
+            attempts=max(1, self.post_attempts),
+            base=self.backoff_step,
+            cap=self.backoff_max,
+            # an interruptible sleep: shutdown() aborts the backoff
+            sleep=self._stop.wait,
+            on_retry=lambda e, n, d: log.debug(
+                "event POST failed (%s); retry %d in %.2fs", e, n, d),
+        )
+
+    def _post_once(self, event: Event) -> None:
+        if self._stop.is_set():
+            return  # shutting down: don't fight over a dying server
         url = f"{self.base}/events/{event.entity_id}/{event.uuid}"
         req = urllib.request.Request(
             url,
@@ -60,8 +84,17 @@ class RestTransceiver(Transceiver):
             )
             self._thread.start()
 
-    def shutdown(self) -> None:
+    def shutdown(self, join_timeout: float = 5.0) -> None:
+        """Stop and JOIN the receive thread (bounded): setting the flag
+        alone let the thread's in-flight long-poll outlive shutdown and
+        race the next run's transceiver for the same entity's actions."""
         self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=join_timeout)
+            if t.is_alive():
+                log.warning("receive thread still in a long-poll after "
+                            "%.1fs; abandoning it (daemon)", join_timeout)
 
     def _receive_loop(self) -> None:
         backoff = 0.0

@@ -61,7 +61,9 @@ from namazu_tpu.obs.metrics import (  # noqa: F401
 )
 from namazu_tpu.obs.spans import (  # noqa: F401
     action_dispatched,
+    action_unroutable,
     carry,
+    entity_stalled,
     event_intercepted,
     experiment_stats,
     latency,

@@ -147,13 +147,31 @@ def _run_digest(storage, i: int, trace) -> str:
     return digest
 
 
+def _quarantined_count(storage) -> int:
+    """How many of the storage's allocated run dirs are crash-
+    quarantined (0 for backends without quarantine support)."""
+    try:
+        return len(getattr(storage, "quarantined_runs")())
+    except Exception:
+        return 0
+
+
 def coverage_stats(storage, window: int = DEFAULT_WINDOW) -> Dict[str, Any]:
     """Distinct-interleaving coverage of a storage's recorded runs."""
     n = storage.nr_stored_histories()
     digests: List[str] = []
     missing = 0
+    # counted over ALL allocated run dirs (a quarantined run past the
+    # last completed one is outside nr_stored_histories' range)
+    quarantined = _quarantined_count(storage)
     digest_errors = 0
+    is_quarantined = getattr(storage, "is_quarantined", None)
     for i in range(n):
+        if is_quarantined is not None and is_quarantined(i):
+            # crash-quarantined run (storage INCOMPLETE marker): its
+            # trace exists but is untrustworthy — excluded from
+            # coverage (doc/robustness.md)
+            continue
         try:
             trace = storage.get_stored_history(i)
         except Exception:
@@ -185,6 +203,7 @@ def coverage_stats(storage, window: int = DEFAULT_WINDOW) -> Dict[str, Any]:
     return {
         "runs": len(digests),
         "runs_without_trace": missing,
+        "runs_quarantined": quarantined,
         "digest_errors": digest_errors,
         "unique_interleavings": unique,
         "coverage": round(unique / len(digests), 4) if digests else 0.0,
@@ -199,7 +218,11 @@ def reproduction_stats(storage) -> Dict[str, Any]:
     """Failure (= bug reproduction) statistics across a storage's runs."""
     n = storage.nr_stored_histories()
     outcomes: List[Tuple[bool, float]] = []
+    quarantined = _quarantined_count(storage)
+    is_quarantined = getattr(storage, "is_quarantined", None)
     for i in range(n):
+        if is_quarantined is not None and is_quarantined(i):
+            continue
         try:
             outcomes.append((storage.is_successful(i),
                              storage.get_required_time(i)))
@@ -220,6 +243,7 @@ def reproduction_stats(storage) -> Dict[str, Any]:
     rate = failures / runs if runs else 0.0
     stats: Dict[str, Any] = {
         "runs": runs,
+        "runs_quarantined": quarantined,
         "failures": failures,
         "failure_rate": round(rate, 4),
         "failure_rate_ci95": [round(lo, 4), round(hi, 4)],

@@ -86,6 +86,11 @@ def render_markdown(payload: Dict[str, Any]) -> str:
     extra = ""
     if cov.get("digest_errors"):
         extra = f", {_num(cov['digest_errors'])} digest errors"
+    if cov.get("runs_quarantined"):
+        # crash-quarantined runs (doc/robustness.md) are excluded from
+        # every statistic; say so whenever any exist
+        extra += (f", {_num(cov['runs_quarantined'])} quarantined "
+                  "(excluded)")
     out(f"- unique interleavings: {_num(cov.get('unique_interleavings'))} "
         f"/ {_num(cov.get('runs'))} runs "
         f"(coverage {_num(cov.get('coverage'))}, "

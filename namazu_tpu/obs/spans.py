@@ -63,6 +63,11 @@ SEARCH_STALL = "nmz_search_stall"
 SIDECAR_REQUESTS = "nmz_sidecar_requests_total"
 ENTITY_LABEL_OVERFLOW = "nmz_entity_label_overflow_total"
 
+# resilience plane (doc/robustness.md): unroutable-action drops and
+# liveness-watchdog stall declarations, by entity
+ACTIONS_UNROUTABLE = "nmz_actions_unroutable_total"
+ENTITY_STALLED = "nmz_entity_stalled_total"
+
 # experiment plane (cross-run aggregates, set by obs/analytics.py when a
 # payload is computed — GET /analytics, nmz-tpu tools report)
 EXPERIMENT_RUNS = "nmz_experiment_runs"
@@ -211,6 +216,34 @@ def action_dispatched(kind: str, e2e: Optional[float]) -> None:
             EVENT_E2E,
             "interception -> action dispatch, end to end",
         ).observe(e2e)
+
+
+def action_unroutable(entity: str) -> None:
+    """An action dropped because no endpoint ever carried an event for
+    its entity (EndpointHub.send_action) — the counter that replaces
+    silent log-and-drop during long experiments."""
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.counter(
+        ACTIONS_UNROUTABLE,
+        "actions dropped for lack of an entity -> endpoint route",
+        ("entity",),
+    ).labels(entity=_entity_label(reg, entity)).inc()
+
+
+def entity_stalled(entity: str) -> None:
+    """The liveness watchdog declared an entity dead (no event within
+    the configured timeout while events sat parked on its behalf)."""
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.counter(
+        ENTITY_STALLED,
+        "liveness-watchdog stall declarations (parked events force-"
+        "released)",
+        ("entity",),
+    ).labels(entity=_entity_label(reg, entity)).inc()
 
 
 def rest_request(method: str, code: int) -> None:

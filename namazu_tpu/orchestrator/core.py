@@ -73,6 +73,17 @@ class Orchestrator:
         # receiving this many _FWD_DONE markers
         self._started = False
         self._shut_down = False
+        # liveness watchdog (doc/robustness.md): entities silent past
+        # the timeout are declared dead and their parked events force-
+        # released, so one hung/killed testee process cannot park the
+        # run behind delays nobody will ever observe. 0 = disabled.
+        self.liveness_timeout_s = float(
+            config.get("entity_liveness_timeout_s", 0) or 0)
+        self._watchdog_stop = threading.Event()
+        # entities currently declared dead; an entity leaves the set
+        # when it is seen again (metric + warning fire per transition,
+        # not per sweep)
+        self._stalled: set = set()
 
     @staticmethod
     def _default_hub(config: Config) -> EndpointHub:
@@ -116,6 +127,8 @@ class Orchestrator:
         self._add_thread(self._control_loop, "control")
         self._add_thread(self._forward_loop_factory(self.policy), "fwd-policy")
         self._add_thread(self._forward_loop_factory(self.dumb), "fwd-dumb")
+        if self.liveness_timeout_s > 0:
+            self._add_thread(self._watchdog_loop, "watchdog")
         log.debug("orchestrator started (enabled=%s)", self.enabled)
 
     def shutdown(self) -> SingleTrace:
@@ -140,7 +153,10 @@ class Orchestrator:
         self._threads["fwd-policy"].join(timeout=10)
         self._threads["fwd-dumb"].join(timeout=10)
         self._threads["actions"].join(timeout=10)
-        # 4. control loop + transports
+        # 4. watchdog, control loop + transports
+        self._watchdog_stop.set()
+        if "watchdog" in self._threads:
+            self._threads["watchdog"].join(timeout=10)
         self.hub.control_queue.put(_STOP)  # type: ignore[arg-type]
         self._threads["control"].join(timeout=10)
         self.hub.shutdown()
@@ -208,6 +224,41 @@ class Orchestrator:
                     log.exception("orchestrator-side action failed: %r", action)
             else:
                 self.hub.send_action(action)
+
+    def _watchdog_loop(self) -> None:
+        """Liveness sweep: declare entities silent past the timeout dead
+        and force-release their parked events from both policies' delay
+        queues, surfacing each transition in ``nmz_entity_stalled_total``
+        and one WARNING — instead of the run silently waiting out delays
+        for a testee that no longer exists."""
+        interval = max(min(self.liveness_timeout_s / 4.0, 1.0), 0.05)
+        while not self._watchdog_stop.wait(interval):
+            self.sweep_stalled_entities()
+
+    def sweep_stalled_entities(self) -> int:
+        """One watchdog pass (public for tests and embedded callers);
+        returns how many parked events were force-released."""
+        stalled = self.hub.stalled_entities(self.liveness_timeout_s)
+        released = 0
+        for entity, silent_for in stalled.items():
+            n = 0
+            for pol in (self.policy, self.dumb):
+                try:
+                    n += pol.force_release_entity(entity)
+                except Exception:
+                    log.exception("force-release for entity %s failed "
+                                  "in policy %s", entity, pol.name)
+            released += n
+            if entity not in self._stalled:
+                self._stalled.add(entity)
+                obs.entity_stalled(entity)
+                log.warning(
+                    "entity %s declared dead (silent %.1fs > %.1fs); "
+                    "force-released %d parked event(s)",
+                    entity, silent_for, self.liveness_timeout_s, n)
+        # entities that spoke again re-arm their stall transition
+        self._stalled &= set(stalled)
+        return released
 
     def _control_loop(self) -> None:
         while True:

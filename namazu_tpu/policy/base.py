@@ -65,6 +65,13 @@ class ExplorePolicy:
     def queue_event(self, event: Event) -> None:
         raise NotImplementedError
 
+    def force_release_entity(self, entity_id: str) -> int:
+        """Release any events parked for ``entity_id`` immediately;
+        returns how many were released. Called by the orchestrator's
+        liveness watchdog when the entity is declared dead — the default
+        is a no-op for policies without a delay queue."""
+        return 0
+
     def start(self) -> None:
         """Start worker threads (idempotent)."""
 
@@ -116,6 +123,10 @@ class QueueBackedPolicy(ExplorePolicy):
 
     def _action_for(self, event: Event) -> Action:
         return event.default_action()
+
+    def force_release_entity(self, entity_id: str) -> int:
+        return self._queue.expedite(
+            lambda ev: getattr(ev, "entity_id", None) == entity_id)
 
     def shutdown(self) -> None:
         """Release all still-delayed events immediately, wait for the

@@ -52,6 +52,12 @@ class HistoryStorage:
     ) -> None:
         raise NotImplementedError
 
+    def quarantine_current_run(self, reason: str = "") -> None:
+        """Mark the in-flight run dir as deliberately abandoned (infra
+        failure / deadline abort: nothing will be recorded). Keeps an
+        aborted run distinguishable from a crashed one — fsck treats
+        marked dirs as accounted for, unmarked ones as findings."""
+
     # -- queries ---------------------------------------------------------
 
     def run_dir(self, i: int) -> str:
@@ -62,6 +68,17 @@ class HistoryStorage:
 
     def nr_stored_histories(self) -> int:
         raise NotImplementedError
+
+    def is_quarantined(self, i: int) -> bool:
+        """Whether run ``i`` was quarantined as incomplete (crash-safety;
+        see storage/naive.py). Quarantined runs raise StorageError from
+        every per-run query so partial data cannot pollute cross-run
+        statistics; backends without crash detection report none."""
+        return False
+
+    def quarantined_runs(self) -> List[int]:
+        return [i for i in range(self.nr_stored_histories())
+                if self.is_quarantined(i)]
 
     def get_stored_history(self, i: int) -> SingleTrace:
         raise NotImplementedError

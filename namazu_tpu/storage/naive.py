@@ -10,9 +10,19 @@ Parity: /root/reference/nmz/historystorage/naive — layout per storage dir:
     00000000/             one dir per run (%08x, parity naive.go:143-158)
         trace.json        the action sequence (JSON, not gob)
         result.json       {"successful": bool, "required_time": s, "metadata": {}}
+        INCOMPLETE        quarantine marker (crash-safety, doc/robustness.md):
+                          written by init()/fsck when a run crashed after
+                          recording its trace but before its result. A
+                          quarantined run is invisible to every query —
+                          analytics, repro-rate stats, the search plane's
+                          history ingest — so a partial run cannot pollute
+                          cross-run statistics. ``nmz-tpu tools fsck``
+                          lists and repairs quarantined runs.
 
 The reference also writes per-action ``actions/<i>.{action,event}.json``
 files; here the whole trace is one JSON array — same information, one file.
+All JSON writes are atomic (utils/atomic.py: tmp + fsync + rename), so a
+SIGKILL mid-write leaves the previous complete content, never a torn file.
 """
 
 from __future__ import annotations
@@ -22,7 +32,14 @@ import os
 from typing import Any, Dict, Iterable, List, Optional
 
 from namazu_tpu.storage.base import HistoryStorage, StorageError, register_storage
+from namazu_tpu.utils.atomic import atomic_write_json, atomic_write_text, is_tmp_artifact
+from namazu_tpu.utils.log import get_logger
 from namazu_tpu.utils.trace import SingleTrace
+
+log = get_logger("storage.naive")
+
+#: quarantine marker file inside a run dir (see module docstring)
+INCOMPLETE_MARKER = "INCOMPLETE"
 
 
 @register_storage
@@ -50,8 +67,11 @@ class NaiveStorage(HistoryStorage):
             return json.load(f)
 
     def _save_meta(self) -> None:
-        with open(self._meta_path(), "w") as f:
-            json.dump({"type": self.NAME, "next_run": self._next_run}, f)
+        atomic_write_json(self._meta_path(),
+                          {"type": self.NAME, "next_run": self._next_run})
+
+    def _marker_path(self, i: int) -> str:
+        return os.path.join(self.run_dir(i), INCOMPLETE_MARKER)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -66,6 +86,28 @@ class NaiveStorage(HistoryStorage):
         if not os.path.exists(self._meta_path()):
             raise StorageError(f"not a storage dir: {self.dir}")
         self._next_run = int(self._load_meta()["next_run"])
+        self._quarantine_crashed_runs()
+
+    def _quarantine_crashed_runs(self) -> None:
+        """Mark run dirs holding a trace but no result: the signature of
+        a run killed between ``record_new_trace`` and ``record_result``.
+        Dirs with NEITHER file are left unmarked here — an in-flight run
+        looks exactly like that, and init() runs concurrently with live
+        runs (the /analytics route loads the storage mid-experiment);
+        ``tools fsck --repair``, which only an operator invokes on a
+        quiescent storage, marks those too."""
+        for i in range(self._next_run):
+            run_dir = self.run_dir(i)
+            if (os.path.exists(os.path.join(run_dir, "trace.json"))
+                    and not os.path.exists(
+                        os.path.join(run_dir, "result.json"))
+                    and not os.path.exists(self._marker_path(i))):
+                atomic_write_text(
+                    self._marker_path(i),
+                    "crashed between trace and result; quarantined by "
+                    "init()\n")
+                log.warning("run %08x has a trace but no result (crash "
+                            "mid-run); quarantined", i)
 
     # -- per-run ---------------------------------------------------------
 
@@ -80,8 +122,9 @@ class NaiveStorage(HistoryStorage):
     def record_new_trace(self, trace: SingleTrace) -> None:
         if self._current_run_dir is None:
             raise StorageError("no working dir; call create_new_working_dir first")
-        with open(os.path.join(self._current_run_dir, "trace.json"), "w") as f:
-            f.write(trace.to_json())
+        atomic_write_text(
+            os.path.join(self._current_run_dir, "trace.json"),
+            trace.to_json())
 
     def record_result(
         self,
@@ -91,15 +134,90 @@ class NaiveStorage(HistoryStorage):
     ) -> None:
         if self._current_run_dir is None:
             raise StorageError("no working dir; call create_new_working_dir first")
-        with open(os.path.join(self._current_run_dir, "result.json"), "w") as f:
-            json.dump(
-                {
-                    "successful": successful,
-                    "required_time": required_time,
-                    "metadata": metadata or {},
-                },
-                f,
-            )
+        atomic_write_json(
+            os.path.join(self._current_run_dir, "result.json"),
+            {
+                "successful": successful,
+                "required_time": required_time,
+                "metadata": metadata or {},
+            },
+        )
+        # a concurrent init() (live /analytics scrape) may have seen the
+        # trace-no-result window just above and quarantined this run;
+        # the result landing proves it completed
+        marker = os.path.join(self._current_run_dir, INCOMPLETE_MARKER)
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+    # -- quarantine ------------------------------------------------------
+
+    def quarantine_current_run(self, reason: str = "") -> None:
+        if self._current_run_dir is None:
+            return
+        atomic_write_text(
+            os.path.join(self._current_run_dir, INCOMPLETE_MARKER),
+            (reason or "run aborted; nothing recorded") + "\n")
+
+    def is_quarantined(self, i: int) -> bool:
+        return os.path.exists(self._marker_path(i))
+
+    def quarantined_runs(self) -> List[int]:
+        return [i for i in range(self._next_run) if self.is_quarantined(i)]
+
+    def fsck(self, repair: bool = False) -> Dict[str, Any]:
+        """Integrity report over every allocated run dir; with
+        ``repair``, quarantine incomplete runs (including trace-less
+        ones — fsck is operator-invoked on a quiescent storage, so the
+        in-flight ambiguity init() must respect does not apply) and
+        sweep orphan ``*.tmp`` files a hard kill left mid-atomic-write.
+        """
+        report: Dict[str, Any] = {
+            "dir": self.dir,
+            "next_run": self._next_run,
+            "complete": 0,
+            "quarantined": [],
+            "incomplete_unmarked": [],
+            "missing_dirs": [],
+            "tmp_artifacts": [],
+            "repaired": repair,
+        }
+        for i in range(self._next_run):
+            run_dir = self.run_dir(i)
+            if not os.path.isdir(run_dir):
+                report["missing_dirs"].append(i)
+                continue
+            for name in sorted(os.listdir(run_dir)):
+                if is_tmp_artifact(name):
+                    path = os.path.join(run_dir, name)
+                    report["tmp_artifacts"].append(path)
+                    if repair:
+                        os.unlink(path)
+            if self.is_quarantined(i):
+                report["quarantined"].append(i)
+            elif os.path.exists(os.path.join(run_dir, "result.json")):
+                report["complete"] += 1
+            else:
+                report["incomplete_unmarked"].append(i)
+                if repair:
+                    atomic_write_text(
+                        self._marker_path(i),
+                        "no result recorded; quarantined by fsck\n")
+        for name in sorted(os.listdir(self.dir)):
+            if is_tmp_artifact(name):
+                path = os.path.join(self.dir, name)
+                report["tmp_artifacts"].append(path)
+                if repair:
+                    os.unlink(path)
+        if repair:
+            # keep what was actually repaired visible: callers decide
+            # exit codes on it even though the dirs are now quarantined
+            report["repaired_runs"] = report["incomplete_unmarked"]
+            report["quarantined"] = sorted(
+                report["quarantined"] + report["incomplete_unmarked"])
+            report["incomplete_unmarked"] = []
+        else:
+            report["repaired_runs"] = []
+        return report
 
     # -- queries ---------------------------------------------------------
 
@@ -112,6 +230,8 @@ class NaiveStorage(HistoryStorage):
         return n
 
     def _result(self, i: int) -> Dict[str, Any]:
+        if self.is_quarantined(i):
+            raise StorageError(f"run {i:08x} is quarantined (INCOMPLETE)")
         path = os.path.join(self.run_dir(i), "result.json")
         if not os.path.exists(path):
             raise StorageError(f"run {i:08x} has no result")
@@ -119,6 +239,11 @@ class NaiveStorage(HistoryStorage):
             return json.load(f)
 
     def get_stored_history(self, i: int) -> SingleTrace:
+        # quarantined runs ARE likely to have a trace — refusing to
+        # serve it is the point: a crash-truncated run must not feed
+        # coverage stats or the search plane's archives
+        if self.is_quarantined(i):
+            raise StorageError(f"run {i:08x} is quarantined (INCOMPLETE)")
         path = os.path.join(self.run_dir(i), "trace.json")
         if not os.path.exists(path):
             raise StorageError(f"run {i:08x} has no trace")

@@ -1,0 +1,81 @@
+"""Crash-safe file writes.
+
+A ``kill -9`` (or power loss) in the middle of a plain ``open(...,
+"w")``/``json.dump`` leaves a torn file: half a JSON document where
+``storage.json`` or ``result.json`` used to be, which then poisons every
+later ``load_storage`` / analytics pass over the experiment. All
+persistent JSON in the storage layer goes through :func:`atomic_write`
+instead: write a sibling temp file, ``fsync`` it, ``os.replace`` onto
+the destination (atomic on POSIX within one filesystem), then best-
+effort ``fsync`` the directory so the rename itself survives a crash.
+
+The observable contract: at every instant the destination path either
+holds the complete previous content or the complete new content — never
+a prefix of the new one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any
+
+
+def atomic_write(path: str, data: bytes) -> None:
+    """Atomically replace ``path``'s content with ``data``."""
+    path = os.path.abspath(path)
+    dir_path = os.path.dirname(path)
+    # the temp file must live in the same directory: os.replace is only
+    # atomic within one filesystem
+    fd, tmp = tempfile.mkstemp(
+        dir=dir_path, prefix=os.path.basename(path) + ".", suffix=".tmp")
+    try:
+        try:
+            os.write(fd, data)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+    except BaseException:
+        # failed before the rename landed: the destination is untouched;
+        # don't leave the orphan temp behind (fsck also sweeps strays
+        # left by a hard kill, where this handler never runs)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(dir_path)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    atomic_write(path, text.encode())
+
+
+def atomic_write_json(path: str, obj: Any, **dump_kw) -> None:
+    atomic_write(path, json.dumps(obj, **dump_kw).encode())
+
+
+def _fsync_dir(dir_path: str) -> None:
+    """Persist a directory entry (the rename) to disk; best effort —
+    some filesystems refuse O_RDONLY directory fsync."""
+    try:
+        fd = os.open(dir_path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+#: suffix every in-flight atomic write carries; ``tools fsck`` sweeps
+#: orphans a hard kill left behind
+TMP_SUFFIX = ".tmp"
+
+
+def is_tmp_artifact(name: str) -> bool:
+    return name.endswith(TMP_SUFFIX)

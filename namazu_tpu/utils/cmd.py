@@ -10,8 +10,58 @@ drop-in compatibility with existing experiment scripts).
 from __future__ import annotations
 
 import os
+import signal
 import subprocess
+import time
 from typing import Optional
+
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("utils.cmd")
+
+#: SIGTERM -> SIGKILL escalation grace when a deadline kills a script's
+#: process group
+KILL_GRACE_S = 3.0
+
+
+def kill_process_group(proc: subprocess.Popen,
+                       grace: float = KILL_GRACE_S) -> None:
+    """Terminate ``proc``'s whole process group (it must have been
+    started with ``start_new_session=True``): SIGTERM first, SIGKILL
+    after ``grace`` seconds. Killing the *group* is the point — an
+    experiment ``run`` script forks testee processes and inspectors,
+    and killing only ``sh`` would orphan them into the next run."""
+    try:
+        pgid = os.getpgid(proc.pid)
+    except (OSError, ProcessLookupError):
+        return
+    try:
+        os.killpg(pgid, signal.SIGTERM)
+    except (OSError, ProcessLookupError):
+        return
+    try:
+        proc.wait(timeout=grace)
+    except subprocess.TimeoutExpired:
+        pass
+    # ALWAYS escalate the group: the direct child exiting on SIGTERM
+    # says nothing about a SIGTERM-ignoring grandchild
+    try:
+        os.killpg(pgid, signal.SIGKILL)
+    except (OSError, ProcessLookupError):
+        pass
+    try:
+        proc.wait(timeout=grace)
+    except subprocess.TimeoutExpired:
+        pass
+    # give group stragglers a moment to be reaped (SIGKILL cannot be
+    # ignored; this just bounds the observable window)
+    deadline = time.monotonic() + grace
+    while time.monotonic() < deadline:
+        try:
+            os.killpg(pgid, 0)
+        except (OSError, ProcessLookupError):
+            return  # group gone
+        time.sleep(0.05)
 
 
 class CmdFactory:
@@ -45,12 +95,34 @@ class CmdFactory:
         script: str,
         timeout: Optional[float] = None,
         cwd: Optional[str] = None,
+        deadline: Optional[float] = None,
     ) -> subprocess.CompletedProcess:
         """Run ``script`` with sh -c; stdout/stderr inherit the caller's
-        (experiment scripts print progress)."""
-        return subprocess.run(
-            ["sh", "-c", script],
-            env=self.env(),
-            cwd=cwd or self.working_dir or None,
-            timeout=timeout,
-        )
+        (experiment scripts print progress).
+
+        With ``deadline`` the script runs in its own session (process
+        group); on expiry the WHOLE group is killed (SIGTERM, then
+        SIGKILL) so forked testee children cannot outlive the phase, and
+        :class:`subprocess.TimeoutExpired` is raised. The plain
+        ``timeout`` keeps subprocess.run semantics (kills only ``sh``)
+        for callers that manage their own children."""
+        argv = ["sh", "-c", script]
+        run_cwd = cwd or self.working_dir or None
+        if deadline is None:
+            return subprocess.run(
+                argv, env=self.env(), cwd=run_cwd, timeout=timeout)
+        proc = subprocess.Popen(
+            argv, env=self.env(), cwd=run_cwd, start_new_session=True)
+        try:
+            proc.wait(timeout=deadline)
+        except subprocess.TimeoutExpired:
+            log.warning("script exceeded its %.1fs deadline; killing its "
+                        "process group: %s", deadline, script)
+            kill_process_group(proc)
+            raise subprocess.TimeoutExpired(argv, deadline) from None
+        except BaseException:
+            # interrupted mid-phase (e.g. KeyboardInterrupt): same
+            # no-orphans guarantee as the deadline path
+            kill_process_group(proc)
+            raise
+        return subprocess.CompletedProcess(argv, proc.returncode)

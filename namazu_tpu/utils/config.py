@@ -45,6 +45,16 @@ DEFAULTS: Dict[str, Any] = {
     "agent_port": -1,  # framed-TCP guest-agent endpoint (reference: pbPort)
     # do not start the exploration policy until REST /control enables it
     "skip_init_orchestration": False,
+    # liveness watchdog (doc/robustness.md): entities with no inbound
+    # event for this many seconds are declared dead and their parked
+    # events force-released (nmz_entity_stalled_total); 0 disables
+    "entity_liveness_timeout_s": 0,
+    # per-phase deadlines for the experiment scripts (seconds; 0 = none).
+    # enforced with process-group kill so a hung script's forked testee
+    # children die with it (utils/cmd.py, cli/run_cmd.py)
+    "run_deadline_s": 0,
+    "validate_deadline_s": 0,
+    "clean_deadline_s": 0,
     # observability plane (namazu_tpu/obs): event-lifecycle spans,
     # metrics registry, GET /metrics on the REST endpoint. Disabling
     # reduces the per-event hot path to one flag check (obs/metrics.py)
@@ -142,8 +152,12 @@ class Config:
         return dict(self._data)
 
     def dump_json(self, path: str) -> None:
-        with open(path, "w") as f:
-            json.dump(self._data, f, indent=2, sort_keys=True)
+        # atomic: the config snapshot is part of the storage's persistent
+        # state — a kill mid-init must not leave a torn config.json that
+        # poisons every later `run` (utils/atomic.py)
+        from namazu_tpu.utils.atomic import atomic_write_json
+
+        atomic_write_json(path, self._data, indent=2, sort_keys=True)
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"Config({self._data!r})"

@@ -1,0 +1,65 @@
+"""Bounded retry with capped exponential backoff + jitter.
+
+One policy for every transient-failure path in the stack — the REST
+transceiver's event POST, the campaign runner's infra-failure retries —
+so "how long do we keep trying" is tuned in one place. Full jitter
+(delay drawn uniformly from ``[0, min(cap, base * 2**attempt)]``)
+decorrelates retriers: N inspectors that lost the orchestrator at the
+same instant must not all re-knock at the same instant too.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Iterator, Optional, Tuple, Type, TypeVar
+
+T = TypeVar("T")
+
+
+def backoff_delays(
+    attempts: int,
+    base: float = 0.5,
+    cap: float = 10.0,
+    rng: Optional[random.Random] = None,
+) -> Iterator[float]:
+    """Yield up to ``attempts`` full-jitter backoff delays (seconds)."""
+    rng = rng or random.Random()
+    for attempt in range(attempts):
+        yield rng.uniform(0.0, min(cap, base * (2.0 ** attempt)))
+
+
+def retry_call(
+    fn: Callable[[], T],
+    exceptions: Tuple[Type[BaseException], ...],
+    attempts: int = 4,
+    base: float = 0.5,
+    cap: float = 10.0,
+    rng: Optional[random.Random] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    on_retry: Optional[Callable[[BaseException, int, float], None]] = None,
+) -> T:
+    """Call ``fn`` up to ``attempts`` times, backing off between tries.
+
+    Only ``exceptions`` are retried; anything else propagates at once,
+    as does the final failure. ``on_retry(exc, attempt, delay)`` runs
+    before each backoff sleep (logging hook). ``sleep`` is injectable so
+    tests and interruptible callers (e.g. a transceiver whose stop event
+    doubles as the sleeper) control the wait.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    delays = backoff_delays(attempts - 1, base=base, cap=cap, rng=rng)
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except exceptions as e:
+            attempt += 1
+            try:
+                delay = next(delays)
+            except StopIteration:
+                raise e from None
+            if on_retry is not None:
+                on_retry(e, attempt, delay)
+            sleep(delay)

@@ -1,0 +1,236 @@
+"""Supervised campaign runner (ISSUE 4): classification, deadlines with
+process-group kill, infra retries + consecutive-failure stop, the
+resumable campaign.json checkpoint, and the CLI surface."""
+
+import json
+import os
+import time
+
+import pytest
+
+from namazu_tpu.campaign import (
+    CLASS_EXPERIMENT,
+    CLASS_INFRA,
+    CLASS_TIMEOUT,
+    Campaign,
+    CampaignError,
+    CampaignSpec,
+    EXIT_INFRA_STOP,
+    EXIT_OK,
+    load_checkpoint,
+    summarize,
+)
+from namazu_tpu.cli import cli_main
+from namazu_tpu.storage import load_storage
+
+
+def _init_storage(tmp_path, run="true", validate="true", name="st",
+                  clean=""):
+    materials = tmp_path / "materials"
+    materials.mkdir(exist_ok=True)
+    config = tmp_path / f"config-{name}.toml"
+    lines = [
+        'explore_policy = "dumb"',
+        f"run = {json.dumps(run)}",
+        f"validate = {json.dumps(validate)}",
+    ]
+    if clean:
+        lines.append(f"clean = {json.dumps(clean)}")
+    config.write_text("\n".join(lines) + "\n")
+    storage = str(tmp_path / name)
+    assert cli_main(["init", str(config), str(materials), storage]) == 0
+    return storage
+
+
+def _spec(storage, **kw):
+    kw.setdefault("runs", 2)
+    kw.setdefault("backoff_base_s", 0.01)
+    kw.setdefault("backoff_cap_s", 0.02)
+    kw.setdefault("seed", 7)
+    return CampaignSpec(storage_dir=storage, **kw)
+
+
+def test_campaign_happy_path(tmp_path):
+    storage = _init_storage(tmp_path)
+    campaign = Campaign(_spec(storage, runs=2))
+    assert campaign.run() == EXIT_OK
+    state = load_checkpoint(storage)
+    assert state["stopped_reason"] == "done"
+    assert [s["class"] for s in state["slots"]] == [CLASS_EXPERIMENT] * 2
+    assert all(len(s["attempts"]) == 1 for s in state["slots"])
+    assert load_storage(storage).nr_stored_histories() == 2
+    summary = summarize(state)
+    assert summary["experiment"] == 2 and summary["unclassified"] == 0
+
+
+def test_campaign_requires_initialized_storage(tmp_path):
+    campaign = Campaign(_spec(str(tmp_path / "nope")))
+    with pytest.raises(CampaignError, match="not an initialized"):
+        campaign.run()
+
+
+def test_infra_failure_retries_then_stops(tmp_path):
+    storage = _init_storage(tmp_path, run="false")
+    campaign = Campaign(_spec(storage, runs=5, retries=1,
+                              max_consecutive_infra=2))
+    assert campaign.run() == EXIT_INFRA_STOP
+    state = campaign.state
+    assert state["stopped_reason"] == "infra"
+    # stopped after K=2 consecutive infra slots, not the full 5
+    assert [s["class"] for s in state["slots"]] == [CLASS_INFRA] * 2
+    # each slot burned its 1+retries attempts
+    assert all(len(s["attempts"]) == 2 for s in state["slots"])
+    assert all(a["exit_status"] == 1
+               for s in state["slots"] for a in s["attempts"])
+    # nothing polluted the repro stats
+    assert load_storage(storage).nr_stored_histories() == 0
+
+
+def test_hung_run_wall_deadline_kills_group(tmp_path):
+    """The acceptance scenario: a run script that sleeps forever. The
+    supervisor's wall deadline kills the whole child group, the slot is
+    classified timeout, zero runs land in the storage, and the campaign
+    exits with the distinct infra-failure status."""
+    storage = _init_storage(
+        tmp_path,
+        run='sleep 600 & echo $! > "$NMZ_WORKING_DIR/child.pid"; '
+            'sleep 600')
+    campaign = Campaign(_spec(storage, runs=3, retries=0,
+                              run_wall_deadline_s=3.0,
+                              max_consecutive_infra=2))
+    t0 = time.monotonic()
+    assert campaign.run() == EXIT_INFRA_STOP
+    assert time.monotonic() - t0 < 120
+    state = campaign.state
+    assert [s["class"] for s in state["slots"]] == [CLASS_TIMEOUT] * 2
+    assert all(s["attempts"][0]["wall_deadline_hit"]
+               for s in state["slots"])
+    # zero runs recorded in repro-rate stats
+    assert load_storage(storage).nr_stored_histories() == 0
+    # no orphan from the killed group
+    for i in range(2):
+        pid_file = os.path.join(storage, f"{i:08x}", "child.pid")
+        if not os.path.exists(pid_file):
+            continue  # killed before the shell wrote it
+        with open(pid_file) as f:
+            pid = int(f.read().strip())
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and _alive(pid):
+            time.sleep(0.1)
+        assert not _alive(pid), f"orphan {pid} outlived its run"
+
+
+def _alive(pid):
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return f.read().split(")")[-1].split()[0] != "Z"
+    except OSError:
+        return False
+
+
+def test_phase_deadline_classified_timeout(tmp_path):
+    """A child-enforced phase deadline (exit 124) classifies as timeout
+    too — same class, different enforcement point."""
+    storage = _init_storage(tmp_path, run="sleep 600")
+    campaign = Campaign(_spec(storage, runs=1, retries=0,
+                              run_deadline_s=1.0,
+                              max_consecutive_infra=1))
+    assert campaign.run() == EXIT_INFRA_STOP
+    slot = campaign.state["slots"][0]
+    assert slot["class"] == CLASS_TIMEOUT
+    assert slot["attempts"][0]["exit_status"] == 124
+    assert slot["attempts"][0]["wall_deadline_hit"] is False
+
+
+def test_campaign_resumes_from_checkpoint(tmp_path):
+    """A campaign killed mid-way resumes from campaign.json: completed
+    slots are not re-run, the remainder is."""
+    storage = _init_storage(tmp_path)
+    assert Campaign(_spec(storage, runs=2)).run() == EXIT_OK
+    # simulate a supervisor crash after slot 2: the checkpoint is there,
+    # stopped_reason records "done" from the first campaign — a resumed
+    # campaign with a higher target keeps the prefix and continues
+    resumed = Campaign(_spec(storage, runs=4))
+    assert resumed.run(resume=True) == EXIT_OK
+    state = load_checkpoint(storage)
+    assert len(state["slots"]) == 4
+    assert state["stopped_reason"] == "done"
+    # exactly 4 runs on disk: slots 0-1 were NOT re-executed
+    assert load_storage(storage).nr_stored_histories() == 4
+
+
+def test_resume_after_infra_stop_attempts_again(tmp_path):
+    """An infra-stopped campaign must not instantly re-stop on resume:
+    the operator re-running IS the claim the environment is fixed, so
+    the consecutive-infra counter resets."""
+    storage = _init_storage(tmp_path, run="false")
+    assert Campaign(_spec(storage, runs=2, retries=0,
+                          max_consecutive_infra=1)).run() == EXIT_INFRA_STOP
+    # "fix the environment": a config.toml wins over the init snapshot
+    (tmp_path / storage.split("/")[-1] / "config.toml").write_text(
+        'explore_policy = "dumb"\nrun = "true"\nvalidate = "true"\n')
+    resumed = Campaign(_spec(storage, runs=2, retries=0,
+                             max_consecutive_infra=1))
+    assert resumed.run(resume=True) == EXIT_OK
+    state = load_checkpoint(storage)
+    assert [s["class"] for s in state["slots"]] == [CLASS_INFRA,
+                                                    CLASS_EXPERIMENT]
+    assert state["stopped_reason"] == "done"
+
+
+def test_campaign_no_resume_starts_fresh(tmp_path):
+    storage = _init_storage(tmp_path)
+    assert Campaign(_spec(storage, runs=1)).run() == EXIT_OK
+    campaign = Campaign(_spec(storage, runs=1))
+    assert campaign.run(resume=False) == EXIT_OK
+    # fresh campaign state (1 slot), but the storage keeps accumulating
+    assert len(campaign.state["slots"]) == 1
+    assert load_storage(storage).nr_stored_histories() == 2
+
+
+def test_checkpoint_written_during_backoff(tmp_path):
+    """The failed attempt is persisted BEFORE the backoff sleep, so a
+    supervisor crash mid-backoff does not forget it."""
+    storage = _init_storage(tmp_path, run="false")
+    campaign = Campaign(_spec(storage, runs=1, retries=1,
+                              max_consecutive_infra=1))
+    seen = []
+    original = campaign._checkpoint_partial
+
+    def spy(slot):
+        original(slot)
+        seen.append(json.load(open(campaign.checkpoint_path)))
+
+    campaign._checkpoint_partial = spy
+    campaign.run()
+    assert seen, "no partial checkpoint written"
+    partial = seen[0]["slots"][-1]
+    assert partial["in_progress"] is True
+    assert partial["class"] == CLASS_INFRA
+
+
+def test_summarize_flags_unclassified():
+    state = {"requested_runs": 2, "stopped_reason": "done",
+             "slots": [{"slot": 0, "class": "experiment"},
+                       {"slot": 1, "class": "mystery"}]}
+    summary = summarize(state)
+    assert summary["unclassified"] == 1
+    assert summary["experiment"] == 1
+
+
+def test_campaign_cli(tmp_path, capsys):
+    storage = _init_storage(tmp_path)
+    rc = cli_main(["campaign", storage, "-n", "2", "--json",
+                   "--backoff-base", "0.01"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    summary = json.loads(out)
+    assert summary["experiment"] == 2
+    assert summary["stopped_reason"] == "done"
+    assert summary["unclassified"] == 0
