@@ -1,4 +1,5 @@
-"""Benchmark: interleavings scored per second per chip.
+"""Benchmark: interleavings scored per second per chip — and, with
+``--pipeline``, events dispatched per second through the event plane.
 
 The reference explores ONE interleaving per wall-clock experiment run
 (minutes); its published metric is bug-repro rate per N runs (BASELINE.md).
@@ -10,8 +11,19 @@ matmul) at production sizes on the default device and compares against a
 single-thread numpy implementation of the same math (the CPU-python
 baseline a reference-style policy could at best use).
 
+``--pipeline`` measures the OTHER half of the serving path: a loopback
+inspector -> REST endpoint -> orchestrator -> policy -> action poll ->
+ack loop (doc/performance.md), reported as ``events_dispatched_per_sec``
+for both the batched fast path and the per-event compatibility wire on
+the same workload. No jax, no device probe — the event plane is pure
+control plane.
+
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Every completed round appends to BENCH_HISTORY.jsonl with a ``metric``
+field; ``--gate`` compares only against same-metric, same-platform
+history entries.
 """
 
 from __future__ import annotations
@@ -158,34 +170,73 @@ def append_history(record: dict, path: str = HISTORY_PATH) -> None:
         f.write(json.dumps(record, sort_keys=True) + "\n")
 
 
+#: the scorer bench's metric name — also the implied metric of history
+#: records that predate the ``metric`` field
+SCORER_METRIC = "interleavings_scored_per_sec_per_chip"
+PIPELINE_METRIC = "events_dispatched_per_sec"
+
+
+def _record_metric(rec: dict) -> str:
+    return rec.get("metric") or SCORER_METRIC
+
+
+def _record_value(rec: dict):
+    """The gated figure of a history record: generic ``value``, falling
+    back to the scorer records' historical ``schedules_per_sec`` key."""
+    v = rec.get("value")
+    if v is None:
+        v = rec.get("schedules_per_sec")
+    try:
+        return float(v) if v is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
 def gate_record(current: dict, history: list,
                 threshold_pct: float = GATE_DEFAULT_PCT,
                 window: int = GATE_BASELINE_WINDOW):
     """Regression gate: compare a fresh bench record against the best of
-    the last ``window`` same-platform history entries.
+    the last ``window`` same-platform, same-METRIC history entries
+    (scorer and pipeline rounds share one history file; a 5M schedules/s
+    figure must never baseline a 40k events/s one).
 
-    Returns ``(ok, reasons, baseline)``. A regression is a
-    ``schedules_per_sec`` (or, when both records carry one, ``coverage``)
-    figure more than ``threshold_pct`` percent below the baseline.
-    Cross-platform comparisons are refused by construction — a CPU
-    fallback reading 40k/s must never read as a 99.6% TPU regression
-    (the round-4 lesson all over again).
+    Returns ``(ok, reasons, baseline)``. A regression is a primary
+    figure (or, when both records carry one, ``coverage``) more than
+    ``threshold_pct`` percent below the baseline. Cross-platform
+    comparisons are refused by construction — a CPU fallback reading
+    40k/s must never read as a 99.6% TPU regression (the round-4 lesson
+    all over again).
     """
+    metric = _record_metric(current)
+    # pipeline records carry the transport mode and workload/tuning
+    # knobs: a per-event run must never be gated against a batched
+    # baseline (a documented ~14x gap), nor a window-0 run against a
+    # 50ms-window one — only like-configured records compare. Scorer
+    # records carry none of these keys, so their comparisons are
+    # unchanged.
+    CONFIG_KEYS = ("mode", "n_events", "n_entities", "batch_max",
+                   "flush_window", "poll_linger")
     same = [h for h in history
             if h.get("platform") == current.get("platform")
-            and h.get("schedules_per_sec")][-window:]
+            and _record_metric(h) == metric
+            and all(h.get(k) == current.get(k) for k in CONFIG_KEYS)
+            and _record_value(h)][-window:]
     reasons = []
     baseline = {}
     if not same:
         return True, [f"no {current.get('platform')!r} history to gate "
                       "against; pass"], baseline
     frac = threshold_pct / 100.0
-    base_rate = max(float(h["schedules_per_sec"]) for h in same)
-    baseline["schedules_per_sec"] = base_rate
-    cur_rate = float(current.get("schedules_per_sec") or 0.0)
+    # scorer records keep their historical key/label so pre-metric
+    # tooling (and humans) reading gate output see familiar names
+    label = "schedules/s" if metric == SCORER_METRIC else metric
+    key = "schedules_per_sec" if metric == SCORER_METRIC else "value"
+    base_rate = max(_record_value(h) for h in same)
+    baseline[key] = base_rate
+    cur_rate = _record_value(current) or 0.0
     if cur_rate < base_rate * (1.0 - frac):
         reasons.append(
-            f"schedules/s regression: {cur_rate:.1f} is "
+            f"{label} regression: {cur_rate:.1f} is "
             f"{100.0 * (1.0 - cur_rate / base_rate):.1f}% below the "
             f"recent best {base_rate:.1f} (threshold {threshold_pct:g}%)")
     covs = [float(h["coverage"]) for h in same
@@ -223,6 +274,150 @@ def numpy_score(delays, hint_ids, arrival, mask, pairs, archive, failures,
     return d2a - d2f - 0.01 * delays.mean(-1)
 
 
+def run_pipeline(n_events: int, n_entities: int, use_batch: bool,
+                 flush_window: float, batch_max: int,
+                 run_id: str, poll_linger: float = 0.02) -> float:
+    """One loopback event-plane run: real REST endpoint on an ephemeral
+    port, real orchestrator threads, the TPU policy with zero delays
+    (``max_interval=0`` — the measured quantity is plumbing, not
+    injected fuzz), one RestTransceiver per entity. Returns events/s
+    from first send to last acknowledged action received."""
+    from namazu_tpu.inspector.rest_transceiver import RestTransceiver
+    from namazu_tpu.orchestrator import Orchestrator
+    from namazu_tpu.policy import create_policy
+    from namazu_tpu.signal import PacketEvent
+    from namazu_tpu.utils.config import Config
+
+    cfg = Config({
+        "rest_port": 0,
+        "run_id": run_id,
+        "explore_policy": "tpu_search",
+        "explore_policy_param": {
+            "search_on_start": False,
+            "max_interval": 0,
+            "seed": 7,
+        },
+    })
+    policy = create_policy("tpu_search")
+    policy.load_config(cfg)
+    orc = Orchestrator(cfg, policy, collect_trace=False)
+    orc.start()
+    port = orc.hub.endpoint("rest").port
+    entities = [f"bench-{i}" for i in range(max(1, n_entities))]
+    txs = {
+        e: RestTransceiver(
+            e, f"http://127.0.0.1:{port}", use_batch=use_batch,
+            flush_window=flush_window, batch_max=batch_max,
+            # the poll side drains bursts: a wider receive batch plus a
+            # linger that matches the flush window keeps GET/DELETE
+            # round trips amortized over whole bursts
+            poll_batch=2 * batch_max, poll_linger=poll_linger)
+        for e in entities
+    }
+    try:
+        for tx in txs.values():
+            tx.start()
+        chans = []
+        t0 = time.perf_counter()
+        for i in range(n_events):
+            e = entities[i % len(entities)]
+            ev = PacketEvent.create(e, e, "peer", hint=f"h{i % 64}")
+            chans.append(txs[e].send_event(ev))
+        for ch in chans:
+            ch.get(timeout=120)
+        elapsed = time.perf_counter() - t0
+    finally:
+        for tx in txs.values():
+            tx.shutdown()
+        orc.shutdown()
+    return n_events / elapsed if elapsed > 0 else float("inf")
+
+
+def pipeline_main(args: argparse.Namespace) -> None:
+    """The ``--pipeline`` entry point: measure the batched fast path and
+    the per-event compatibility wire on the SAME loopback workload, emit
+    one JSON line with both figures, append to the bench history under
+    the ``events_dispatched_per_sec`` metric (skipped for --smoke — the
+    smoke workload is sized for CI liveness, not for measurement)."""
+    n_events = 64 if args.smoke else args.pipeline_events
+    n_entities = 2 if args.smoke else args.pipeline_entities
+    out = {
+        "metric": PIPELINE_METRIC,
+        "unit": "events/s",
+        # the figure is host-loopback-bound, not accelerator-bound;
+        # its own platform tag keeps the gate from ever comparing it
+        # against chip scorer numbers
+        "platform": "loopback",
+        "n_events": n_events,
+        "n_entities": n_entities,
+        "batch_max": args.batch_max,
+        "flush_window": args.flush_window,
+        "poll_linger": args.poll_linger,
+    }
+    if args.smoke:
+        out["smoke"] = True
+    per_event = batched = None
+    if args.pipeline_mode in ("both", "per-event"):
+        per_event = run_pipeline(
+            n_events, n_entities, use_batch=False,
+            flush_window=args.flush_window, batch_max=args.batch_max,
+            run_id=f"bench-pipeline-perevent-{os.getpid()}",
+            poll_linger=args.poll_linger)
+        out["per_event_events_per_sec"] = round(per_event, 1)
+    if args.pipeline_mode in ("both", "batched"):
+        batched = run_pipeline(
+            n_events, n_entities, use_batch=True,
+            flush_window=args.flush_window, batch_max=args.batch_max,
+            run_id=f"bench-pipeline-batched-{os.getpid()}",
+            poll_linger=args.poll_linger)
+        out["batched_events_per_sec"] = round(batched, 1)
+    primary = batched if batched is not None else per_event
+    out["value"] = round(primary, 1)
+    if batched is not None and per_event:
+        out["speedup"] = round(batched / per_event, 2)
+
+    prior = load_history(args.history)
+    record = {
+        "timestamp": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+        "revision": _code_revision(),
+        "metric": PIPELINE_METRIC,
+        "value": out["value"],
+        # the primary figure's transport mode — the gate only compares
+        # same-mode records
+        "mode": "batched" if batched is not None else "per-event",
+        "n_events": n_events,
+        "n_entities": n_entities,
+        "batch_max": args.batch_max,
+        "flush_window": args.flush_window,
+        "poll_linger": args.poll_linger,
+        "unit": out["unit"],
+        "platform": out["platform"],
+    }
+    if "speedup" in out:
+        record["speedup"] = out["speedup"]
+        record["per_event_events_per_sec"] = \
+            out["per_event_events_per_sec"]
+    if not args.smoke:
+        try:
+            append_history(record, args.history)
+        except OSError as e:  # the JSON line must still come out
+            print(f"# could not append bench history: {e}",
+                  file=sys.stderr)
+    if args.gate:
+        ok, reasons, baseline = gate_record(
+            record, prior, threshold_pct=args.gate_threshold)
+        out["gate"] = {"ok": ok, "threshold_pct": args.gate_threshold,
+                       "baseline": baseline, "reasons": reasons}
+        print(json.dumps(out))
+        if not ok:
+            for reason in reasons:
+                print(f"# GATE FAILED: {reason}", file=sys.stderr)
+            raise SystemExit(1)
+        return
+    print(json.dumps(out))
+
+
 def parse_args(argv=None) -> argparse.Namespace:
     ap = argparse.ArgumentParser(
         description="namazu_tpu scorer benchmark (one JSON line)")
@@ -243,11 +438,45 @@ def parse_args(argv=None) -> argparse.Namespace:
                          "unique-interleaving fraction from `nmz-tpu "
                          "tools report`) folded into the history record "
                          "and gated alongside schedules/s")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="measure the event plane instead of the "
+                         "scorer: a loopback inspector -> orchestrator "
+                         "-> policy -> ack loop, reported as "
+                         "events_dispatched_per_sec (no jax needed)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="with --pipeline: fixed tiny workload for CI "
+                         "liveness — completes fast, emits the JSON "
+                         "line, appends no history")
+    ap.add_argument("--pipeline-events", type=int, default=2000,
+                    metavar="N", help="events per pipeline run "
+                    "(default 2000)")
+    ap.add_argument("--pipeline-entities", type=int, default=2,
+                    metavar="K", help="concurrent loopback entities "
+                    "(default 2 — on small hosts more entities just "
+                    "multiply polling threads and GIL contention)")
+    ap.add_argument("--pipeline-mode", default="both",
+                    choices=("both", "batched", "per-event"),
+                    help="which transport(s) to measure (default both; "
+                         "the printed line carries each mode's figure)")
+    ap.add_argument("--batch-max", type=int, default=128, metavar="N",
+                    help="transceiver coalescing size cap (default 128)")
+    ap.add_argument("--flush-window", type=float, default=0.05,
+                    metavar="S", help="transceiver coalescing window in "
+                    "seconds; 0 = synchronous per-send flush "
+                    "(default 0.05)")
+    ap.add_argument("--poll-linger", type=float, default=0.05,
+                    metavar="S", help="server-side action-poll linger "
+                    "in seconds: after the first action, keep filling "
+                    "the batch this long (default 0.05)")
     return ap.parse_args(argv)
 
 
 def main(argv=None) -> None:
     args = parse_args(argv)
+    if args.pipeline:
+        # pure control plane: no jax import, no device probe, no
+        # CPU re-exec — the event plane runs the same everywhere
+        return pipeline_main(args)
     if os.environ.get("NMZ_BENCH_NO_PROBE") != "1" and _device_init_hangs():
         # re-exec on CPU so the bench always emits its JSON line (argv
         # forwarded: a gated bench must stay gated through the fallback)
@@ -424,6 +653,7 @@ def main(argv=None) -> None:
         "timestamp": datetime.datetime.now(
             datetime.timezone.utc).isoformat(timespec="seconds"),
         "revision": _code_revision(),
+        "metric": SCORER_METRIC,
         "schedules_per_sec": out["value"],
         "unit": out["unit"],
         "vs_baseline": out["vs_baseline"],

@@ -12,7 +12,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Dict, Optional, Set
+from typing import Dict, List, Optional, Set
 
 from namazu_tpu import obs
 from namazu_tpu.signal.action import Action
@@ -36,6 +36,12 @@ class Endpoint:
 
     def send_action(self, action: Action) -> None:
         raise NotImplementedError
+
+    def send_actions(self, actions: List[Action]) -> None:
+        """Dispatch a batch in order. Endpoints with a cheaper bulk path
+        (REST: one queue-lock acquisition per entity) override this."""
+        for action in actions:
+            self.send_action(action)
 
     def shutdown(self) -> None:
         pass
@@ -75,24 +81,46 @@ class EndpointHub:
 
     # -- inbound (transports call these) --------------------------------
 
+    def _note_inbound(self, event: Event, endpoint_name: str) -> None:
+        """Routing + liveness bookkeeping for one inbound event; caller
+        holds ``_lock``."""
+        prev = self._entity_route.get(event.entity_id)
+        if prev is not None and prev != endpoint_name:
+            log.warning(
+                "entity %s moved endpoint %s -> %s",
+                event.entity_id, prev, endpoint_name,
+            )
+        self._entity_route[event.entity_id] = endpoint_name
+        self._last_seen[event.entity_id] = time.monotonic()
+        # an entity that speaks again is routable again: re-arm its
+        # one-shot unroutable warning
+        self._warned_unroutable.discard(event.entity_id)
+
     def post_event(self, event: Event, endpoint_name: str) -> None:
         with self._lock:
-            prev = self._entity_route.get(event.entity_id)
-            if prev is not None and prev != endpoint_name:
-                log.warning(
-                    "entity %s moved endpoint %s -> %s",
-                    event.entity_id, prev, endpoint_name,
-                )
-            self._entity_route[event.entity_id] = endpoint_name
-            self._last_seen[event.entity_id] = time.monotonic()
-            # an entity that speaks again is routable again: re-arm its
-            # one-shot unroutable warning
-            self._warned_unroutable.discard(event.entity_id)
+            self._note_inbound(event, endpoint_name)
         event.mark_arrived()
         obs.mark(event, "intercepted")
         obs.event_intercepted(endpoint_name, event.entity_id)
         obs.record_intercepted(event, endpoint_name)
         self.event_queue.put(event)
+
+    def post_events(self, events: List[Event], endpoint_name: str) -> None:
+        """Batch ingress (the REST batch POST route): one ``_lock``
+        acquisition for the whole batch's routing bookkeeping, events
+        enqueued in arrival order."""
+        if not events:
+            return
+        with self._lock:
+            for event in events:
+                self._note_inbound(event, endpoint_name)
+        for event in events:
+            event.mark_arrived()
+            obs.mark(event, "intercepted")
+            obs.event_intercepted(endpoint_name, event.entity_id)
+            obs.record_intercepted(event, endpoint_name)
+            self.event_queue.put(event)
+        obs.event_batch("ingress", len(events))
 
     def post_control(self, control: Control) -> None:
         self.control_queue.put(control)
@@ -107,17 +135,53 @@ class EndpointHub:
             if first_drop:
                 self._warned_unroutable.add(action.entity_id)
         if name is None:
-            obs.action_unroutable(action.entity_id)
-            if first_drop:
-                log.warning(
-                    "no endpoint for entity %s; dropping %r (repeats "
-                    "counted in %s, logged at DEBUG)",
-                    action.entity_id, action, "nmz_actions_unroutable_total")
-            else:
-                log.debug("no endpoint for entity %s; dropping %r",
-                          action.entity_id, action)
+            self._drop_unroutable(action, first_drop)
             return
         self._endpoints[name].send_action(action)
+
+    def _drop_unroutable(self, action: Action, first_drop: bool) -> None:
+        obs.action_unroutable(action.entity_id)
+        if first_drop:
+            log.warning(
+                "no endpoint for entity %s; dropping %r (repeats "
+                "counted in %s, logged at DEBUG)",
+                action.entity_id, action, "nmz_actions_unroutable_total")
+        else:
+            log.debug("no endpoint for entity %s; dropping %r",
+                      action.entity_id, action)
+
+    def send_actions(self, actions: List[Action]) -> None:
+        """Batch dispatch (the orchestrator's action loop drains its
+        merged queue greedily): routes for the whole batch are resolved
+        under ONE ``_lock`` acquisition, then each endpoint receives its
+        sub-batch in order via its own bulk path. Size-1 batches take
+        this path too so the dispatch-occupancy histogram sees them —
+        "batches are always full" must be falsifiable from the metric."""
+        if not actions:
+            return
+        routed: Dict[str, List[Action]] = {}
+        drops = []
+        with self._lock:
+            for action in actions:
+                name = self._entity_route.get(action.entity_id)
+                if name is None:
+                    first = (action.entity_id
+                             not in self._warned_unroutable)
+                    if first:
+                        self._warned_unroutable.add(action.entity_id)
+                    drops.append((action, first))
+                else:
+                    routed.setdefault(name, []).append(action)
+        for action, first_drop in drops:
+            self._drop_unroutable(action, first_drop)
+        n_routed = 0
+        for name, batch in routed.items():
+            self._endpoints[name].send_actions(batch)
+            n_routed += len(batch)
+        if n_routed:
+            # dropped actions were not dispatched; they must not inflate
+            # the occupancy histogram
+            obs.event_batch("dispatch", n_routed)
 
     # -- liveness (the orchestrator's watchdog reads these) -------------
 

@@ -11,6 +11,20 @@ Capability parity with /root/reference/nmz/endpoint/rest
 * ``DELETE /api/v3/actions/{entity}/{uuid}``— acknowledge/remove an action
 * ``POST /api/v3/control?op=enableOrchestration|disableOrchestration``
 
+Batch fast path (doc/performance.md) — the per-event routes above stay
+wire-compatible for old inspectors; new transceivers amortize the
+per-request overhead across whole batches:
+
+* ``POST /api/v3/events/{entity}/batch``    — submit a JSON array of
+  events in one request; each uuid rides the same dedupe ring as the
+  per-event route, so a retried batch whose 200 was lost replays
+  idempotently (``{"accepted": N, "duplicates": M}``)
+* ``GET /api/v3/actions/{entity}?batch=N``  — long-poll up to N queued
+  actions in one response (``{"actions": [...]}``; 204 when none)
+* ``DELETE /api/v3/actions/{entity}``       — multi-uuid acknowledge,
+  body ``{"uuids": [...]}``; unknown uuids are reported, not an error
+  (``{"deleted": [...], "missing": [...]}``)
+
 Operator surface at the server root (not under the API root — that is
 the inspector wire): ``GET /metrics`` + ``/metrics.json`` (PR 1),
 ``GET /healthz`` (liveness + active run id), ``GET /traces`` (recorded
@@ -25,6 +39,7 @@ request, which long-polling requires anyway; no third-party HTTP stack.
 
 from __future__ import annotations
 
+import itertools
 import json
 import re
 import threading
@@ -47,6 +62,7 @@ log = get_logger("endpoint.rest")
 API_ROOT = "/api/v3"
 
 _EVENTS_RE = re.compile(rf"^{API_ROOT}/events/([^/]+)/([^/]+)$")
+_EVENTS_BATCH_RE = re.compile(rf"^{API_ROOT}/events/([^/]+)/batch$")
 _ACTIONS_RE = re.compile(rf"^{API_ROOT}/actions/([^/]+)(?:/([^/]+))?$")
 _CONTROL_RE = re.compile(rf"^{API_ROOT}/control$")
 _TRACES_RE = re.compile(r"^/traces(?:/([^/]+))?$")
@@ -58,49 +74,115 @@ class ActionQueue:
     Parity: /root/reference/nmz/endpoint/rest/queue/restqueue.go:20-135 —
     ``peek`` blocks until non-empty; a newer concurrent peek supersedes the
     older one; ``delete`` acknowledges by uuid.
+
+    Storage is an insertion-ordered uuid->action dict (dicts preserve
+    insertion order), so ``delete`` is O(1) instead of the old linear
+    scan — at batch depths a DELETE ack of the queue tail no longer costs
+    a walk over every action still in flight.
     """
 
     def __init__(self) -> None:
-        self._items: List[Action] = []
+        self._items: "Dict[str, Action]" = {}
         self._cond = threading.Condition()
         self._peek_gen = 0
 
     def put(self, action: Action) -> None:
         with self._cond:
-            self._items.append(action)
+            self._items[action.uuid] = action
             self._cond.notify_all()
+
+    def put_many(self, actions: List[Action]) -> None:
+        """Enqueue a whole batch under one lock acquisition + one wakeup
+        (the hub's batch fan-through calls this per entity)."""
+        if not actions:
+            return
+        with self._cond:
+            for action in actions:
+                self._items[action.uuid] = action
+            self._cond.notify_all()
+
+    def _wait_nonempty(self, timeout: Optional[float]) -> Optional[int]:
+        """Block until non-empty; returns this poller's generation, or
+        None on timeout or supersession. Caller holds the lock."""
+        self._peek_gen += 1
+        my_gen = self._peek_gen
+        self._cond.notify_all()  # wake any older poller so it can yield
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self._items:
+                return my_gen
+            if my_gen != self._peek_gen:
+                return None  # superseded
+            remaining = None if deadline is None \
+                else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                return None
+            self._cond.wait(remaining)
 
     def peek(self, timeout: float = 30.0) -> Optional[Action]:
         """Return (without removing) the head action, blocking up to
         ``timeout``. Returns None on timeout or when superseded by a newer
         peek."""
         with self._cond:
-            self._peek_gen += 1
-            my_gen = self._peek_gen
-            self._cond.notify_all()  # wake any older poller so it can yield
-            end = threading.TIMEOUT_MAX if timeout is None else None
-            import time as _time
+            if self._wait_nonempty(timeout) is None:
+                return None
+            return next(iter(self._items.values()))
 
-            deadline = None if end else _time.monotonic() + timeout
-            while True:
-                if self._items:
-                    return self._items[0]
-                if my_gen != self._peek_gen:
-                    return None  # superseded
-                remaining = None if deadline is None else deadline - _time.monotonic()
-                if remaining is not None and remaining <= 0:
-                    return None
-                self._cond.wait(remaining)
+    def peek_batch(self, max_n: int, timeout: float = 30.0,
+                   linger: float = 0.0) -> List[Action]:
+        """Return (without removing) up to ``max_n`` head actions,
+        blocking like :meth:`peek` until at least one is present. The
+        batch GET route's body: whatever is queued NOW ships in one
+        response instead of one long-poll round trip per action.
+
+        ``linger`` > 0 trades that many seconds of delivery latency for
+        occupancy: after the first action lands, keep collecting until
+        the batch is full or the linger expires — at production rates a
+        few ms of linger turns per-action round trips into full
+        batches."""
+        max_n = max(1, max_n)
+        with self._cond:
+            my_gen = self._wait_nonempty(timeout)
+            if my_gen is None:
+                return []
+            if linger > 0 and len(self._items) < max_n:
+                deadline = time.monotonic() + linger
+                while len(self._items) < max_n:
+                    if self._peek_gen != my_gen:
+                        # a newer poll arrived mid-linger: yield to it
+                        # (like peek does), or both pollers would be
+                        # handed — and dispatch — the same actions
+                        return []
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+            return list(itertools.islice(self._items.values(), max_n))
 
     def delete(self, uuid: str) -> Optional[Action]:
         """Remove and return the action with ``uuid``, or None."""
         with self._cond:
-            for i, a in enumerate(self._items):
-                if a.uuid == uuid:
-                    del self._items[i]
-                    self._cond.notify_all()
-                    return a
-            return None
+            action = self._items.pop(uuid, None)
+            if action is not None:
+                self._cond.notify_all()
+            return action
+
+    def delete_many(self, uuids: List[str]):
+        """Remove a batch of uuids under one lock acquisition; returns
+        ``(deleted_actions, missing_uuids)`` — a partial ack (some uuids
+        already acked or never queued) is data, not an error."""
+        deleted: List[Action] = []
+        missing: List[str] = []
+        with self._cond:
+            for uuid in uuids:
+                action = self._items.pop(uuid, None)
+                if action is None:
+                    missing.append(uuid)
+                else:
+                    deleted.append(action)
+            if deleted:
+                self._cond.notify_all()
+        return deleted, missing
 
     def __len__(self) -> int:
         with self._cond:
@@ -156,6 +238,13 @@ class RestEndpoint(Endpoint):
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # one response = ONE tcp segment: fully buffer the write
+            # side (handle_one_request flushes per response) and disable
+            # Nagle — header and body written as separate unbuffered
+            # segments interlock with the peer's delayed ACK and cost
+            # tens of ms per small request/response round trip
+            wbufsize = -1
+            disable_nagle_algorithm = True
 
             def log_message(self, fmt, *args):  # route to our logger
                 log.debug("http: " + fmt, *args)
@@ -180,6 +269,9 @@ class RestEndpoint(Endpoint):
 
             def do_POST(self) -> None:
                 url = urlparse(self.path)
+                m = _EVENTS_BATCH_RE.match(url.path)
+                if m:
+                    return self._post_event_batch(m.group(1))
                 m = _EVENTS_RE.match(url.path)
                 if m:
                     return self._post_event(m.group(1), m.group(2))
@@ -205,6 +297,48 @@ class RestEndpoint(Endpoint):
                     return self._reply(200, {"duplicate": True})
                 endpoint.hub.post_event(sig, endpoint.NAME)
                 self._reply(200, {})
+
+            def _post_event_batch(self, entity: str) -> None:
+                """One POST carrying a whole JSON array of events. The
+                batch is validated atomically (any malformed item 400s
+                the whole request — the client retries the batch, and
+                the dedupe ring makes the replay of already-accepted
+                uuids idempotent), then fanned into the hub in ONE
+                call."""
+                try:
+                    body = json.loads(self._read_body())
+                except ValueError as e:
+                    return self._reply(400, {"error": str(e)})
+                if isinstance(body, dict):
+                    body = body.get("events")
+                if not isinstance(body, list) or not body:
+                    return self._reply(
+                        400, {"error": "batch body must be a non-empty "
+                              "JSON array of events (or {\"events\": "
+                              "[...]})"})
+                events = []
+                for i, item in enumerate(body):
+                    try:
+                        sig = signal_from_jsonable(item)
+                    except (SignalError, ValueError, TypeError) as e:
+                        return self._reply(
+                            400, {"error": f"batch item {i}: {e}"})
+                    if not isinstance(sig, Event):
+                        return self._reply(
+                            400, {"error": f"batch item {i} is not an "
+                                  "event"})
+                    if sig.entity_id != entity:
+                        return self._reply(
+                            400, {"error": f"batch item {i} entity "
+                                  f"{sig.entity_id!r} does not match url "
+                                  f"entity {entity!r}"})
+                    events.append(sig)
+                fresh = [ev for ev in events
+                         if not endpoint.note_event_uuid(ev.uuid)]
+                if fresh:
+                    endpoint.hub.post_events(fresh, endpoint.NAME)
+                self._reply(200, {"accepted": len(fresh),
+                                  "duplicates": len(events) - len(fresh)})
 
             def _post_control(self, query: Dict[str, list]) -> None:
                 ops = query.get("op") or []
@@ -244,10 +378,41 @@ class RestEndpoint(Endpoint):
                 if not (m and m.group(2) is None):
                     return self._reply(404, {"error": f"no route {url.path}"})
                 entity = m.group(1)
-                action = endpoint._queue_for(entity).peek(endpoint.poll_timeout)
-                if action is None:
+                query = parse_qs(url.query)
+                raw_batch = (query.get("batch") or [None])[0]
+                if raw_batch is None:
+                    # per-event wire (pre-batch inspectors): one head
+                    # action as the whole body
+                    action = endpoint._queue_for(entity).peek(
+                        endpoint.poll_timeout)
+                    if action is None:
+                        return self._reply(204)
+                    return self._reply(200, action.to_jsonable())
+                try:
+                    max_n = int(raw_batch)
+                    if max_n <= 0:
+                        raise ValueError
+                except ValueError:
+                    return self._reply(
+                        400, {"error": f"bad batch={raw_batch!r} "
+                              "(want a positive integer)"})
+                raw_linger = (query.get("linger_ms") or ["0"])[0]
+                try:
+                    # capped: a client must not park this handler
+                    # thread for longer than a poll window
+                    linger = min(max(0.0, float(raw_linger)),
+                                 1000.0) / 1000.0
+                except ValueError:
+                    return self._reply(
+                        400, {"error": f"bad linger_ms={raw_linger!r} "
+                              "(want a number)"})
+                actions = endpoint._queue_for(entity).peek_batch(
+                    max_n, endpoint.poll_timeout, linger=linger)
+                if not actions:
                     return self._reply(204)
-                self._reply(200, action.to_jsonable())
+                obs.event_batch("actions_poll", len(actions))
+                self._reply(200, {"actions": [a.to_jsonable()
+                                              for a in actions]})
 
             def _get_analytics(self, query) -> None:
                 """Experiment-analytics surface (obs/analytics.py): the
@@ -309,17 +474,45 @@ class RestEndpoint(Endpoint):
             def do_DELETE(self) -> None:
                 url = urlparse(self.path)
                 m = _ACTIONS_RE.match(url.path)
-                if not (m and m.group(2)):
+                if not m:
                     return self._reply(404, {"error": f"no route {url.path}"})
                 entity, uuid = m.group(1), m.group(2)
+                if uuid is None:
+                    return self._delete_batch(entity)
                 action = endpoint._queue_for(entity).delete(uuid)
                 if action is not None:
-                    obs.mark(action, "acked")
-                    obs.record_acked(action)
-                    obs.rest_ack(entity, obs.latency(action, "dispatched"))
+                    self._ack(entity, action)
                     self._reply(200, {})
                 else:
                     self._reply(404, {"error": f"no action {uuid} for {entity}"})
+
+            def _ack(self, entity: str, action: Action) -> None:
+                obs.mark(action, "acked")
+                obs.record_acked(action)
+                obs.rest_ack(entity, obs.latency(action, "dispatched"))
+
+            def _delete_batch(self, entity: str) -> None:
+                """Multi-uuid acknowledge: ``{"uuids": [...]}`` in the
+                body, one queue-lock acquisition for the whole batch.
+                Unknown uuids come back in ``missing`` with a 200 — a
+                replayed ack (the 200 was lost in flight) is a normal
+                retry, not a client error."""
+                try:
+                    body = json.loads(self._read_body())
+                except ValueError as e:
+                    return self._reply(400, {"error": str(e)})
+                uuids = body.get("uuids") if isinstance(body, dict) else None
+                if (not isinstance(uuids, list) or not uuids
+                        or not all(isinstance(u, str) for u in uuids)):
+                    return self._reply(
+                        400, {"error": "body must be {\"uuids\": "
+                              "[\"...\", ...]}"})
+                deleted, missing = \
+                    endpoint._queue_for(entity).delete_many(uuids)
+                for action in deleted:
+                    self._ack(entity, action)
+                self._reply(200, {"deleted": [a.uuid for a in deleted],
+                                  "missing": missing})
 
         self._server = ThreadingHTTPServer((self._host, self._port), Handler)
         self._server.daemon_threads = True
@@ -346,3 +539,23 @@ class RestEndpoint(Endpoint):
 
     def send_action(self, action: Action) -> None:
         self._queue_for(action.entity_id).put(action)
+
+    def send_actions(self, actions: List[Action]) -> None:
+        """Batch fan-through: group by entity (order preserved within
+        each), resolve every queue under ONE ``_queues_lock``
+        acquisition, then one ``put_many`` (one queue lock + one
+        wakeup) per entity — instead of lock/unlock churn per action."""
+        if len(actions) == 1:
+            return self.send_action(actions[0])
+        by_entity: Dict[str, List[Action]] = {}
+        for action in actions:
+            by_entity.setdefault(action.entity_id, []).append(action)
+        with self._queues_lock:
+            queues = {}
+            for entity in by_entity:
+                q = self._queues.get(entity)
+                if q is None:
+                    q = self._queues[entity] = ActionQueue()
+                queues[entity] = q
+        for entity, batch in by_entity.items():
+            queues[entity].put_many(batch)

@@ -5,73 +5,366 @@ Parity: /root/reference/nmz/inspector/transceiver/resttransceiver.go —
 ``GET /actions/{entity}``, acknowledges with ``DELETE``, and dispatches the
 action to the per-event waiter queue; linear backoff on transport errors
 (resttransceiver.go:158-188).
+
+Event-plane fast path (doc/performance.md), on top of the parity wire:
+
+* **persistent keep-alive connections** — one ``http.client`` connection
+  for the outbound (POST) side and one owned by the receive thread, each
+  reused across requests/long-poll cycles with a single transparent
+  reconnect on a stale socket, instead of a fresh TCP handshake per
+  request;
+* **client-side event coalescing** — with ``use_batch`` (default),
+  ``_post`` buffers events and flushes them as one
+  ``POST /events/{entity}/batch`` when the buffer reaches ``batch_max``
+  OR ``flush_window`` seconds after the first buffered event, so
+  single-event latency is bounded by the window. ``flush_window=0``
+  (the default) flushes synchronously on the caller thread: same wire
+  batching, zero added latency, and transport errors still raise into
+  inspector code exactly like the per-event path;
+* **batched receive** — ``GET /actions/{entity}?batch=N`` drains up to N
+  actions per long-poll round trip, acknowledged with ONE multi-uuid
+  ``DELETE``.
+
+The coalescing/linger windows default to 0: a fuzzer's transport must
+not add latency the policy didn't choose (injected delays ARE the
+product), so out of the box the batch wire only amortizes what is
+already queued. Throughput deployments opt into windows explicitly —
+``bench.py --pipeline`` shows the trade (doc/performance.md).
+
+``use_batch=False`` speaks the exact pre-batch per-event wire (POST per
+event, single-action GET, per-uuid DELETE) — still over the persistent
+connections — for orchestrators that predate the batch routes.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
+import socket
 import threading
 import time
 import urllib.error
-import urllib.request
+from typing import List, Optional
+from urllib.parse import urlsplit
 
+from namazu_tpu import obs
 from namazu_tpu.endpoint.rest import API_ROOT
 from namazu_tpu.inspector.transceiver import Transceiver
 from namazu_tpu.signal.action import Action
-from namazu_tpu.signal.base import signal_from_jsonable
+from namazu_tpu.signal.base import SignalError, signal_from_jsonable
 from namazu_tpu.signal.event import Event
 from namazu_tpu.utils.log import get_logger
 from namazu_tpu.utils.retry import retry_call
 
 log = get_logger("transceiver.rest")
 
+#: transport errors worth retrying / backing off on: socket-level
+#: failures (URLError is an OSError subclass) and HTTP-protocol hiccups
+#: from a dropped keep-alive peer
+_TRANSPORT_ERRORS = (urllib.error.URLError, OSError,
+                     http.client.HTTPException)
+
+
+class TransientHTTPStatus(OSError):
+    """A retryable response status (5xx-class / overload): the old
+    urllib path raised HTTPError (a URLError subclass) for these, so
+    they rode the bounded POST retry — an OSError subclass keeps them
+    inside ``_TRANSPORT_ERRORS``."""
+
+
+def _check_post_status(status: int, what: str) -> None:
+    if status == 200:
+        return
+    if status >= 500 or status in (408, 429):
+        raise TransientHTTPStatus(f"{what} -> {status}")
+    raise RuntimeError(f"{what} -> {status}")
+
+
+class _KeepAliveConn:
+    """One persistent HTTP/1.1 connection to the orchestrator.
+
+    NOT thread-safe — each owner (the post path under its lock, the
+    receive thread) holds its own instance. A request on a stale
+    keep-alive socket (server restarted, idle timeout) gets ONE
+    transparent reconnect+replay; every request here is idempotent by
+    construction (event POSTs dedupe server-side, GET peeks, DELETE acks
+    report already-gone uuids as ``missing``)."""
+
+    def __init__(self, base_url: str, timeout: float, abort=None):
+        parts = urlsplit(base_url)
+        if parts.scheme not in ("http", "https"):
+            raise ValueError(f"unsupported scheme {parts.scheme!r}")
+        self._https = parts.scheme == "https"
+        self._host = parts.hostname or "127.0.0.1"
+        self._port = parts.port
+        self._timeout = timeout
+        # abort() true = owner is shutting down: a socket error then
+        # propagates instead of triggering the transparent replay (which
+        # on the long-poll path would block the shutdown for a whole
+        # poll window)
+        self._abort = abort
+        self._conn: Optional[http.client.HTTPConnection] = None
+
+    def request(self, method: str, path: str,
+                body: Optional[bytes] = None):
+        """Issue one request; returns ``(status, body_bytes)``."""
+        headers = {"Connection": "keep-alive"}
+        if body is not None:
+            headers["Content-Type"] = "application/json"
+        last_exc: Optional[BaseException] = None
+        for attempt in (0, 1):
+            if self._abort is not None and self._abort():
+                # owner is shutting down: do not open a FRESH connection
+                # (a post-close request would park in a new long-poll
+                # and outlive the shutdown join)
+                raise OSError("connection owner is shutting down")
+            # local reference: close() from the owner's shutdown path
+            # nulls the attribute concurrently; the socket error that
+            # close raises in us must surface as OSError, not as an
+            # AttributeError on a vanished connection object
+            conn = self._conn
+            if conn is None:
+                cls = (http.client.HTTPSConnection if self._https
+                       else http.client.HTTPConnection)
+                conn = self._conn = cls(self._host, self._port,
+                                        timeout=self._timeout)
+                try:
+                    conn.connect()
+                    # disable Nagle: the wire pattern here is small
+                    # request, wait for reply — exactly what Nagle +
+                    # delayed ACK turns into per-request stalls
+                    conn.sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                except (OSError, AttributeError):
+                    pass  # request() below surfaces real failures
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.will_close:
+                    self.close()
+                return resp.status, data
+            except (OSError, http.client.HTTPException) as e:
+                # stale socket: reconnect once and replay; a second
+                # failure is a real transport error for the caller's
+                # backoff machinery
+                self.close()
+                last_exc = e
+                if self._abort is not None and self._abort():
+                    raise
+        raise last_exc  # type: ignore[misc]
+
+    def close(self) -> None:
+        conn, self._conn = self._conn, None
+        if conn is not None:
+            sock = getattr(conn, "sock", None)
+            if sock is not None:
+                # a plain close() does NOT wake a thread blocked in
+                # recv() on this socket (the fd stays open until the
+                # read returns); shutdown() does — this is what breaks
+                # an in-flight long-poll at owner shutdown
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            try:
+                conn.close()
+            except Exception:
+                pass
+
 
 class RestTransceiver(Transceiver):
     def __init__(self, entity_id: str, orchestrator_url: str,
                  backoff_step: float = 0.5, backoff_max: float = 5.0,
-                 post_attempts: int = 4):
+                 post_attempts: int = 4, use_batch: bool = True,
+                 batch_max: int = 64, flush_window: float = 0.0,
+                 poll_batch: Optional[int] = None,
+                 poll_linger: float = 0.0):
         super().__init__(entity_id)
         self.base = orchestrator_url.rstrip("/") + API_ROOT
         self.backoff_step = backoff_step
         self.backoff_max = backoff_max
         self.post_attempts = post_attempts
+        self.use_batch = use_batch
+        self.batch_max = max(1, int(batch_max))
+        self.flush_window = max(0.0, float(flush_window))
+        # how many actions one long-poll round trip may drain, and how
+        # long the server may linger after the first action to fill the
+        # batch (seconds; latency <-> occupancy knob)
+        self.poll_batch = (self.batch_max if poll_batch is None
+                           else max(1, int(poll_batch)))
+        self.poll_linger = max(0.0, float(poll_linger))
+        self._path = urlsplit(self.base).path
         self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
+        self._thread: Optional[threading.Thread] = None
+        # outbound connection: shared by caller threads (and the flush
+        # thread), serialized by _conn_lock; the receive thread owns its
+        # own connection so a long-poll never blocks a POST
+        self._post_conn = _KeepAliveConn(self.base, timeout=30.0)
+        self._recv_conn = _KeepAliveConn(self.base, timeout=65.0,
+                                         abort=self._stop.is_set)
+        self._conn_lock = threading.Lock()
+        # coalescing buffer (use_batch): _buf_cond guards the buffer,
+        # _flush_lock serializes whole flushes so concurrent callers
+        # cannot reorder chunks on the wire
+        self._buf: List[Event] = []
+        self._buf_since = 0.0
+        self._buf_cond = threading.Condition()
+        self._flush_lock = threading.Lock()
+        self._flush_thread: Optional[threading.Thread] = None
 
     # -- outbound --------------------------------------------------------
 
     def _post(self, event: Event) -> None:
-        """POST the event, riding out transient transport hiccups with
-        bounded backoff + jitter: the receive loop already backs off,
-        but this path used to raise straight into inspector code on one
-        dropped connection — killing the inspector over a blip the next
-        attempt would have absorbed. Exhausted retries still raise (the
-        orchestrator is genuinely gone)."""
-        retry_call(
-            lambda: self._post_once(event),
-            exceptions=(urllib.error.URLError, OSError),
-            attempts=max(1, self.post_attempts),
-            base=self.backoff_step,
-            cap=self.backoff_max,
-            # an interruptible sleep: shutdown() aborts the backoff
-            sleep=self._stop.wait,
-            on_retry=lambda e, n, d: log.debug(
-                "event POST failed (%s); retry %d in %.2fs", e, n, d),
-        )
+        """Queue/POST the event. Per-event mode rides out transient
+        transport hiccups with bounded backoff + jitter (exhausted
+        retries still raise — the orchestrator is genuinely gone).
+        Batch mode appends to the coalescing buffer; the flush (size
+        cap, window expiry, or synchronous when ``flush_window=0``)
+        carries the same retry policy, and a replayed batch whose 200
+        was lost dedupes server-side."""
+        if not self.use_batch:
+            retry_call(
+                lambda: self._post_once(event),
+                exceptions=_TRANSPORT_ERRORS,
+                attempts=max(1, self.post_attempts),
+                base=self.backoff_step,
+                cap=self.backoff_max,
+                # an interruptible sleep: shutdown() aborts the backoff
+                sleep=self._stop.wait,
+                on_retry=lambda e, n, d: log.debug(
+                    "event POST failed (%s); retry %d in %.2fs", e, n, d),
+            )
+            return
+        if self.flush_window <= 0:
+            # window 0: post THIS event directly (a batch of one over
+            # the batch wire) instead of routing through the shared
+            # buffer — a concurrent sender's failing flush could
+            # otherwise drain this event and swallow its error, where
+            # the per-event path would have raised into this caller
+            retry_call(
+                lambda: self._post_batch_once([event], event.entity_id),
+                exceptions=_TRANSPORT_ERRORS,
+                attempts=max(1, self.post_attempts),
+                base=self.backoff_step,
+                cap=self.backoff_max,
+                sleep=self._stop.wait,
+                on_retry=lambda e, n, d: log.debug(
+                    "batch POST failed (%s); retry %d in %.2fs",
+                    e, n, d),
+            )
+            return
+        with self._buf_cond:
+            self._buf.append(event)
+            if len(self._buf) == 1:
+                self._buf_since = time.monotonic()
+            n = len(self._buf)
+            self._buf_cond.notify()
+        if n >= self.batch_max:
+            # synchronous flush at the size cap: backpressure on the
+            # sending thread
+            self._flush()
+        else:
+            self._ensure_flusher()
 
-    def _post_once(self, event: Event) -> None:
-        if self._stop.is_set():
+    def _post_once(self, event: Event, ignore_stop: bool = False) -> None:
+        if self._stop.is_set() and not ignore_stop:
             return  # shutting down: don't fight over a dying server
-        url = f"{self.base}/events/{event.entity_id}/{event.uuid}"
-        req = urllib.request.Request(
-            url,
-            data=event.to_json().encode(),
-            headers={"Content-Type": "application/json"},
-            method="POST",
-        )
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            if resp.status != 200:
-                raise RuntimeError(f"POST {url} -> {resp.status}")
+        path = f"{self._path}/events/{event.entity_id}/{event.uuid}"
+        with self._conn_lock:
+            t0 = time.perf_counter()
+            status, _ = self._post_conn.request(
+                "POST", path, body=event.to_json().encode())
+            obs.transport_rtt("post", time.perf_counter() - t0)
+        _check_post_status(status, f"POST {path}")
+
+    def _ensure_flusher(self) -> None:
+        if self._flush_thread is not None or self._stop.is_set():
+            return
+        with self._flush_lock:
+            if self._flush_thread is None and not self._stop.is_set():
+                self._flush_thread = threading.Thread(
+                    target=self._flush_loop,
+                    name=f"rest-flush-{self.entity_id}",
+                    daemon=True,
+                )
+                self._flush_thread.start()
+
+    def _flush_loop(self) -> None:
+        """Window clock: sleep until ``flush_window`` after the first
+        buffered event, then flush whatever accumulated. Events the
+        size-cap path already flushed synchronously just leave an empty
+        buffer behind — flushing nothing is free."""
+        while True:
+            with self._buf_cond:
+                while not self._buf and not self._stop.is_set():
+                    self._buf_cond.wait(0.5)
+                if self._stop.is_set():
+                    return  # shutdown() drains the buffer after joining
+                since = self._buf_since
+            delay = since + self.flush_window - time.monotonic()
+            if delay > 0 and self._stop.wait(delay):
+                return
+            try:
+                self._flush()
+            except Exception:
+                # the async path cannot raise into inspector code; the
+                # events are lost and their waiters will time out
+                log.exception(
+                    "batch flush failed after retries; events dropped")
+
+    def _flush(self) -> None:
+        """Drain the buffer onto the wire in ``batch_max`` chunks, in
+        order (``_flush_lock`` keeps concurrent flushers from
+        interleaving their chunks). Events are grouped by their OWN
+        entity id — the per-event wire routes by ``event.entity_id``,
+        so a transceiver may legitimately carry a neighbor entity's
+        events, and the batch route requires every item in a request to
+        match its url entity."""
+        with self._flush_lock:
+            with self._buf_cond:
+                batch, self._buf = self._buf, []
+            by_entity: "dict[str, List[Event]]" = {}
+            for event in batch:
+                by_entity.setdefault(event.entity_id, []).append(event)
+            for entity, events in by_entity.items():
+                for i in range(0, len(events), self.batch_max):
+                    chunk = events[i:i + self.batch_max]
+                    retry_call(
+                        lambda c=chunk, e=entity:
+                            self._post_batch_once(c, e),
+                        exceptions=_TRANSPORT_ERRORS,
+                        attempts=max(1, self.post_attempts),
+                        base=self.backoff_step,
+                        cap=self.backoff_max,
+                        sleep=self._stop.wait,
+                        on_retry=lambda e, n, d: log.debug(
+                            "batch POST failed (%s); retry %d in %.2fs",
+                            e, n, d),
+                    )
+
+    def _post_batch_once(self, chunk: List[Event],
+                         entity: Optional[str] = None) -> None:
+        entity = self.entity_id if entity is None else entity
+        body = json.dumps([ev.to_jsonable() for ev in chunk]).encode()
+        path = f"{self._path}/events/{entity}/batch"
+        with self._conn_lock:
+            t0 = time.perf_counter()
+            status, _ = self._post_conn.request("POST", path, body=body)
+            obs.transport_rtt("post_batch", time.perf_counter() - t0)
+        if status in (400, 404):
+            # a pre-batch orchestrator has no .../batch route (its
+            # per-event route reads "batch" as a uuid and 400s the list
+            # body): deliver this chunk per-event and stay legacy.
+            # ignore_stop: these events were already accepted into the
+            # buffer, and this may be shutdown's final flush — a silent
+            # early-return would drop them while reporting success
+            self._downgrade_to_legacy(f"batch POST -> {status}")
+            for event in chunk:
+                self._post_once(event, ignore_stop=True)
+            return
+        _check_post_status(status, f"POST {path}")
+        obs.event_batch("flush", len(chunk))
 
     # -- inbound ---------------------------------------------------------
 
@@ -85,46 +378,141 @@ class RestTransceiver(Transceiver):
             self._thread.start()
 
     def shutdown(self, join_timeout: float = 5.0) -> None:
-        """Stop and JOIN the receive thread (bounded): setting the flag
-        alone let the thread's in-flight long-poll outlive shutdown and
-        race the next run's transceiver for the same entity's actions."""
+        """Stop and JOIN the worker threads (bounded): setting the flag
+        alone let an in-flight long-poll outlive shutdown and race the
+        next run's transceiver for the same entity's actions. Events
+        still in the coalescing buffer get one final best-effort
+        flush."""
         self._stop.set()
+        with self._buf_cond:
+            self._buf_cond.notify_all()
+        ft = self._flush_thread
+        if ft is not None and ft is not threading.current_thread():
+            ft.join(timeout=join_timeout)
+        try:
+            self._flush()
+        except Exception:
+            log.debug("final flush failed during shutdown", exc_info=True)
         t = self._thread
         if t is not None and t is not threading.current_thread():
+            # break an in-flight long-poll: closing the socket under the
+            # receive thread makes its blocked read raise, and the loop
+            # exits on the stop flag instead of waiting out the server's
+            # poll window
+            self._recv_conn.close()
             t.join(timeout=join_timeout)
             if t.is_alive():
                 log.warning("receive thread still in a long-poll after "
                             "%.1fs; abandoning it (daemon)", join_timeout)
+        with self._conn_lock:
+            self._post_conn.close()
 
     def _receive_loop(self) -> None:
         backoff = 0.0
         while not self._stop.is_set():
             try:
-                action = self._poll_once()
+                actions = self._poll_once()
                 backoff = 0.0
-            except (urllib.error.URLError, OSError, RuntimeError) as e:
+            # SignalError: a malformed/version-skewed 200 body (unknown
+            # action class from a newer orchestrator) must back off and
+            # retry like any other bad response, not kill this thread
+            except (*_TRANSPORT_ERRORS, RuntimeError, ValueError,
+                    SignalError) as e:
                 backoff = min(backoff + self.backoff_step, self.backoff_max)
                 log.debug("poll error (%s); backing off %.1fs", e, backoff)
                 self._stop.wait(backoff)
                 continue
-            if action is not None:
+            for action in actions:
                 self.dispatch_action(action)
+        self._recv_conn.close()
 
-    def _poll_once(self) -> Action | None:
-        url = f"{self.base}/actions/{self.entity_id}"
-        req = urllib.request.Request(url, method="GET")
-        with urllib.request.urlopen(req, timeout=60) as resp:
-            if resp.status == 204:
-                return None
-            body = resp.read()
+    def _poll_once(self) -> List[Action]:
+        """One long-poll cycle over the receive thread's persistent
+        connection; returns the acknowledged actions (empty on a 204
+        timeout). Batch mode drains up to ``poll_batch`` actions and
+        acks them with one multi-uuid DELETE."""
+        if self.use_batch:
+            return self._poll_once_batch()
+        path = f"{self._path}/actions/{self.entity_id}"
+        t0 = time.perf_counter()
+        status, body = self._recv_conn.request("GET", path)
+        obs.transport_rtt("poll", time.perf_counter() - t0)
+        if status == 204:
+            return []
+        if status != 200:
+            raise RuntimeError(f"GET {path} -> {status}")
         d = json.loads(body)
         action = signal_from_jsonable(d)
         if not isinstance(action, Action):
-            raise RuntimeError(f"GET {url} returned non-action {d!r}")
+            raise RuntimeError(f"GET {path} returned non-action {d!r}")
         # acknowledge (parity: GET then DELETE, resttransceiver.go:139-156)
-        del_req = urllib.request.Request(
-            f"{url}/{action.uuid}", method="DELETE"
-        )
-        with urllib.request.urlopen(del_req, timeout=30):
-            pass
-        return action
+        t0 = time.perf_counter()
+        status, _ = self._recv_conn.request(
+            "DELETE", f"{path}/{action.uuid}")
+        obs.transport_rtt("ack", time.perf_counter() - t0)
+        # 404 = already acked: the keep-alive layer replays a DELETE
+        # whose 200 was lost on a dying socket, and the server dequeued
+        # the action on the first attempt — the action is in hand, so
+        # this is success, not an error (dropping it would hang the
+        # event's waiter)
+        if status not in (200, 404):
+            raise RuntimeError(f"DELETE {path}/{action.uuid} -> {status}")
+        return [action]
+
+    def _downgrade_to_legacy(self, why: str) -> None:
+        """The server predates the batch routes: fall back to the
+        per-event wire for the rest of this transceiver's life (still
+        over the persistent connections)."""
+        if self.use_batch:
+            self.use_batch = False
+            log.warning("orchestrator speaks the pre-batch wire (%s); "
+                        "falling back to per-event transport", why)
+
+    def _poll_once_batch(self) -> List[Action]:
+        path = f"{self._path}/actions/{self.entity_id}"
+        t0 = time.perf_counter()
+        linger_ms = int(self.poll_linger * 1000)
+        status, body = self._recv_conn.request(
+            "GET", f"{path}?batch={self.poll_batch}"
+                   f"&linger_ms={linger_ms}")
+        obs.transport_rtt("poll", time.perf_counter() - t0)
+        if status == 204:
+            return []
+        if status != 200:
+            raise RuntimeError(f"GET {path}?batch -> {status}")
+        doc = json.loads(body)
+        if not (isinstance(doc, dict)
+                and isinstance(doc.get("actions"), list)):
+            # a pre-batch orchestrator ignores the query and answers the
+            # per-event wire: one action object as the whole body —
+            # degrade gracefully instead of killing the receive thread
+            action = signal_from_jsonable(doc)
+            if not isinstance(action, Action):
+                raise RuntimeError(
+                    f"GET {path}?batch returned non-action {doc!r}")
+            self._downgrade_to_legacy("single-action poll body")
+            t0 = time.perf_counter()
+            status, _ = self._recv_conn.request(
+                "DELETE", f"{path}/{action.uuid}")
+            obs.transport_rtt("ack", time.perf_counter() - t0)
+            if status not in (200, 404):  # 404 = replayed ack
+                raise RuntimeError(
+                    f"DELETE {path}/{action.uuid} -> {status}")
+            return [action]
+        actions: List[Action] = []
+        for item in doc["actions"]:
+            action = signal_from_jsonable(item)
+            if not isinstance(action, Action):
+                raise RuntimeError(
+                    f"GET {path}?batch returned non-action {item!r}")
+            actions.append(action)
+        if not actions:
+            return []
+        del_body = json.dumps(
+            {"uuids": [a.uuid for a in actions]}).encode()
+        t0 = time.perf_counter()
+        status, _ = self._recv_conn.request("DELETE", path, body=del_body)
+        obs.transport_rtt("ack", time.perf_counter() - t0)
+        if status != 200:
+            raise RuntimeError(f"DELETE {path} (batch) -> {status}")
+        return actions

@@ -64,6 +64,7 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     action_unroutable,
     carry,
     entity_stalled,
+    event_batch,
     event_intercepted,
     experiment_stats,
     latency,
@@ -82,6 +83,7 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     search_stall,
     sidecar_request,
     span,
+    transport_rtt,
 )
 
 

@@ -63,6 +63,17 @@ SEARCH_STALL = "nmz_search_stall"
 SIDECAR_REQUESTS = "nmz_sidecar_requests_total"
 ENTITY_LABEL_OVERFLOW = "nmz_entity_label_overflow_total"
 
+# event-plane fast path (doc/performance.md): how full the batches
+# actually run, and what each client-side HTTP round trip costs
+EVENT_BATCH = "nmz_event_batch_size"
+TRANSPORT_RTT = "nmz_transport_rtt_seconds"
+
+#: power-of-two batch-occupancy buckets — the interesting question is
+#: "are batches amortizing anything" (1 vs 2-8 vs full), not sub-unit
+#: latency resolution
+BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                 512.0, 1024.0)
+
 # resilience plane (doc/robustness.md): unroutable-action drops and
 # liveness-watchdog stall declarations, by entity
 ACTIONS_UNROUTABLE = "nmz_actions_unroutable_total"
@@ -244,6 +255,33 @@ def entity_stalled(entity: str) -> None:
         "released)",
         ("entity",),
     ).labels(entity=_entity_label(reg, entity)).inc()
+
+
+def event_batch(stage: str, size: int) -> None:
+    """One batch moved through an event-plane stage (``ingress`` = REST
+    batch POST -> hub, ``dispatch`` = orchestrator action fan-out,
+    ``actions_poll`` = batch GET response, ``flush`` = transceiver
+    client-side coalescing flush)."""
+    if not metrics.enabled():
+        return
+    metrics.get().histogram(
+        EVENT_BATCH,
+        "events per batch through the event-plane fast path",
+        ("stage",),
+        buckets=BATCH_BUCKETS,
+    ).labels(stage=stage).observe(size)
+
+
+def transport_rtt(op: str, seconds: float) -> None:
+    """Client-side wall time of one transceiver HTTP round trip
+    (``post`` / ``post_batch`` / ``poll`` / ``ack``)."""
+    if not metrics.enabled():
+        return
+    metrics.get().histogram(
+        TRANSPORT_RTT,
+        "transceiver-side HTTP round-trip time",
+        ("op",),
+    ).labels(op=op).observe(seconds)
 
 
 def rest_request(method: str, code: int) -> None:

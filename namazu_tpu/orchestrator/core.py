@@ -168,25 +168,65 @@ class Orchestrator:
 
     # -- loops -----------------------------------------------------------
 
+    #: greedy-drain cap for the event and action loops: bounds how much
+    #: one batch can delay the loop's shutdown sentinel check, and the
+    #: largest batch a policy's vectorized decision sees at once
+    BATCH_MAX = 256
+
     def _event_loop(self) -> None:
         while True:
             ev = self.hub.event_queue.get()
             if ev is _STOP:
                 return
+            # greedy drain: everything already inbound rides ONE policy
+            # call (the batch POST route enqueues whole batches, so
+            # under load this recovers them; when idle the batch is 1
+            # and behavior is exactly the sequential path)
+            batch = [ev]
+            stop = False
+            while len(batch) < self.BATCH_MAX:
+                try:
+                    nxt = self.hub.event_queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    stop = True
+                    break
+                batch.append(nxt)
             target = self.policy if self.enabled else self.dumb
-            obs.mark(ev, "enqueued")
-            obs.record_enqueued(ev, target.name)
+            for ev in batch:
+                obs.mark(ev, "enqueued")
+                obs.record_enqueued(ev, target.name)
             try:
-                target.queue_event(ev)
+                if len(batch) == 1:
+                    target.queue_event(batch[0])
+                    rejected = ()
+                else:
+                    # queue_events isolates per-event failures itself
+                    # and reports them (policy/base.py contract);
+                    # reaching this except means a batch-level failure
+                    # (e.g. queue closed at shutdown)
+                    rejected = target.queue_events(batch) or ()
             except Exception:
-                log.exception("policy %s rejected event %r", target.name, ev)
+                log.exception("policy %s rejected a batch of %d events "
+                              "(first: %r)", target.name, len(batch),
+                              batch[0])
             else:
-                # queue_event returning means the policy chose this
-                # event's delay/priority — the decision point
-                obs.mark(ev, "decided")
-                obs.record_decided(ev, target.name)
-                obs.policy_decision(target.name, ev.entity_id,
-                                    obs.latency(ev, "intercepted"))
+                # queue_event(s) returning means the policy chose the
+                # batch's delays/priorities — the decision point.
+                # Rejected events get no marks, exactly like a scalar
+                # rejection: batched and per-event telemetry stay
+                # identical
+                rejected_ids = {id(ev) for ev in rejected}
+                for ev in batch:
+                    if id(ev) in rejected_ids:
+                        continue
+                    obs.mark(ev, "decided")
+                    obs.record_decided(ev, target.name)
+                    obs.policy_decision(target.name, ev.entity_id,
+                                        obs.latency(ev, "intercepted"))
+            if stop:
+                return
 
     def _forward_loop_factory(self, policy: ExplorePolicy):
         def loop() -> None:
@@ -202,28 +242,55 @@ class Orchestrator:
     def _action_loop(self) -> None:
         done = 0
         while True:
-            item = self._merged_actions.get()
-            if item is _FWD_DONE:
-                done += 1
-                if done == self._n_policies:
-                    return
-                continue
-            action: Action = item  # type: ignore[assignment]
-            action.mark_triggered()
-            obs.mark(action, "dispatched")
-            kind = ("orchestrator" if action.orchestrator_side_only
-                    else "forwarded")
-            obs.record_dispatched(action, kind)
-            obs.action_dispatched(kind, obs.latency(action, "intercepted"))
-            if self.collect_trace:
-                self.trace.append(action)
-            if action.orchestrator_side_only:
+            raw = [self._merged_actions.get()]
+            while len(raw) < self.BATCH_MAX:
                 try:
-                    action.execute_on_orchestrator()
-                except Exception:
-                    log.exception("orchestrator-side action failed: %r", action)
-            else:
-                self.hub.send_action(action)
+                    raw.append(self._merged_actions.get_nowait())
+                except queue.Empty:
+                    break
+            # an item is one action, a released burst (list — the
+            # action_out contract, policy/base.py), or a sentinel
+            batch: list = []
+            for item in raw:
+                if isinstance(item, list):
+                    batch.extend(item)
+                else:
+                    batch.append(item)
+            # forwardable actions accumulate and fan through the hub in
+            # one send_actions call (one route-lock + one queue-lock per
+            # endpoint/entity); orchestrator-side actions act as flush
+            # barriers so in-process execution keeps its place in the
+            # release order
+            forward: list = []
+            for item in batch:
+                if item is _FWD_DONE:
+                    done += 1
+                    continue
+                action: Action = item  # type: ignore[assignment]
+                action.mark_triggered()
+                obs.mark(action, "dispatched")
+                kind = ("orchestrator" if action.orchestrator_side_only
+                        else "forwarded")
+                obs.record_dispatched(action, kind)
+                obs.action_dispatched(kind,
+                                      obs.latency(action, "intercepted"))
+                if self.collect_trace:
+                    self.trace.append(action)
+                if action.orchestrator_side_only:
+                    if forward:
+                        self.hub.send_actions(forward)
+                        forward = []
+                    try:
+                        action.execute_on_orchestrator()
+                    except Exception:
+                        log.exception(
+                            "orchestrator-side action failed: %r", action)
+                else:
+                    forward.append(action)
+            if forward:
+                self.hub.send_actions(forward)
+            if done >= self._n_policies:
+                return
 
     def _watchdog_loop(self) -> None:
         """Liveness sweep: declare entities silent past the timeout dead

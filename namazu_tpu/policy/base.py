@@ -9,7 +9,10 @@ from typing import Callable, Dict, Iterable, Optional, TYPE_CHECKING
 from namazu_tpu import obs
 from namazu_tpu.signal.action import Action
 from namazu_tpu.signal.event import Event
+from namazu_tpu.utils.log import get_logger
 from namazu_tpu.utils.sched_queue import QueueClosed, ScheduledQueue
+
+log = get_logger("policy")
 
 if TYPE_CHECKING:  # pragma: no cover
     from namazu_tpu.storage.base import HistoryStorage
@@ -40,7 +43,11 @@ class ExplorePolicy:
 
     * ``queue_event`` MUST return quickly — never block on I/O or sleep.
     * actions appear on ``action_out`` (a thread-safe queue) in the order
-      the policy decides to release them; that order IS the fuzz.
+      the policy decides to release them; that order IS the fuzz. An
+      ``action_out`` item is one :class:`Action` OR a list of them (a
+      burst released together — the consumer flattens in order); the
+      batch form exists so a burst costs one queue hand-off, not one
+      thread wakeup per action.
     * ``load_config`` may be called again at runtime for dynamic reload.
     """
 
@@ -64,6 +71,30 @@ class ExplorePolicy:
 
     def queue_event(self, event: Event) -> None:
         raise NotImplementedError
+
+    def queue_events(self, events: Iterable[Event]) -> "list[Event]":
+        """Batch entry point: decide a whole batch in one call; returns
+        the events the policy REJECTED (empty when all queued — the
+        orchestrator skips lifecycle marks for rejected events, keeping
+        batched and per-event telemetry identical). The default just
+        loops; policies with a vectorizable decision (the TPU policy's
+        bucket -> table lookup) override the batch hook so the
+        orchestrator's event loop can hand them a drained batch without
+        a per-event Python round trip.
+
+        Failures are isolated per event, matching the per-event path's
+        semantics: one poison event must not take down the rest of the
+        drained batch."""
+        rejected = []
+        for event in events:
+            try:
+                self.queue_event(event)
+            except Exception:
+                log.exception(
+                    "policy %s rejected event %r (rest of the batch "
+                    "continues)", self.name, event)
+                rejected.append(event)
+        return rejected
 
     def force_release_entity(self, entity_id: str) -> int:
         """Release any events parked for ``entity_id`` immediately;
@@ -110,16 +141,45 @@ class QueueBackedPolicy(ExplorePolicy):
             self._started = True
             self._dequeue_thread = self._spawn(self._dequeue_loop, "dequeue")
 
+    def queue_events(self, events: Iterable[Event]) -> "list[Event]":
+        """Shared batch preamble (one home for the list/size/start
+        boilerplate): single events ride the isolated scalar loop,
+        larger batches go through :meth:`_queue_events_batch`."""
+        events = list(events)
+        if len(events) <= 1:
+            return super().queue_events(events)
+        self.start()
+        return self._queue_events_batch(events)
+
+    def _queue_events_batch(self, events: "list[Event]") -> "list[Event]":
+        """Batch hook for >= 2 events (``start()`` already called):
+        queue the whole batch, ideally under one queue-lock
+        acquisition; returns the rejected events. Default: the
+        isolated scalar loop."""
+        return super().queue_events(events)
+
+    #: how many simultaneously-ripe releases one dequeue pass may drain
+    #: (and the largest burst list emitted on action_out)
+    DEQUEUE_BATCH_MAX = 256
+
     def _dequeue_loop(self) -> None:
         while True:
             try:
-                event = self._queue.get()
+                events = self._queue.get_batch(self.DEQUEUE_BATCH_MAX)
             except QueueClosed:
                 return
-            obs.record_released(event, self.name)
-            obs.queue_dwell(self.name, event.entity_id,
-                            obs.latency(event, "enqueued"))
-            self._emit(self._action_for(event))
+            actions = []
+            for event in events:
+                obs.record_released(event, self.name)
+                obs.queue_dwell(self.name, event.entity_id,
+                                obs.latency(event, "enqueued"))
+                actions.append(self._action_for(event))
+            if len(actions) == 1:
+                self._emit(actions[0])
+            else:
+                # one queue hand-off for the whole burst (list form of
+                # the action_out contract)
+                self.action_out.put(actions)
 
     def _action_for(self, event: Event) -> Action:
         return event.default_action()

@@ -30,5 +30,13 @@ class DumbPolicy(QueueBackedPolicy):
         self.start()
         self._queue.put(event, self.interval, self.interval)
 
+    def _queue_events_batch(self, events):
+        """Batch intake: one queue-lock acquisition for a drained batch
+        (this policy serves the orchestration-disabled hot path, so the
+        batch fan-through matters here as much as in the real fuzzers)."""
+        self._queue.put_many(
+            (event, self.interval, self.interval) for event in events)
+        return []
+
 
 register_policy(DumbPolicy.NAME, DumbPolicy)

@@ -28,6 +28,9 @@ from namazu_tpu.policy.proc_subpolicies import create_proc_subpolicy
 from namazu_tpu.signal.action import ProcSetSchedAction, ShellAction
 from namazu_tpu.signal.event import Event, ProcSetEvent
 from namazu_tpu.utils.config import parse_duration
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("policy.random")
 
 
 class RandomPolicy(QueueBackedPolicy):
@@ -82,6 +85,35 @@ class RandomPolicy(QueueBackedPolicy):
             lo *= self.PRIORITIZED_SPEEDUP
             hi *= self.PRIORITIZED_SPEEDUP
         self._queue.put(event, lo, hi)
+
+    def _queue_events_batch(self, events):
+        """Batch intake: ProcSet events keep the immediate-answer path
+        (isolated per event); the rest enter the delay queue under ONE
+        lock via put_many, whose delay sampling draws from the same RNG
+        in the same order as sequential puts — a seeded run stays
+        reproducible whether the orchestrator handed events over singly
+        or in batches."""
+        rejected = []
+        delayed = []
+        for event in events:
+            if isinstance(event, ProcSetEvent):
+                try:
+                    attrs = self._proc_policy.attrs_for(event.pids)
+                    self._emit(
+                        ProcSetSchedAction.for_procset(event, attrs))
+                except Exception:
+                    log.exception("procset event %r rejected (batch "
+                                  "continues)", event)
+                    rejected.append(event)
+                continue
+            lo, hi = self.min_interval, self.max_interval
+            if event.entity_id in self.prioritized_entities:
+                lo *= self.PRIORITIZED_SPEEDUP
+                hi *= self.PRIORITIZED_SPEEDUP
+            delayed.append((event, lo, hi))
+        if delayed:
+            self._queue.put_many(delayed)
+        return rejected
 
     # -- workers ---------------------------------------------------------
 

@@ -31,8 +31,11 @@ def make_packet_events(n: int, entities: int) -> List[PacketEvent]:
 
 def drain_actions(policy: ExplorePolicy, n: int, timeout: float = 30.0) -> List[Action]:
     out: List[Action] = []
-    for _ in range(n):
-        out.append(policy.action_out.get(timeout=timeout))
+    while len(out) < n:
+        item = policy.action_out.get(timeout=timeout)
+        # action_out items are one Action or a released burst (list) —
+        # policy/base.py ExplorePolicy contract
+        out.extend(item if isinstance(item, list) else [item])
     return out
 
 
@@ -51,8 +54,12 @@ def pump_concurrent(policy: ExplorePolicy, n: int, entities: int = 3) -> List[Ac
     collected: "queue.Queue[Action]" = queue.Queue()
 
     def collector() -> None:
-        for _ in range(n):
-            collected.put(policy.action_out.get(timeout=30.0))
+        got = 0
+        while got < n:
+            item = policy.action_out.get(timeout=30.0)
+            for action in (item if isinstance(item, list) else [item]):
+                collected.put(action)
+                got += 1
 
     t = threading.Thread(target=collector, daemon=True)
     t.start()

@@ -82,12 +82,62 @@ class ScheduledQueue:
         """Enqueue with an exact delay (used by deterministic replay)."""
         self.put(item, delay, delay)
 
+    def put_many(self, entries) -> None:
+        """Enqueue a batch of ``(item, min_delay, max_delay)`` triples
+        under ONE condition-lock acquisition and ONE wakeup — the
+        event-plane batch path's per-event cost is a heap push, not a
+        lock round trip. Delay sampling matches :meth:`put` exactly
+        (same RNG, same draw order), so a batch of equal-bound items
+        keeps FIFO order by sequence number like sequential puts
+        would."""
+        entries = list(entries)
+        if not entries:
+            return
+        sampled = []
+        for item, min_delay, max_delay in entries:
+            if max_delay < min_delay:
+                raise ValueError(
+                    f"max_delay {max_delay} < min_delay {min_delay}")
+            if min_delay == max_delay:
+                sampled.append((item, min_delay))
+            else:
+                sampled.append((item, self._rng.uniform(min_delay,
+                                                        max_delay)))
+        now = time.monotonic()
+        with self._cond:
+            if self._closed:
+                raise QueueClosed
+            for item, delay in sampled:
+                heapq.heappush(
+                    self._heap,
+                    (now + delay * self._time_scale, next(self._seq),
+                     now, item))
+            self._cond.notify()
+            if self._obs_name:
+                obs.sched_queue_depth(self._obs_name, len(self._heap))
+
+    def put_at_many(self, pairs) -> None:
+        """Batch :meth:`put_at`: ``(item, exact_delay)`` pairs, one lock
+        acquisition (the deterministic-replay side of put_many)."""
+        self.put_many((item, delay, delay) for item, delay in pairs)
+
     def get(self, timeout: Optional[float] = None) -> Any:
         """Block until the earliest item's release time passes; return it.
 
         Raises :class:`QueueClosed` when the queue is closed and empty, and
         :class:`TimeoutError` on timeout.
         """
+        return self.get_batch(1, timeout)[0]
+
+    def get_batch(self, max_n: int,
+                  timeout: Optional[float] = None) -> list:
+        """Block like :meth:`get` for the first ripe item, then return
+        every ALREADY-ripe item up to ``max_n``, in release order — the
+        consumer's side of the batch fast path: a burst of zero/equal-
+        delay releases crosses the queue in one lock acquisition instead
+        of one wakeup per item. Never waits for more items once one is
+        ripe, so batching cannot delay a release."""
+        max_n = max(1, max_n)
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
             while True:
@@ -95,14 +145,20 @@ class ScheduledQueue:
                 if self._heap:
                     release = self._heap[0][0]
                     if release <= now:
-                        _, _, put_ts, item = heapq.heappop(self._heap)
+                        items = []
+                        while (self._heap and len(items) < max_n
+                               and self._heap[0][0] <= now):
+                            _, _, put_ts, item = heapq.heappop(self._heap)
+                            if self._obs_name:
+                                # metric locks are leaves; safe under
+                                # _cond
+                                obs.sched_queue_wait(self._obs_name,
+                                                     now - put_ts)
+                            items.append(item)
                         if self._obs_name:
-                            # metric locks are leaves; safe under _cond
                             obs.sched_queue_depth(self._obs_name,
                                                   len(self._heap))
-                            obs.sched_queue_wait(self._obs_name,
-                                                 now - put_ts)
-                        return item
+                        return items
                     wait = release - now
                 elif self._closed:
                     raise QueueClosed

@@ -437,26 +437,17 @@ def test_rest_post_retries_transients(monkeypatch):
     from namazu_tpu.inspector.rest_transceiver import RestTransceiver
 
     tx = RestTransceiver("e1", "http://127.0.0.1:1", backoff_step=0.01,
-                         backoff_max=0.02, post_attempts=4)
+                         backoff_max=0.02, post_attempts=4,
+                         use_batch=False)
     calls = []
 
-    def flaky(req, timeout=0):
-        calls.append(req.full_url)
+    def flaky(method, path, body=None):
+        calls.append(path)
         if len(calls) < 3:
-            raise urllib.error.URLError("connection refused")
+            raise ConnectionRefusedError("connection refused")
+        return 200, b"{}"
 
-        class Resp:
-            status = 200
-
-            def __enter__(self):
-                return self
-
-            def __exit__(self, *a):
-                return False
-
-        return Resp()
-
-    monkeypatch.setattr("urllib.request.urlopen", flaky)
+    monkeypatch.setattr(tx._post_conn, "request", flaky)
     tx._post(PacketEvent.create("e1", "e1", "peer"))  # no raise
     assert len(calls) == 3
 
@@ -465,17 +456,41 @@ def test_rest_post_exhausts_and_raises(monkeypatch):
     from namazu_tpu.inspector.rest_transceiver import RestTransceiver
 
     tx = RestTransceiver("e1", "http://127.0.0.1:1", backoff_step=0.01,
-                         backoff_max=0.02, post_attempts=3)
+                         backoff_max=0.02, post_attempts=3,
+                         use_batch=False)
     calls = []
 
-    def down(req, timeout=0):
+    def down(method, path, body=None):
         calls.append(1)
-        raise urllib.error.URLError("still down")
+        raise ConnectionRefusedError("still down")
 
-    monkeypatch.setattr("urllib.request.urlopen", down)
-    with pytest.raises(urllib.error.URLError):
+    monkeypatch.setattr(tx._post_conn, "request", down)
+    with pytest.raises(OSError):
         tx._post(PacketEvent.create("e1", "e1", "peer"))
     assert len(calls) == 3
+
+
+def test_rest_batch_flush_retries_and_dedupes_serverside(monkeypatch):
+    """The batch POST path carries the same bounded-retry policy: a
+    flush whose 200 was lost replays the whole batch (the endpoint's
+    dedupe ring absorbs the duplicates server-side)."""
+    from namazu_tpu.inspector.rest_transceiver import RestTransceiver
+
+    tx = RestTransceiver("e1", "http://127.0.0.1:1", backoff_step=0.01,
+                         backoff_max=0.02, post_attempts=4,
+                         use_batch=True, flush_window=0.0)
+    calls = []
+
+    def flaky(method, path, body=None):
+        calls.append((method, path))
+        if len(calls) < 3:
+            raise ConnectionResetError("peer vanished mid-response")
+        return 200, b'{"accepted": 1, "duplicates": 0}'
+
+    monkeypatch.setattr(tx._post_conn, "request", flaky)
+    tx._post(PacketEvent.create("e1", "e1", "peer"))  # no raise
+    assert len(calls) == 3
+    assert all(path.endswith("/events/e1/batch") for _, path in calls)
 
 
 def test_rest_shutdown_joins_receive_thread(monkeypatch):
