@@ -53,6 +53,9 @@ def register(sub) -> None:
                    metavar="K",
                    help="stop after K consecutive non-experiment run "
                         "slots (default 3; 0 = never)")
+    p.add_argument("--knowledge", default="", metavar="HOST:PORT",
+                   help="global failure-knowledge service address, "
+                        "forwarded to every run child (doc/knowledge.md)")
     p.add_argument("--no-resume", action="store_true",
                    help="ignore an existing campaign.json and start a "
                         "fresh campaign")
@@ -73,6 +76,8 @@ def run(args) -> int:
         backoff_base_s=args.backoff_base,
         backoff_cap_s=args.backoff_cap,
         max_consecutive_infra=args.max_consecutive_infra,
+        extra_run_args=(["--knowledge", args.knowledge]
+                        if args.knowledge else []),
     )
     campaign = Campaign(spec)
     try:

@@ -39,6 +39,13 @@ def register(sub) -> None:
             help=f"deadline for the {phase} script (seconds; its whole "
                  f"process group is killed on expiry); default: the "
                  f"config's {phase}_deadline_s, 0 = none")
+    p.add_argument(
+        "--knowledge", default="", metavar="HOST:PORT",
+        help="global failure-knowledge service address (a sidecar "
+             "started with --pool-dir, doc/knowledge.md): cold runs "
+             "warm-start from the fleet's pooled failures, failures "
+             "stream back; an outage degrades to local-only search. "
+             "Overrides the config's explore_policy_param.knowledge")
     p.set_defaults(func=run)
 
 
@@ -66,6 +73,11 @@ def run(args) -> int:
               "afterwards)", file=sys.stderr)
         return 1
     cfg = Config.from_file(cfg_path)
+    if args.knowledge:
+        # CLI wins over the config snapshot (same precedence as the
+        # deadline flags): `campaign --knowledge` forwards this to every
+        # child without editing the storage's config
+        cfg.set("explore_policy_param.knowledge", args.knowledge)
 
     storage = load_storage(storage_dir)
     working_dir = storage.create_new_working_dir()
@@ -89,6 +101,9 @@ def run(args) -> int:
     from namazu_tpu import obs
 
     obs.set_analytics_storage(os.path.abspath(storage_dir))
+    if args.knowledge:
+        # fold the fleet's pool/tenant stats into GET /analytics
+        obs.set_knowledge_address(args.knowledge)
 
     run_deadline = _deadline(args.run_deadline, cfg, "run_deadline_s")
     validate_deadline = _deadline(args.validate_deadline, cfg,

@@ -18,6 +18,15 @@ def register(sub) -> None:
     p.add_argument("--platform", default="",
                    help="jax platform override (e.g. cpu); empty = "
                         "process default")
+    p.add_argument("--pool-dir", default="",
+                   help="global failure-pool directory: enables the "
+                        "multi-tenant knowledge service (pool_push/"
+                        "pool_pull/surrogate_predict/stats ops, "
+                        "doc/knowledge.md); empty = search ops only")
+    p.add_argument("--state-dir", default="",
+                   help="knowledge-service state directory (scenario "
+                        "tables, surrogate examples); default: the "
+                        "pool dir")
     p.set_defaults(func=run_sidecar)
 
 
@@ -40,4 +49,5 @@ def run_sidecar(args) -> int:
     from namazu_tpu.sidecar import serve_sidecar
 
     host, _, port = args.listen.rpartition(":")
-    return serve_sidecar(host or "127.0.0.1", int(port))
+    return serve_sidecar(host or "127.0.0.1", int(port),
+                         pool_dir=args.pool_dir, state_dir=args.state_dir)

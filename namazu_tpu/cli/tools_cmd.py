@@ -195,12 +195,25 @@ def register(sub) -> None:
                     help="write to this file instead of stdout")
     pr.set_defaults(func=report)
 
+    pk = tsub.add_parser(
+        "knowledge",
+        help="global failure-knowledge service stats (doc/knowledge.md): "
+             "pool occupancy, tenants, scenario tables, shared-surrogate "
+             "training rounds",
+    )
+    pk.add_argument("addr", help="service address host:port (a sidecar "
+                                 "started with --pool-dir)")
+    pk.set_defaults(func=knowledge_stats)
+
     pf = tsub.add_parser(
         "fsck",
         help="storage integrity check (doc/robustness.md): list "
              "quarantined (INCOMPLETE) runs, crash-incomplete runs not "
              "yet marked, and orphan atomic-write temp files; --repair "
-             "quarantines the incomplete runs and sweeps the temps",
+             "quarantines the incomplete runs and sweeps the temps. "
+             "Pointed at a shared failure-pool dir (doc/knowledge.md) "
+             "it checks pool entries instead: stray temps and torn "
+             "(unreadable) .npz entries",
     )
     pf.add_argument("storage")
     pf.add_argument("--repair", action="store_true",
@@ -375,13 +388,60 @@ def report(args) -> int:
     return 0
 
 
+def _looks_like_pool_dir(path: str) -> bool:
+    """A shared failure-pool dir is flat ``<digest>.npz`` files with no
+    storage skeleton — no ``config.json``/``storage.json`` (every
+    initialized storage has those). A FRESH pool counts too: empty, or
+    holding only the knowledge service's ``_state`` subdir — fsck on a
+    just-started service must report 0 entries, not crash on
+    load_storage."""
+    if not os.path.isdir(path) \
+            or os.path.exists(os.path.join(path, "config.json")) \
+            or os.path.exists(os.path.join(path, "storage.json")):
+        return False
+    names = os.listdir(path)
+    if any(n.endswith((".npz", ".tmp")) for n in names):
+        return True
+    return not names or names == ["_state"]
+
+
+def _fsck_pool(args) -> int:
+    from namazu_tpu.models.failure_pool import pool_fsck
+
+    report = pool_fsck(args.storage, repair=args.repair)
+    findings = (len(report["tmp_artifacts"])
+                + len(report["unreadable_entries"]))
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+        return 1 if findings else 0
+    print(f"{report['pool_dir']}: {report['entries']} pool entr(ies) "
+          "readable")
+    for name in report["tmp_artifacts"]:
+        print(f"  stray temp: {name}")
+    for name in report["unreadable_entries"]:
+        print(f"  unreadable entry: {name}")
+    if args.repair and report["repaired"]:
+        print(f"repaired: {len(report['repaired'])} item(s) swept/"
+              "quarantined")
+    elif findings:
+        print("rerun with --repair to sweep stray temps and quarantine "
+              "torn entries")
+    return 1 if findings else 0
+
+
 def fsck(args) -> int:
     """Integrity report over a storage's run dirs. Exit 1 only for
     UNHANDLED states — unmarked incomplete dirs, missing dirs, stray
     atomic-write temps (found-and-repaired still exits 1 so scripts
     notice the storage needed repair). Already-quarantined runs are
     reported but are a handled state (a supervised abort marks its own
-    dir; doc/robustness.md), so they alone exit 0."""
+    dir; doc/robustness.md), so they alone exit 0.
+
+    A shared failure-pool dir (no storage skeleton) gets the pool
+    checks instead — the knowledge plane's pool is part of the same
+    durable state a campaign depends on (doc/knowledge.md)."""
+    if _looks_like_pool_dir(args.storage):
+        return _fsck_pool(args)
     st = load_storage(args.storage)
     try:
         if not hasattr(st, "fsck"):
@@ -415,6 +475,26 @@ def fsck(args) -> int:
         print("rerun with --repair to quarantine incomplete runs and "
               "sweep stray temps")
     return 1 if findings else 0
+
+
+def knowledge_stats(args) -> int:
+    """One ``stats`` round trip against a knowledge-hosting sidecar;
+    prints the JSON payload (the same document obs/analytics.py folds
+    into its payload when a knowledge address is registered)."""
+    from namazu_tpu.knowledge import KnowledgeClient
+
+    client = KnowledgeClient(args.addr, tenant="tools")
+    try:
+        stats = client.stats()
+    finally:
+        client.close()
+    if stats is None:
+        print(f"error: knowledge service {args.addr} unreachable or "
+              "not configured (start a sidecar with --pool-dir)",
+              file=sys.stderr)
+        return 1
+    print(json.dumps(stats, sort_keys=True, indent=2))
+    return 0
 
 
 def import_reference_trace(args) -> int:

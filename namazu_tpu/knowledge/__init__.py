@@ -1,0 +1,35 @@
+"""Global failure-knowledge plane (doc/knowledge.md).
+
+ROADMAP item 3: the reference Namazu explores every experiment from
+scratch — the exploration policy owns no cross-run state beyond what one
+orchestrator process holds — and the cross-batch repro-rate floor drops
+to 40% when a campaign's recording phase is unlucky (RESULTS.md). This
+package federates the pieces that already exist in isolation (persistent
+sidecar, content-keyed failure pools, reward surrogate) into one
+multi-tenant knowledge service:
+
+* :mod:`namazu_tpu.knowledge.service` — :class:`KnowledgeService`: the
+  sidecar-hosted hub. Campaigns stream failure signatures (encoded
+  traces keyed by the timing-invariant ``trace_digest``) in; the service
+  maintains a global content-keyed pool (atomic crash-safe writes,
+  dedupe is the filesystem itself), per-scenario best delay tables, and
+  a shared :class:`RewardSurrogate` trained across tenants.
+* :mod:`namazu_tpu.knowledge.client` — :class:`KnowledgeClient`: the
+  campaign-side keep-alive framed-JSON client with graceful degradation:
+  a knowledge outage must never fail a campaign, so every call site
+  treats ``None`` as "skip, search locally" and the client re-probes the
+  service after a cooldown (a restarted service recovers ingest without
+  duplicate pool entries — content keying makes re-pushes no-ops).
+
+Wire ops (versioned; served by ``nmz-tpu sidecar --pool-dir ...`` over
+the same length-prefixed JSON framing as every sidecar request):
+``pool_push``, ``pool_pull``, ``surrogate_predict``, ``stats``.
+"""
+
+from namazu_tpu.knowledge.client import (  # noqa: F401
+    KnowledgeClient,
+    shared_client,
+)
+from namazu_tpu.knowledge.service import KnowledgeService  # noqa: F401
+
+KNOWLEDGE_OPS = KnowledgeService.OPS

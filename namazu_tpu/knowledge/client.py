@@ -1,0 +1,249 @@
+"""Campaign-side knowledge client: keep-alive framing, outage immunity.
+
+The cardinal rule (doc/knowledge.md): a knowledge outage must never
+fail a campaign. Every public method returns ``None`` instead of
+raising when the service is unreachable, stale, or answers with an
+error; call sites treat ``None`` as "skip — search locally". The first
+failure logs one warning and opens a cooldown window (during which
+calls return ``None`` immediately, so a dead service costs a campaign
+nothing per run); after the cooldown the next call re-probes, so a
+restarted service is picked up automatically — and because the pool is
+content-keyed, the re-pushed backlog dedupes instead of duplicating.
+
+Transport: one persistent length-prefixed-JSON connection (the PR 5
+keep-alive pattern; the sidecar serves any number of frames per
+connection since the same PR), with one transparent reconnect on a
+stale socket.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from namazu_tpu import obs
+from namazu_tpu.endpoint.agent import read_frame, write_frame
+from namazu_tpu.models.failure_pool import (
+    MAX_LOAD,
+    PoolEntry,
+    entries_to_pool_entries,
+)
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("knowledge.client")
+
+
+def pairs_fingerprint(pairs) -> str:
+    """Content fingerprint of a search's precedence-pair sample.
+    Surrogate features are only comparable between searches that share
+    the pair sample, so this fingerprint scopes the service-side example
+    stores — campaigns of one scenario converge on the same pairs (same
+    occupied buckets, K, H, seed) and pool; anything else is walled
+    off."""
+    a = np.ascontiguousarray(np.asarray(pairs))
+    h = hashlib.sha256()
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+    return h.hexdigest()[:16]
+
+
+class KnowledgeClient:
+    #: seconds an outage silences the client before the next re-probe
+    COOLDOWN_S = 30.0
+
+    def __init__(self, addr: str, tenant: str = "", scenario: str = "",
+                 timeout: float = 15.0,
+                 cooldown_s: float = COOLDOWN_S) -> None:
+        host, _, port = addr.rpartition(":")
+        self._host = host or "127.0.0.1"
+        self._port = int(port)
+        self.addr = addr
+        self.tenant = tenant or "anon"
+        self.scenario = scenario
+        self.timeout = timeout
+        self.cooldown_s = cooldown_s
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self._down_until = 0.0
+        self._warned = False
+
+    # -- transport --------------------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection((self._host, self._port),
+                                     timeout=self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _close_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_sock()
+
+    def _roundtrip(self, req: dict) -> dict:
+        """One framed request/response on the persistent connection,
+        with one transparent reconnect on a stale socket (the service
+        may have restarted between runs). Caller holds the lock."""
+        for attempt in (0, 1):
+            if self._sock is None:
+                self._sock = self._connect()
+            try:
+                write_frame(self._sock, req)
+                resp = read_frame(self._sock)
+                if resp is None:
+                    raise ConnectionError("connection closed mid-request")
+                return resp
+            except (OSError, ValueError) as e:
+                self._close_sock()
+                if attempt:
+                    raise ConnectionError(str(e)) from e
+        raise AssertionError("unreachable")
+
+    def _request(self, req: dict) -> Optional[dict]:
+        """Send one knowledge op; ``None`` = degraded (outage or the
+        service refused the op). Never raises."""
+        req = dict(req, v=1, tenant=self.tenant,
+                   scenario=req.get("scenario", self.scenario))
+        with self._lock:
+            now = time.monotonic()
+            if now < self._down_until:
+                return None
+            try:
+                resp = self._roundtrip(req)
+            except Exception as e:
+                self._mark_outage(f"unreachable ({e})")
+                return None
+            if not resp.get("ok"):
+                # an op-level refusal (unknown op on an old sidecar, no
+                # --pool-dir configured) is as dead as a closed port:
+                # cool down rather than re-asking every run
+                self._mark_outage(resp.get("error", "request refused"))
+                return None
+            self._down_until = 0.0
+            self._warned = False
+            return resp
+
+    def _mark_outage(self, why: str) -> None:
+        self._down_until = time.monotonic() + self.cooldown_s
+        self._close_sock()
+        obs.knowledge_outage()
+        if not self._warned:
+            self._warned = True
+            log.warning(
+                "knowledge service %s %s; degrading to local-only "
+                "search (re-probing in %.0fs — an outage never fails a "
+                "campaign)", self.addr, why, self.cooldown_s)
+        else:
+            log.debug("knowledge service %s still down: %s",
+                      self.addr, why)
+
+    def available(self) -> bool:
+        """Best-effort liveness view (no wire traffic)."""
+        return time.monotonic() >= self._down_until
+
+    # -- ops --------------------------------------------------------------
+
+    def push(self, entries: Sequence[dict] = (),
+             best: Optional[dict] = None,
+             examples: Sequence[dict] = (),
+             pairs_fp: str = "") -> Optional[dict]:
+        """Stream failure signatures / a best table / labeled surrogate
+        examples to the service; returns its response or ``None`` when
+        degraded."""
+        if not entries and best is None and not examples:
+            return {"ok": True, "accepted": 0, "duplicates": 0}
+        req: Dict = {"op": "pool_push", "entries": list(entries)}
+        if best is not None:
+            req["best"] = best
+        if examples:
+            req["examples"] = list(examples)
+            req["pairs_fp"] = pairs_fp
+        resp = self._request(req)
+        obs.knowledge_push(resp is not None,
+                           accepted=(resp or {}).get("accepted", 0),
+                           duplicates=(resp or {}).get("duplicates", 0))
+        return resp
+
+    def pull(self, H: int, exclude: Sequence[str] = (),
+             max_entries: int = MAX_LOAD
+             ) -> Optional[Tuple[List[PoolEntry], Optional[dict]]]:
+        """Warm-start material: ``(pool entries, scenario table)`` —
+        ``None`` when degraded (distinct from ``([], None)``, a healthy
+        but empty service)."""
+        resp = self._request({"op": "pool_pull", "H": int(H),
+                              "exclude": list(exclude),
+                              "max_entries": int(max_entries)})
+        if resp is None:
+            obs.knowledge_pull(False)
+            return None
+        entries = entries_to_pool_entries(resp.get("entries") or [], H)
+        obs.knowledge_pull(True)
+        table = resp.get("scenario_table")
+        if table is not None:
+            try:
+                delays = np.asarray(table["delays"], np.float32)
+                if delays.shape != (int(H),):
+                    table = None
+                else:
+                    table = {"delays": delays,
+                             "fitness": float(table["fitness"])}
+            except (KeyError, TypeError, ValueError):
+                table = None
+        return entries, table
+
+    def scenario_table(self, H: int) -> Optional[dict]:
+        """Just the scenario's best delay table (a cheap pull with no
+        entries) — the cold-run hot-path warm-start."""
+        pulled = self.pull(H, max_entries=0)
+        return pulled[1] if pulled is not None else None
+
+    def predict(self, feats: np.ndarray,
+                pairs_fp: str = "") -> Optional[np.ndarray]:
+        """Shared-surrogate P(reproduce) per candidate feature vector;
+        ``None`` when degraded or the model is untrained for this
+        feature space — the caller keeps its fitness argmax."""
+        feats = np.asarray(feats, np.float32)
+        resp = self._request({
+            "op": "surrogate_predict", "pairs_fp": pairs_fp,
+            "feats": [[float(x) for x in row] for row in feats],
+        })
+        if resp is None or not resp.get("trained"):
+            return None
+        probs = np.asarray(resp.get("probs") or [], np.float32)
+        return probs if probs.shape == (feats.shape[0],) else None
+
+    def stats(self) -> Optional[dict]:
+        return self._request({"op": "stats"})
+
+
+# -- per-process shared clients ------------------------------------------
+
+_clients: Dict[Tuple[str, str, str], KnowledgeClient] = {}
+_clients_lock = threading.Lock()
+
+
+def shared_client(addr: str, tenant: str = "",
+                  scenario: str = "") -> KnowledgeClient:
+    """One client per (addr, tenant, scenario) per process, so the
+    policy, ingest, and the surrogate hook share a connection AND an
+    outage cooldown — a dead service is probed once, not once per
+    subsystem."""
+    key = (addr, tenant or "anon", scenario)
+    with _clients_lock:
+        client = _clients.get(key)
+        if client is None:
+            client = _clients[key] = KnowledgeClient(
+                addr, tenant=key[1], scenario=scenario)
+        return client

@@ -1,0 +1,462 @@
+"""The multi-tenant knowledge service: the sidecar's global memory.
+
+One instance serves every campaign on a host (or fleet, over DCN): a
+content-keyed failure pool on disk, per-scenario best delay tables for
+cold-run warm-starts, and a shared reward surrogate trained across
+tenants. All writes are crash-safe (``utils/atomic.py`` for JSON state,
+tmp+rename for pool entries), so a killed sidecar restarts into the
+same knowledge — and because the pool is content-keyed, tenants that
+re-push after the restart dedupe exactly-once instead of doubling
+entries.
+
+Feature-space discipline: surrogate features are precedence-pair
+embeddings whose pair sample depends on the tenant's occupied hint
+buckets, so examples are only poolable between searches that share a
+pair sample. The service therefore keys surrogate stores by
+``(scenario, pairs_fp, K)`` — the cross-campaign case the warm-start
+exists for (N campaigns of one scenario) shares all three, while an
+unrelated experiment can never pollute another's training set.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from namazu_tpu import obs
+from namazu_tpu.models.failure_pool import (
+    entry_from_jsonable,
+    entry_to_jsonable,
+    pool_load,
+    pool_put,
+    pool_size,
+)
+from namazu_tpu.utils.atomic import atomic_write_json
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("knowledge.service")
+
+#: labeled examples retained per surrogate store (digest-keyed LRU, so
+#: re-pushes of the same interleaving refresh instead of duplicate)
+MAX_EXAMPLES = 2048
+
+#: minimum labeled examples PER CLASS before the shared surrogate
+#: trains/serves — same rationale as ScheduleSearch.MIN_CLASS_EXAMPLES
+MIN_CLASS_EXAMPLES = 3
+
+
+def _surrogate_or_none(K: int):
+    """Build a RewardSurrogate, or None when the learning stack (jax/
+    flax/optax) is absent — the service then serves ``trained: false``
+    and tenants fall back to their local fitness argmax."""
+    try:
+        from namazu_tpu.models.surrogate import RewardSurrogate
+
+        return RewardSurrogate(K=K, seed=0)
+    except Exception:
+        log.warning("shared surrogate unavailable (learning stack not "
+                    "importable); serving predictions disabled",
+                    exc_info=True)
+        return None
+
+
+class _SurrogateStore:
+    """One scenario+feature-space's labeled examples + online model.
+
+    Example mutations happen under the service's global lock; the
+    expensive parts — model fit (jax compile + epochs) and the npz
+    persist — run OUTSIDE it on a snapshot, serialized per store by
+    ``train_lock``, so a training round never stalls other tenants'
+    pulls (or blows the pushing client's timeout into a phantom
+    outage)."""
+
+    def __init__(self, K: int):
+        self.K = K
+        # digest -> (feats f32[K], label); ordered for LRU eviction
+        self.examples: "OrderedDict[str, Tuple[np.ndarray, float]]" = \
+            OrderedDict()
+        self.model = None
+        self.model_failed = False  # learning stack absent: don't retry
+        self.train_rounds = 0
+        self.dirty = False  # examples added since the last train
+        self.train_lock = threading.Lock()
+
+    def add(self, digest: str, feats: np.ndarray, label: float) -> None:
+        if digest in self.examples:
+            del self.examples[digest]  # refresh LRU position + label
+        self.examples[digest] = (feats, label)
+        while len(self.examples) > MAX_EXAMPLES:
+            self.examples.popitem(last=False)
+        self.dirty = True
+
+    def dataset(self) -> Tuple[np.ndarray, np.ndarray]:
+        feats = np.stack([f for f, _ in self.examples.values()]) \
+            if self.examples else np.zeros((0, self.K), np.float32)
+        labels = np.asarray([l for _, l in self.examples.values()],
+                            np.float32)
+        return feats, labels
+
+    def trainable(self) -> bool:
+        labels = np.asarray([l for _, l in self.examples.values()])
+        pos = int((labels > 0.5).sum())
+        return min(pos, len(labels) - pos) >= MIN_CLASS_EXAMPLES
+
+    def train_on(self, feats: np.ndarray, labels: np.ndarray) -> bool:
+        """Fit one round on a snapshot — called OUTSIDE the global
+        lock; returns whether a round ran."""
+        with self.train_lock:
+            if self.model_failed:
+                return False
+            if self.model is None:
+                self.model = _surrogate_or_none(self.K)
+                if self.model is None:
+                    self.model_failed = True
+                    return False
+            self.model.train(feats, labels, epochs=2,
+                             seed=self.train_rounds)
+            self.train_rounds += 1
+        obs.knowledge_surrogate_round()
+        return True
+
+
+class KnowledgeService:
+    """Handler for the knowledge wire ops (hosted by the sidecar).
+
+    Thread-safe: the sidecar serves each connection from its own thread
+    and tenants push/pull concurrently; one lock serializes state
+    mutations (none of these ops are on an event hot path)."""
+
+    VERSION = 1
+    OPS = ("pool_push", "pool_pull", "surrogate_predict", "stats")
+
+    def __init__(self, pool_dir: str, state_dir: str = ""):
+        if not pool_dir:
+            raise ValueError("KnowledgeService needs a pool directory")
+        self.pool_dir = os.path.abspath(pool_dir)
+        # state lives in a subdir by default: scenario/surrogate .npz
+        # state must never be mistaken for pool entries by pool_size/
+        # pool_load/fsck, which treat every <pool>/*.npz as a signature
+        self.state_dir = os.path.abspath(
+            state_dir or os.path.join(self.pool_dir, "_state"))
+        os.makedirs(self.pool_dir, exist_ok=True)
+        os.makedirs(self.state_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        # tenant -> {"first_seen", "last_seen", "pushes", "pulls"}
+        self._tenants: Dict[str, Dict[str, Any]] = {}
+        # scenario fingerprint -> {"delays", "fitness", "H", "updated_at"}
+        self._scenarios: Dict[str, Dict[str, Any]] = {}
+        # (scenario, pairs_fp, K) -> _SurrogateStore
+        self._surrogates: Dict[Tuple[str, str, int], _SurrogateStore] = {}
+        self._pushes = 0
+        self._pulls = 0
+        self._dedupe_hits = 0
+        self._load_state()
+
+    # -- persistence (crash-safe; a restarted service resumes) -----------
+
+    def _scenario_path(self) -> str:
+        return os.path.join(self.state_dir, "scenarios.json")
+
+    def _store_path(self, key: Tuple[str, str, int]) -> str:
+        sid = hashlib.sha256(
+            f"{key[0]}|{key[1]}|{key[2]}".encode()).hexdigest()[:16]
+        return os.path.join(self.state_dir, f"surrogate_{sid}.npz")
+
+    def _load_state(self) -> None:
+        try:
+            import json
+
+            with open(self._scenario_path()) as f:
+                self._scenarios = json.load(f)
+        except FileNotFoundError:
+            pass
+        except Exception:
+            log.exception("scenario table state unreadable; starting "
+                          "with an empty table set")
+
+    def _save_scenarios(self) -> None:
+        try:
+            atomic_write_json(self._scenario_path(), self._scenarios,
+                              sort_keys=True)
+        except OSError:
+            log.exception("could not persist scenario tables")
+
+    def _save_store(self, key: Tuple[str, str, int], digests, feats,
+                    labels) -> None:
+        """Persist one example snapshot through utils/atomic.py (fsync +
+        rename + dir fsync — the same durability as every other
+        persistence site, per this module's crash-safety contract)."""
+        import io
+
+        from namazu_tpu.utils.atomic import atomic_write
+
+        buf = io.BytesIO()
+        np.savez(buf, feats=feats, labels=labels,
+                 digests=np.asarray(digests),
+                 scenario=np.asarray(key[0]),
+                 pairs_fp=np.asarray(key[1]))
+        try:
+            atomic_write(self._store_path(key), buf.getvalue())
+        except OSError:
+            log.exception("could not persist surrogate examples")
+
+    def _get_store(self, key: Tuple[str, str, int]) -> _SurrogateStore:
+        store = self._surrogates.get(key)
+        if store is not None:
+            return store
+        store = _SurrogateStore(K=key[2])
+        try:
+            with np.load(self._store_path(key)) as z:
+                feats, labels = z["feats"], z["labels"]
+                for d, f, l in zip(z["digests"], feats, labels):
+                    store.add(str(d), np.asarray(f, np.float32), float(l))
+            store.dirty = True  # retrain lazily from the recovered set
+        except FileNotFoundError:
+            pass
+        except Exception:
+            log.exception("surrogate example state unreadable; starting "
+                          "empty")
+        self._surrogates[key] = store
+        return store
+
+    # -- dispatch ---------------------------------------------------------
+
+    def handle(self, req: dict) -> dict:
+        op = str(req.get("op"))
+        handler = {
+            "pool_push": self._pool_push,
+            "pool_pull": self._pool_pull,
+            "surrogate_predict": self._surrogate_predict,
+            "stats": self._stats,
+        }.get(op)
+        if handler is None:
+            return {"ok": False, "v": self.VERSION,
+                    "error": f"unknown knowledge op {op!r}"}
+        with self._lock:
+            try:
+                resp = handler(req)
+            except Exception as e:
+                log.exception("knowledge op %s failed", op)
+                resp = {"ok": False, "error": repr(e)}
+        # deferred surrogate work (snapshots taken under the lock) runs
+        # HERE, outside it: a jax fit + npz persist must never stall
+        # other tenants' pulls behind the global lock (or blow this
+        # client's timeout into a phantom outage)
+        deferred = resp.pop("_deferred", ())
+        trained = False
+        for key, store, digests, feats, labels, want_train in deferred:
+            self._save_store(key, digests, feats, labels)
+            if want_train:
+                trained = store.train_on(feats, labels) or trained
+        if deferred and op == "pool_push":
+            resp["trained"] = trained  # settled now that the fit ran
+        resp.setdefault("v", self.VERSION)
+        obs.knowledge_service_stats(len(self._tenants),
+                                    pool_size(self.pool_dir))
+        return resp
+
+    def _touch_tenant(self, req: dict, what: str) -> str:
+        tenant = str(req.get("tenant") or "anon")
+        now = time.time()
+        t = self._tenants.setdefault(
+            tenant, {"first_seen": now, "pushes": 0, "pulls": 0})
+        t["last_seen"] = now
+        t[what] = t.get(what, 0) + 1
+        return tenant
+
+    # -- ops --------------------------------------------------------------
+
+    def _pool_push(self, req: dict) -> dict:
+        """Ingest failure signatures (content-keyed, exactly-once),
+        optionally a scenario's best delay table, and optionally labeled
+        surrogate examples. All three ride one op so a tenant's
+        end-of-run push is a single round trip."""
+        self._touch_tenant(req, "pushes")
+        self._pushes += 1
+        scenario = str(req.get("scenario") or "")
+        accepted = duplicates = rejected = 0
+        for d in req.get("entries") or []:
+            try:
+                realized, arrival, seed, entry_h = entry_from_jsonable(d)
+                _, added = pool_put(self.pool_dir, realized, arrival,
+                                    seed, entry_h)
+            except Exception:
+                rejected += 1
+                continue
+            if added:
+                accepted += 1
+            else:
+                duplicates += 1
+        self._dedupe_hits += duplicates
+        best = req.get("best")
+        if best and scenario:
+            self._install_best(scenario, best)
+        examples = req.get("examples") or []
+        pairs_fp = str(req.get("pairs_fp") or "")
+        deferred = []
+        if examples and scenario and pairs_fp:
+            deferred = self._add_examples(scenario, pairs_fp, examples)
+        return {"ok": True, "accepted": accepted,
+                "duplicates": duplicates, "rejected": rejected,
+                "trained": False,  # settled post-lock from _deferred
+                "_deferred": deferred,
+                "pool_size": pool_size(self.pool_dir)}
+
+    def _install_best(self, scenario: str, best: dict) -> None:
+        """Keep the highest-fitness delay table per scenario — the
+        warm-start a cold campaign installs before its own history
+        exists. Fitness comparisons only make sense within a scenario
+        (same oracle, same weights), which is exactly the key."""
+        try:
+            delays = [float(x) for x in best["delays"]]
+            fitness = float(best["fitness"])
+            h = int(best.get("H") or len(delays))
+        except (KeyError, TypeError, ValueError):
+            return
+        if not np.isfinite(fitness) or len(delays) != h:
+            return
+        cur = self._scenarios.get(scenario)
+        if cur is not None and cur.get("H") == h \
+                and cur.get("fitness", float("-inf")) >= fitness:
+            return
+        self._scenarios[scenario] = {
+            "delays": delays, "fitness": fitness, "H": h,
+            "updated_at": time.time(),
+        }
+        self._save_scenarios()
+
+    def _add_examples(self, scenario: str, pairs_fp: str,
+                      examples: list) -> list:
+        """Fold examples into their stores (under the global lock) and
+        return the deferred persist/train snapshots for ``handle`` to
+        run outside it."""
+        stores_touched = set()
+        for ex in examples:
+            try:
+                feats = np.asarray(ex["feats"], np.float32)
+                label = float(ex["label"])
+                digest = str(ex.get("digest") or "")
+            except (KeyError, TypeError, ValueError):
+                continue
+            if feats.ndim != 1 or not digest:
+                continue
+            key = (scenario, pairs_fp, int(feats.shape[0]))
+            self._get_store(key).add(digest, feats, label)
+            stores_touched.add(key)
+        deferred = []
+        for key in stores_touched:
+            store = self._surrogates[key]
+            deferred.append(self._snapshot_deferred(key, store))
+        return deferred
+
+    def _snapshot_deferred(self, key: Tuple[str, str, int],
+                           store: _SurrogateStore) -> Tuple:
+        """Immutable (persist + maybe-train) work item, snapped under
+        the global lock. ``dirty`` clears only when a train WILL run, so
+        below-threshold examples keep accumulating toward one."""
+        digests = list(store.examples.keys())
+        feats, labels = store.dataset()
+        want_train = (store.dirty and not store.model_failed
+                      and store.trainable())
+        if want_train:
+            store.dirty = False
+        return key, store, digests, feats, labels, want_train
+
+    def _pool_pull(self, req: dict) -> dict:
+        """Serve the warm-start: pooled signatures compatible with the
+        tenant's bucket count (minus what it already has) plus the
+        scenario's best delay table."""
+        self._touch_tenant(req, "pulls")
+        self._pulls += 1
+        from namazu_tpu.models.failure_pool import MAX_LOAD
+
+        h = int(req.get("H") or 0)
+        exclude = set(req.get("exclude") or [])
+        max_entries = int(req.get("max_entries", MAX_LOAD))
+        entries = []
+        if h > 0 and max_entries > 0:
+            for e in pool_load(self.pool_dir, h, exclude=exclude,
+                               max_entries=max_entries):
+                try:
+                    d = entry_to_jsonable(e.realized, e.arrival, e.seed, h)
+                except Exception:
+                    # one malformed on-disk entry (legacy format, manual
+                    # edit) must cost that entry, never the whole pull —
+                    # a failed pull reads as an outage to every tenant
+                    log.exception("pool entry %s unserializable; skipped",
+                                  e.digest)
+                    continue
+                d["digest"] = e.digest
+                entries.append(d)
+        table: Optional[dict] = None
+        scenario = str(req.get("scenario") or "")
+        cur = self._scenarios.get(scenario)
+        if cur is not None and (h <= 0 or cur.get("H") == h):
+            table = {"delays": cur["delays"], "fitness": cur["fitness"],
+                     "H": cur["H"]}
+        return {"ok": True, "entries": entries, "scenario_table": table,
+                "pool_size": pool_size(self.pool_dir)}
+
+    def _surrogate_predict(self, req: dict) -> dict:
+        """P(reproduce) for candidate schedule feature vectors, from the
+        shared model of this scenario's feature space. ``trained:
+        false`` (not an error) when the space is unknown or still too
+        thin — the tenant keeps its fitness argmax."""
+        scenario = str(req.get("scenario") or "")
+        pairs_fp = str(req.get("pairs_fp") or "")
+        feats = np.asarray(req.get("feats") or [], np.float32)
+        if feats.ndim != 2 or feats.shape[0] == 0:
+            return {"ok": False, "error": "feats must be [N, K]"}
+        key = (scenario, pairs_fp, int(feats.shape[1]))
+        store = self._surrogates.get(key)
+        if store is None and os.path.exists(self._store_path(key)):
+            store = self._get_store(key)  # restart recovery
+        if store is None:
+            return {"ok": True, "trained": False}
+        deferred = []
+        if store.dirty:
+            # a recovered (or thin-then-grown) example set retrains
+            # lazily — deferred outside the lock like every fit, so THIS
+            # reply says untrained (tenant keeps its argmax) and the
+            # next predict is served from the fresh model
+            deferred.append(self._snapshot_deferred(key, store))
+        if store.model is None:
+            return {"ok": True, "trained": False, "_deferred": deferred}
+        probs = store.model.predict(feats)
+        return {"ok": True, "trained": True,
+                "probs": [float(p) for p in probs],
+                "train_rounds": store.train_rounds,
+                "_deferred": deferred}
+
+    def _stats(self, req: dict) -> dict:
+        """Pool/tenant occupancy for dashboards and the PR 3 analytics
+        plane (obs/analytics.py folds this into its payload)."""
+        return {
+            "ok": True,
+            "pool_dir": self.pool_dir,
+            "pool_size": pool_size(self.pool_dir),
+            "tenant_count": len(self._tenants),
+            "tenants": {k: dict(v) for k, v in self._tenants.items()},
+            "scenario_count": len(self._scenarios),
+            "scenarios": {
+                fp: {"fitness": s["fitness"], "H": s["H"],
+                     "updated_at": s["updated_at"]}
+                for fp, s in self._scenarios.items()
+            },
+            "pushes": self._pushes,
+            "pulls": self._pulls,
+            "dedupe_hits": self._dedupe_hits,
+            "surrogate": {
+                "stores": len(self._surrogates),
+                "examples": sum(len(s.examples)
+                                for s in self._surrogates.values()),
+                "train_rounds": sum(s.train_rounds
+                                    for s in self._surrogates.values()),
+            },
+        }

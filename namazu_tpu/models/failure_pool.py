@@ -27,7 +27,7 @@ from __future__ import annotations
 import hashlib
 import os
 import tempfile
-from typing import List, NamedTuple, Optional, Sequence, Set
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -69,11 +69,21 @@ def pool_add(pool_dir: str, realized: EncodedTrace, arrival: EncodedTrace,
              seed: Optional[np.ndarray], H: int) -> str:
     """Persist one failure signature; returns its digest. Idempotent —
     an existing entry with the same digest is left untouched."""
+    return pool_put(pool_dir, realized, arrival, seed, H)[0]
+
+
+def pool_put(pool_dir: str, realized: EncodedTrace, arrival: EncodedTrace,
+             seed: Optional[np.ndarray], H: int) -> Tuple[str, bool]:
+    """:func:`pool_add` that also reports whether the entry was NEW
+    (False = content-keyed dedupe hit). The knowledge service counts
+    dedupe hits per push; concurrent writers racing on one signature
+    both land on the same filename via atomic rename, so the final pool
+    holds exactly one entry either way."""
     digest = trace_digest(realized)
     os.makedirs(pool_dir, exist_ok=True)
     path = os.path.join(pool_dir, f"{digest}.npz")
     if os.path.exists(path):
-        return digest
+        return digest, False
     payload = {
         "hint_space": np.asarray(HINT_SPACE),
         "H": np.asarray(H),
@@ -97,7 +107,7 @@ def pool_add(pool_dir: str, realized: EncodedTrace, arrival: EncodedTrace,
         except OSError:
             pass
         raise
-    return digest
+    return digest, True
 
 
 def pool_load(pool_dir: str, H: int,
@@ -175,3 +185,130 @@ def pool_size(pool_dir: str) -> int:
     if not os.path.isdir(pool_dir):
         return 0
     return sum(1 for n in os.listdir(pool_dir) if n.endswith(".npz"))
+
+
+# -- wire form (knowledge service, doc/knowledge.md) ---------------------
+
+def entry_to_jsonable(realized: EncodedTrace, arrival: EncodedTrace,
+                      seed: Optional[np.ndarray], H: int) -> Dict[str, Any]:
+    """One failure signature as a JSON-able dict — the ``pool_push``
+    wire form. Only the masked prefix travels (padding re-grows on the
+    receiving side and is digest-neutral anyway)."""
+    m = realized.mask
+    d: Dict[str, Any] = {
+        "hint_space": HINT_SPACE,
+        "H": int(H),
+        "hint_ids": realized.hint_ids[m].tolist(),
+        "entity_ids": realized.entity_ids[m].tolist(),
+        "released": realized.arrival[m].tolist(),
+        "arrival": arrival.arrival[m].tolist(),
+        "faultable": realized.faultable[m].tolist(),
+    }
+    if seed is not None:
+        d["seed"] = np.asarray(seed, np.float32).tolist()
+    return d
+
+
+def entry_from_jsonable(d: Dict[str, Any]) -> Tuple[EncodedTrace,
+                                                    EncodedTrace,
+                                                    Optional[np.ndarray],
+                                                    int]:
+    """Inverse of :func:`entry_to_jsonable`: ``(realized, arrival, seed,
+    H)``. Raises on malformed/mismatched payloads — the caller skips the
+    entry (wire peers are never trusted blindly, same contract as
+    :func:`pool_load`)."""
+    if d.get("hint_space") != HINT_SPACE:
+        raise ValueError(
+            f"entry from hint space {d.get('hint_space')!r} "
+            f"(this build: {HINT_SPACE!r})")
+    hint_ids = np.asarray(d["hint_ids"], np.int32)
+    n = len(hint_ids)
+    entity_ids = np.asarray(d["entity_ids"], np.int32)
+    released = np.asarray(d["released"], np.float32)
+    arrival_t = np.asarray(d["arrival"], np.float32)
+    faultable = np.asarray(d.get("faultable", np.ones(n)), bool)
+    if not (len(entity_ids) == len(released) == len(arrival_t)
+            == len(faultable) == n):
+        # every array, faultable included: a mismatched length would be
+        # persisted into the shared pool and poison every later pull
+        # (the re-serialization indexes faultable by the mask)
+        raise ValueError("entry arrays disagree on length")
+    mask = np.ones((n,), bool)
+    realized = EncodedTrace(hint_ids, entity_ids, released, mask,
+                            faultable=faultable)
+    arrival = EncodedTrace(hint_ids, entity_ids, arrival_t, mask,
+                           faultable=faultable)
+    seed = (np.asarray(d["seed"], np.float32)
+            if d.get("seed") is not None else None)
+    return realized, arrival, seed, int(d["H"])
+
+
+def entries_to_pool_entries(dicts: Sequence[Dict[str, Any]], H: int
+                            ) -> List[PoolEntry]:
+    """Decode pulled wire entries into :class:`PoolEntry` objects,
+    skipping (with one aggregate warning) anything malformed or from
+    another hint space / bucket count."""
+    out: List[PoolEntry] = []
+    skipped = 0
+    for d in dicts:
+        try:
+            realized, arrival, seed, entry_h = entry_from_jsonable(d)
+            if entry_h != H:
+                skipped += 1
+                continue
+            out.append(PoolEntry(digest=trace_digest(realized),
+                                 realized=realized, arrival=arrival,
+                                 seed=seed))
+        except Exception:
+            skipped += 1
+    if skipped:
+        log.warning("%d pulled knowledge entr(ies) were malformed or "
+                    "from another hint space/bucket count; skipped",
+                    skipped)
+    return out
+
+
+# -- integrity (nmz-tpu tools fsck over a pool dir) ----------------------
+
+def pool_fsck(pool_dir: str, repair: bool = False) -> Dict[str, Any]:
+    """Integrity report over a shared pool directory: stray atomic-write
+    temps (a hard-killed writer's leftovers; ``repair`` sweeps them) and
+    unreadable/torn ``.npz`` entries (``repair`` quarantines them with a
+    ``.bad`` suffix so loaders stop re-parsing them). Content-keyed
+    entries are self-deduplicating, so there is no cross-entry state to
+    reconcile."""
+    report: Dict[str, Any] = {
+        "pool_dir": os.path.abspath(pool_dir),
+        "entries": 0,
+        "tmp_artifacts": [],
+        "unreadable_entries": [],
+        "repaired": [],
+    }
+    if not os.path.isdir(pool_dir):
+        return report
+    for name in sorted(os.listdir(pool_dir)):
+        path = os.path.join(pool_dir, name)
+        if name.endswith(".tmp"):
+            report["tmp_artifacts"].append(name)
+            if repair:
+                try:
+                    os.unlink(path)
+                    report["repaired"].append(name)
+                except OSError:
+                    pass
+            continue
+        if not name.endswith(".npz"):
+            continue
+        try:
+            with np.load(path) as z:
+                _ = z["hint_ids"]  # force a header + member read
+            report["entries"] += 1
+        except Exception:
+            report["unreadable_entries"].append(name)
+            if repair:
+                try:
+                    os.replace(path, path + ".bad")
+                    report["repaired"].append(name)
+                except OSError:
+                    pass
+    return report

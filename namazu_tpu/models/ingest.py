@@ -13,11 +13,42 @@ from typing import List, NamedTuple, Optional
 
 import numpy as np
 
+from namazu_tpu import obs
 from namazu_tpu.ops import trace_encoding as te
 from namazu_tpu.signal.base import HINT_SPACE
 from namazu_tpu.utils.log import get_logger
 
 log = get_logger("models.ingest")
+
+#: most recent runs whose labeled features feed the shared surrogate
+#: per ingest — bounds the extra featurize cost (and the wire payload)
+#: on long histories; older runs were already pushed by earlier ingests
+MAX_EXAMPLE_PUSH = 64
+
+
+def _push_surrogate_examples(client, search, encoded) -> None:
+    """Stream (digest, features, reproduced?) for the most recent runs
+    to the knowledge service's shared surrogate. Runs AFTER
+    ``set_occupied_buckets``: features only pool between searches with
+    the same precedence-pair sample, and the pairs are final once the
+    occupied buckets are set — the fingerprint scopes the server-side
+    store (knowledge/service.py). Best-effort: surrogate sharing is an
+    accelerator, never a dependency."""
+    from namazu_tpu.knowledge.client import pairs_fingerprint
+    from namazu_tpu.models.failure_pool import trace_digest
+
+    try:
+        examples = []
+        for _enc, enc_rt, ok, _seed in encoded[-MAX_EXAMPLE_PUSH:]:
+            examples.append({
+                "digest": trace_digest(enc_rt),
+                "feats": [float(x) for x in search._feats_of(enc_rt)],
+                "label": 0.0 if ok else 1.0,
+            })
+        client.push(examples=examples,
+                    pairs_fp=pairs_fingerprint(search.pairs))
+    except Exception:
+        log.exception("could not push surrogate examples")
 
 
 class IngestParams(NamedTuple):
@@ -38,6 +69,16 @@ class IngestParams(NamedTuple):
     # 1-2 failures its own phase A happened to record
     # (models/failure_pool.py)
     failure_pool: str = ""
+    # knowledge-service address "host:port" ("" = off): the remote
+    # backend behind the same pool interface (doc/knowledge.md) —
+    # failures stream to the fleet-global pool and pooled signatures
+    # from OTHER campaigns/hosts fold back in, with graceful degradation
+    # to the local pool (or none) on outage. tenant/scenario identify
+    # the pushing campaign and the experiment fingerprint for
+    # warm-start keying and the shared surrogate's feature-space scoping
+    knowledge: str = ""
+    knowledge_tenant: str = ""
+    knowledge_scenario: str = ""
 
 
 def failure_seed(trace, H: int, max_interval: float):
@@ -134,23 +175,60 @@ def ingest_history(search, storage, p: IngestParams) -> List:
             skipped_unstamped, HINT_SPACE)
     # cross-batch failure pool: persist this storage's failures, then
     # pull in signatures recorded by OTHER runs/batches (dedup by
-    # content digest — re-ingesting our own failures is a no-op)
+    # content digest — re-ingesting our own failures is a no-op). With a
+    # knowledge service configured the same flow additionally rides the
+    # fleet-global pool: push own failures up, pull the fleet's down —
+    # and an outage silently degrades to the local-only path (the
+    # client logs one warning; a campaign never fails on knowledge)
     pooled = []
-    if p.failure_pool:
-        from namazu_tpu.models.failure_pool import pool_add, pool_load
+    client = None
+    if p.knowledge:
+        from namazu_tpu.knowledge import shared_client
+
+        client = shared_client(p.knowledge, tenant=p.knowledge_tenant,
+                               scenario=p.knowledge_scenario)
+    if p.failure_pool or client is not None:
+        from namazu_tpu.models.failure_pool import (
+            entry_to_jsonable,
+            pool_add,
+            pool_load,
+            trace_digest,
+        )
 
         own = set()
+        push_entries = []
         for enc, enc_rt, ok, seed in encoded:
-            if not ok:
-                try:
-                    own.add(pool_add(p.failure_pool, enc_rt, enc,
-                                     seed, p.H))
-                except Exception:
-                    log.exception("could not pool failure signature")
-        pooled = pool_load(p.failure_pool, p.H, exclude=own)
+            if ok:
+                continue
+            try:
+                own.add(trace_digest(enc_rt))
+                if p.failure_pool:
+                    pool_add(p.failure_pool, enc_rt, enc, seed, p.H)
+                if client is not None:
+                    push_entries.append(
+                        entry_to_jsonable(enc_rt, enc, seed, p.H))
+            except Exception:
+                log.exception("could not pool failure signature")
+        if p.failure_pool:
+            pooled = pool_load(p.failure_pool, p.H, exclude=own)
+        if client is not None:
+            client.push(entries=push_entries)  # None on outage: fine
+            have = own | {e.digest for e in pooled}
+            remote = client.pull(p.H, exclude=have)
+            if remote is not None:
+                r_entries, _table = remote
+                # the cold-run warm-start: fleet signatures this search
+                # has never seen are about to enter its archives
+                fresh = sum(
+                    1 for e in r_entries
+                    if not search.has_failure_signature(e.digest))
+                obs.knowledge_warmstart("archive", fresh)
+                pooled = pooled + r_entries
         if pooled:
             log.info("folding %d pooled failure signature(s) into the "
-                     "search (pool %s)", len(pooled), p.failure_pool)
+                     "search (pool %s%s)", len(pooled),
+                     p.failure_pool or "-",
+                     f", knowledge {p.knowledge}" if p.knowledge else "")
     # concentrate the feature pairs on the buckets the experiment
     # actually produces BEFORE embedding anything (a pair change clears
     # the archives; the loop below repopulates them in full)
@@ -190,6 +268,8 @@ def ingest_history(search, storage, p: IngestParams) -> List:
             failures.append(enc)
         else:
             successes.append(enc)
+    if client is not None and encoded:
+        _push_surrogate_examples(client, search, encoded)
     if p.reference_mode == "envelope" and successes:
         return [te.envelope_trace(successes)]
     pool = successes if successes else failures

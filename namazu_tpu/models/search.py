@@ -137,6 +137,13 @@ class SearchBase:
         self._failure_digests = [""] * cfg.failure_size
         self._failure_digest_set: set = set()
         self.generations_run = 0
+        # optional shared-surrogate hook (doc/knowledge.md): a callable
+        # ``feats [N, K] -> probs [N] | None`` serving predictions from
+        # the knowledge service's cross-tenant model. Consulted only
+        # when the LOCAL surrogate is still too thin to train (the
+        # exact cold-start window cross-campaign knowledge exists for);
+        # None / a None return degrades to the fitness argmax
+        self.remote_surrogate = None
         # fault half of the genome is scored only when faults can be
         # non-zero; coin=None keeps the pre-config-4 jit cache entry
         self._coin = (te.fault_coin(cfg.seed, cfg.H)
@@ -566,9 +573,16 @@ class ScheduleSearch(SearchBase):
     def _surrogate_pick(self, trace, pairs, archive, failures,
                         nov_scale=None) -> Optional[BestSchedule]:
         """Re-rank the evolved population's fitness top-k by predicted
-        repro probability; return the winner (None = surrogate inactive)."""
+        repro probability; return the winner (None = surrogate inactive).
+        The ranker is the local online MLP once it has enough of both
+        outcome classes; before that — the cold-start window — the
+        shared knowledge-service surrogate (``remote_surrogate``) ranks
+        instead, when one is wired and trained. Either path degrading
+        returns None and the caller falls back to the fitness argmax."""
         surrogate = self._train_surrogate()
-        if surrogate is None:
+        remote = self.remote_surrogate if surrogate is None else None
+        if surrogate is None and (remote is None
+                                  or self.cfg.surrogate_topk <= 0):
             return None
         import jax.numpy as jnp
 
@@ -589,8 +603,14 @@ class ScheduleSearch(SearchBase):
         top = np.asarray(jnp.argsort(-fitness)[:k])
         # features averaged over the reference traces, like the fitness
         cand_feats = np.asarray(feats[top].mean(axis=1))
-        order, probs = surrogate.rerank(cand_feats, top=1)
-        winner = int(top[order[0]])
+        if surrogate is not None:
+            order, _probs = surrogate.rerank(cand_feats, top=1)
+            winner = int(top[order[0]])
+        else:
+            probs = remote(cand_feats)
+            if probs is None:  # outage/untrained: keep the argmax
+                return None
+            winner = int(top[int(np.argmax(probs))])
         return BestSchedule(
             delays=np.asarray(delays[winner]),
             faults=faults[winner],

@@ -67,6 +67,12 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     event_batch,
     event_intercepted,
     experiment_stats,
+    knowledge_outage,
+    knowledge_pull,
+    knowledge_push,
+    knowledge_service_stats,
+    knowledge_surrogate_round,
+    knowledge_warmstart,
     latency,
     mark,
     policy_decision,
@@ -127,6 +133,13 @@ def set_analytics_storage(dir_path) -> None:
     route aggregates over (``nmz-tpu run`` calls this with its storage;
     None unregisters)."""
     analytics.set_storage_dir(dir_path)
+
+
+def set_knowledge_address(addr) -> None:
+    """Register the knowledge-service address whose pool/tenant stats
+    the live analytics payload folds in (``run --knowledge`` calls
+    this; None unregisters)."""
+    analytics.set_knowledge_address(addr)
 
 
 def analytics_payload(top: int = analytics.DEFAULT_TOP,

@@ -56,6 +56,7 @@ __all__ = [
     "coverage_stats", "reproduction_stats", "entity_stats",
     "convergence_stats", "suspicious_branches", "compute_payload",
     "payload", "set_storage_dir", "storage_dir",
+    "set_knowledge_address", "knowledge_address",
     "StallDetector", "note_search_round", "reset_stall_detector",
 ]
 
@@ -445,6 +446,47 @@ def storage_dir() -> Optional[str]:
     return _storage_dir
 
 
+_knowledge_addr: Optional[str] = None
+
+
+def set_knowledge_address(addr: Optional[str]) -> None:
+    """Register the knowledge-service address whose pool/tenant stats
+    the live payload folds in (``nmz-tpu run --knowledge`` registers
+    it; None unregisters). Purely additive: no address, no section."""
+    global _knowledge_addr
+    _knowledge_addr = addr or None
+
+
+def knowledge_address() -> Optional[str]:
+    return _knowledge_addr
+
+
+def _knowledge_section() -> Optional[Dict[str, Any]]:
+    """Pool/tenant stats from the registered knowledge service — the
+    fleet-level counterpart of the per-storage sections. Best-effort
+    like the storage join: an outage yields ``available: false``, never
+    a failed payload (a scrape must not 500 on a dead sidecar)."""
+    addr = _knowledge_addr
+    if not addr:
+        return None
+    from namazu_tpu.knowledge import shared_client
+
+    stats = shared_client(addr, tenant="analytics").stats()
+    if stats is None:
+        return {"address": addr, "available": False}
+    return {
+        "address": addr,
+        "available": True,
+        "pool_size": stats.get("pool_size", 0),
+        "tenant_count": stats.get("tenant_count", 0),
+        "scenario_count": stats.get("scenario_count", 0),
+        "pushes": stats.get("pushes", 0),
+        "pulls": stats.get("pulls", 0),
+        "dedupe_hits": stats.get("dedupe_hits", 0),
+        "surrogate": stats.get("surrogate", {}),
+    }
+
+
 def payload(top: int = DEFAULT_TOP,
             window: int = DEFAULT_WINDOW) -> Dict[str, Any]:
     """The live analytics document: the registered storage (when one is
@@ -465,12 +507,16 @@ def payload(top: int = DEFAULT_TOP,
     from namazu_tpu.obs import recorder as _recorder
 
     try:
-        return compute_payload(storage=st,
-                               recorder_runs=_recorder.recorder().runs(),
-                               top=top, window=window)
+        doc = compute_payload(storage=st,
+                              recorder_runs=_recorder.recorder().runs(),
+                              top=top, window=window)
     finally:
         if st is not None:
             st.close()
+    know = _knowledge_section()
+    if know is not None:
+        doc["knowledge"] = know
+    return doc
 
 
 # -- live stall detection --------------------------------------------------

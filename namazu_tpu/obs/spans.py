@@ -79,6 +79,18 @@ BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
 ACTIONS_UNROUTABLE = "nmz_actions_unroutable_total"
 ENTITY_STALLED = "nmz_entity_stalled_total"
 
+# global failure-knowledge plane (doc/knowledge.md): cross-campaign
+# pool traffic, warm-start installs, the shared surrogate's training
+# cadence, and the service's tenant/pool occupancy
+KNOWLEDGE_PUSHES = "nmz_knowledge_pushes_total"
+KNOWLEDGE_PULLS = "nmz_knowledge_pulls_total"
+KNOWLEDGE_DEDUPE = "nmz_knowledge_dedupe_hits_total"
+KNOWLEDGE_WARMSTART = "nmz_knowledge_warmstart_installs_total"
+KNOWLEDGE_SURROGATE_ROUNDS = "nmz_knowledge_surrogate_train_rounds_total"
+KNOWLEDGE_TENANTS = "nmz_knowledge_tenants"
+KNOWLEDGE_POOL = "nmz_knowledge_pool_entries"
+KNOWLEDGE_OUTAGES = "nmz_knowledge_outages_total"
+
 # experiment plane (cross-run aggregates, set by obs/analytics.py when a
 # payload is computed — GET /analytics, nmz-tpu tools report)
 EXPERIMENT_RUNS = "nmz_experiment_runs"
@@ -493,3 +505,81 @@ def sidecar_request(op: str, ok: bool) -> None:
     metrics.get().counter(
         SIDECAR_REQUESTS, "search sidecar requests", ("op", "ok"),
     ).labels(op=op, ok=str(bool(ok)).lower()).inc()
+
+
+# -- global failure-knowledge plane (doc/knowledge.md) -------------------
+
+def knowledge_push(ok: bool, accepted: int = 0, duplicates: int = 0) -> None:
+    """One pool_push round trip: entries the service newly stored vs
+    content-keyed dedupe hits (the same signature already pooled)."""
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.counter(
+        KNOWLEDGE_PUSHES, "knowledge-service pool_push requests", ("ok",),
+    ).labels(ok=str(bool(ok)).lower()).inc()
+    if duplicates > 0:
+        reg.counter(
+            KNOWLEDGE_DEDUPE,
+            "pushed signatures the pool already held (content-keyed "
+            "dedupe)",
+        ).inc(duplicates)
+
+
+def knowledge_pull(ok: bool) -> None:
+    # pulled-entry VOLUME is deliberately not counted here: the entries
+    # that matter (new to the pulling search) land in
+    # nmz_knowledge_warmstart_installs_total{kind="archive"}
+    if not metrics.enabled():
+        return
+    metrics.get().counter(
+        KNOWLEDGE_PULLS, "knowledge-service pool_pull requests", ("ok",),
+    ).labels(ok=str(bool(ok)).lower()).inc()
+
+
+def knowledge_warmstart(kind: str, n: int = 1) -> None:
+    """A cold run installed fleet knowledge: ``kind`` = what landed
+    (``archive`` = pooled signatures folded into the failure archive,
+    ``table`` = a scenario's best delay table installed on the hot
+    path)."""
+    if not metrics.enabled() or n <= 0:
+        return
+    metrics.get().counter(
+        KNOWLEDGE_WARMSTART,
+        "warm-start installs from the knowledge service", ("kind",),
+    ).labels(kind=kind).inc(n)
+
+
+def knowledge_surrogate_round() -> None:
+    if not metrics.enabled():
+        return
+    metrics.get().counter(
+        KNOWLEDGE_SURROGATE_ROUNDS,
+        "shared-surrogate training rounds on the knowledge service",
+    ).inc()
+
+
+def knowledge_service_stats(tenants: int, pool_entries: int) -> None:
+    """Service-side occupancy gauges (published on every handled op)."""
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.gauge(
+        KNOWLEDGE_TENANTS,
+        "distinct tenants the knowledge service has seen",
+    ).set(tenants)
+    reg.gauge(
+        KNOWLEDGE_POOL,
+        "failure signatures in the global knowledge pool",
+    ).set(pool_entries)
+
+
+def knowledge_outage() -> None:
+    """The knowledge service was unreachable/stale; the caller degraded
+    to local-only search (an outage must never fail a campaign)."""
+    if not metrics.enabled():
+        return
+    metrics.get().counter(
+        KNOWLEDGE_OUTAGES,
+        "knowledge-service outages degraded to local-only search",
+    ).inc()

@@ -120,6 +120,20 @@ class TPUSearchPolicy(QueueBackedPolicy):
         # the storage dir (sibling experiments share one pool; anchoring
         # inside the storage would make every batch an island again).
         self.failure_pool = ""
+        # knowledge-service address "host:port" ("" = off): the global
+        # failure-knowledge plane (doc/knowledge.md). A cold run pulls
+        # the fleet's warm-start (pooled signatures + the scenario's
+        # best delay table) before its own history exists; every ingest
+        # streams failures up; the shared surrogate ranks candidates
+        # during the local model's cold-start window. Outages degrade to
+        # local-only search — never to a failed run.
+        self.knowledge = ""
+        # scenario fingerprint override; "" = derived from the config's
+        # run/validate scripts + hint space + H + release mode, so N
+        # campaigns of one example land on one warm-start key without
+        # coordination
+        self.knowledge_scenario = ""
+        self.scenario = ""
         # novelty anneal (GA backend): explore at full w_novelty until
         # the failure archive holds this many DISTINCT signatures, then
         # scale novelty down as the archive grows (SearchConfig docs).
@@ -218,6 +232,9 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.surrogate_topk = int(p("surrogate_topk", self.surrogate_topk))
         self.failure_pool = os.path.expanduser(os.path.expandvars(
             str(p("failure_pool", self.failure_pool) or "")))
+        self.knowledge = str(p("knowledge", self.knowledge) or "")
+        self.knowledge_scenario = str(
+            p("knowledge_scenario", self.knowledge_scenario) or "")
         self.min_failure_signatures = int(
             p("min_failure_signatures", self.min_failure_signatures))
         self.novelty_floor = float(p("novelty_floor", self.novelty_floor))
@@ -262,6 +279,10 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.proc_policy_name = name
         self._proc_policy = create_proc_subpolicy(name, self._rng)
         self._proc_policy.load_params(p("proc_policy_param", {}) or {})
+        # last: the fingerprint folds in knobs parsed above (H,
+        # release_mode), so it must see their final values
+        self.scenario = (self.knowledge_scenario
+                         or self._scenario_fingerprint(config))
 
     # -- hot path ---------------------------------------------------------
 
@@ -683,6 +704,11 @@ class TPUSearchPolicy(QueueBackedPolicy):
             if ckpt and os.path.exists(ckpt) and self._delays is None:
                 # cheap install FIRST (np.load only), then the heavy build
                 installed = self._install_from_checkpoint(ckpt)
+            if not installed and self._delays is None and self.knowledge:
+                # truly cold run (no checkpoint product): the fleet's
+                # best table for this scenario beats the hash fallback —
+                # the whole point of the knowledge plane (doc/knowledge.md)
+                self._knowledge_warmstart_table()
             if installed and self.search_every > 1:
                 storage = self._storage
                 try:
@@ -728,6 +754,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
                             log.exception(
                                 "checkpoint %s not loadable; starting a "
                                 "fresh search", ckpt)
+                    self._wire_remote_surrogate(self._search)
                 search = self._search
             if search.generations_run > 0 and self._delays is None:
                 # install the checkpointed best NOW: the testee's decisive
@@ -762,6 +789,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
                      best.fitness, search.generations_run)
             if ckpt:
                 search.save(ckpt)
+            self._knowledge_push_best(best.delays, best.fitness)
         except Exception:
             log.exception("schedule search failed; hash-based delays remain")
 
@@ -835,6 +863,104 @@ class TPUSearchPolicy(QueueBackedPolicy):
         obs.record_install("sidecar")
         log.info("installed sidecar schedule (fitness %.4f, gen %d)",
                  resp["fitness"], resp["generations_run"])
+        self._knowledge_push_best(self._delays, float(resp["fitness"]))
+
+    # -- global failure-knowledge plane (doc/knowledge.md) ---------------
+
+    def _scenario_fingerprint(self, config) -> str:
+        """Warm-start key: campaigns of one experiment — same run/
+        validate scripts, hint space, bucket count, release mode — must
+        land on one knowledge-service scenario without coordination,
+        and experiments with different oracles must never share a delay
+        table (their fitness scales aren't comparable)."""
+        import hashlib
+        import json as _json
+
+        from namazu_tpu.signal.base import HINT_SPACE
+
+        basis = [str(config.get("run", "")),
+                 str(config.get("validate", "")),
+                 HINT_SPACE, int(self.H), self.release_mode]
+        return hashlib.sha256(
+            _json.dumps(basis).encode()).hexdigest()[:16]
+
+    def _knowledge_tenant(self) -> str:
+        d = getattr(self._storage, "dir", None)
+        return os.path.basename(os.path.abspath(d)) if d else "anon"
+
+    def _knowledge_client(self):
+        """The process-shared client for this policy's service/tenant/
+        scenario triple, or None when the knowledge plane is off."""
+        if not self.knowledge:
+            return None
+        from namazu_tpu.knowledge import shared_client
+
+        return shared_client(self.knowledge,
+                             tenant=self._knowledge_tenant(),
+                             scenario=self.scenario)
+
+    def _knowledge_warmstart_table(self) -> bool:
+        """Cold-run hot-path warm-start: install the scenario's best
+        fleet delay table when nothing better exists yet (no checkpoint,
+        no own search product). Returns whether a table was installed;
+        outages/empty services return False and hash fallback remains —
+        a knowledge outage must never fail (or even delay) a run."""
+        client = self._knowledge_client()
+        if client is None:
+            return False
+        try:
+            table = client.scenario_table(self.H)
+        except Exception:
+            log.exception("knowledge warm-start failed; keeping "
+                          "hash-based delays")
+            return False
+        if table is None:
+            return False
+        self._delays = table["delays"]
+        obs.schedule_install("knowledge")
+        obs.record_install("knowledge")
+        obs.knowledge_warmstart("table")
+        log.info("installed knowledge warm-start schedule (fitness "
+                 "%.4f, scenario %s)", table["fitness"], self.scenario)
+        return True
+
+    def _knowledge_push_best(self, delays, fitness: float) -> None:
+        """Publish this run's evolved best so the NEXT cold campaign of
+        this scenario warm-starts from it (service keeps the highest
+        fitness per scenario). Best-effort."""
+        client = self._knowledge_client()
+        if client is None:
+            return
+        import numpy as _np
+
+        if delays is None or not _np.isfinite(fitness):
+            return
+        try:
+            client.push(best={
+                "delays": [float(x) for x in _np.asarray(delays)],
+                "fitness": float(fitness), "H": self.H,
+            })
+        except Exception:
+            log.exception("could not push best schedule to the "
+                          "knowledge service")
+
+    def _wire_remote_surrogate(self, search) -> None:
+        """Give the search the shared-surrogate hook: candidate features
+        go to the knowledge service scoped by this search's own pair
+        fingerprint (features never cross feature spaces). Consulted
+        only while the local surrogate is too thin (models/search.py
+        _surrogate_pick)."""
+        client = self._knowledge_client()
+        if client is None:
+            return
+
+        from namazu_tpu.knowledge.client import pairs_fingerprint
+
+        def hook(feats, _client=client, _search=search):
+            return _client.predict(
+                feats, pairs_fp=pairs_fingerprint(_search.pairs))
+
+        search.remote_surrogate = hook
 
     def _failure_pool_path(self) -> str:
         """Pool dir; a relative path anchors to the storage dir's PARENT
@@ -860,6 +986,9 @@ class TPUSearchPolicy(QueueBackedPolicy):
             max_seed_genomes=self.MAX_SEED_GENOMES,
             order_mode_max_l=self.ORDER_MODE_MAX_L,
             failure_pool=self._failure_pool_path(),
+            knowledge=self.knowledge,
+            knowledge_tenant=self._knowledge_tenant(),
+            knowledge_scenario=self.scenario,
         )
     # order mode scores dense (a windowed permutation needs the whole
     # trace in one lexsort — ops/schedule.py), so uncapped encoding would

@@ -11,7 +11,10 @@ round trip.
 
 Wire: framed JSON over TCP (the same 4-byte little-endian length prefix
 as the guest-agent endpoint — endpoint/agent.py read_frame/write_frame),
-one request per connection:
+keep-alive: a connection may carry any number of request/response pairs
+(the PR 5 persistent-connection pattern; requests on one connection are
+served in order). Old one-shot clients — send one frame, read the
+reply, close — keep working: the server loop simply sees EOF.
 
 * ``{"op": "ping"}`` -> ``{"ok": true, "searches": N}``
 * ``{"op": "search", "key": str, "storage": dir,
@@ -19,6 +22,10 @@ one request per connection:
      "generations": N, "checkpoint": path}``
   -> ``{"ok": true, "fitness": f, "delays": [...], "faults": [...],
         "generations_run": N}``
+* knowledge-plane ops (``pool_push`` / ``pool_pull`` /
+  ``surrogate_predict`` / ``stats``; doc/knowledge.md) when the sidecar
+  was started with ``--pool-dir`` — without it they answer
+  ``{"ok": false, ...}`` and clients degrade to local-only search.
 
 The sidecar reads the storage directory itself (same host by design —
 this boundary rides loopback/DCN, never the per-event hot path), runs
@@ -197,6 +204,22 @@ class SearchService:
         except Exception as e:
             return {"ok": False, "error": f"storage: {e}"}
         ip = req.get("ingest_params") or {}
+        if ip.get("knowledge"):
+            # a sidecar-hosted search serves knowledge-wired tenants
+            # too: its ingest pushes/pulls the global pool (below, via
+            # IngestParams) and its candidate re-rank may consult the
+            # shared surrogate — possibly our own loopback, which is
+            # fine (each connection gets its own handler thread)
+            from namazu_tpu.knowledge import shared_client
+            from namazu_tpu.knowledge.client import pairs_fingerprint
+
+            kc = shared_client(
+                str(ip["knowledge"]),
+                tenant=str(ip.get("knowledge_tenant") or ""),
+                scenario=str(ip.get("knowledge_scenario") or ""))
+            search.remote_surrogate = (
+                lambda feats, _c=kc, _s=search:
+                    _c.predict(feats, pairs_fp=pairs_fingerprint(_s.pairs)))
         references = ingest_history(
             search, storage,
             IngestParams(**{k: v for k, v in ip.items()
@@ -221,11 +244,20 @@ class SearchService:
 
 
 class SidecarServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 10990):
+    def __init__(self, host: str = "127.0.0.1", port: int = 10990,
+                 knowledge=None):
         self.service = SearchService()
+        # optional multi-tenant knowledge service (knowledge/service.py):
+        # the sidecar is its host process, sharing the framed wire
+        self.knowledge = knowledge
         self._host, self._port = host, port
         self._srv: Optional[socket.socket] = None
         self._stop = threading.Event()
+        # live keep-alive connections: shutdown must sever them too, or
+        # "kill the service" would leave already-connected clients
+        # talking to a half-dead server instead of degrading cleanly
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
 
     @property
     def port(self) -> int:
@@ -249,6 +281,13 @@ class SidecarServer:
                 self._srv.close()
             except OSError:
                 pass
+        with self._conns_lock:
+            conns, self._conns = set(self._conns), set()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -259,21 +298,58 @@ class SidecarServer:
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True, name="sidecar-conn").start()
 
+    def _dispatch(self, req: dict) -> dict:
+        """Route one request: knowledge ops to the hosted knowledge
+        service (an explicit refusal when none is configured, so clients
+        can tell "no knowledge here" from a dead host and degrade),
+        everything else to the search service."""
+        op = req.get("op")
+        from namazu_tpu.knowledge import KNOWLEDGE_OPS
+
+        if op in KNOWLEDGE_OPS:
+            if self.knowledge is None:
+                resp = {"ok": False,
+                        "error": "knowledge service not configured "
+                                 "(start the sidecar with --pool-dir)"}
+            else:
+                resp = self.knowledge.handle(req)
+            obs.sidecar_request(str(op), bool(resp.get("ok")))
+            return resp
+        resp = self.service.handle(req)
+        if op == "ping" and self.knowledge is not None:
+            # advertise the knowledge plane (and its version) so a
+            # client can discover it from the same probe old clients
+            # already send; a knowledge-less sidecar answers the
+            # pre-knowledge shape unchanged
+            resp["knowledge"] = True
+            resp["knowledge_v"] = self.knowledge.VERSION
+        return resp
+
     def _serve_conn(self, conn: socket.socket) -> None:
-        # one request per connection: searches take seconds and the
-        # client blocks on the reply anyway, so connection reuse would
-        # only add framing state
+        # keep-alive: serve request/response pairs until the client
+        # closes (EOF -> read_frame None). Knowledge clients push and
+        # pull on every run of a campaign, so re-paying TCP setup (and
+        # slow-start) per request would tax exactly the cold-run path
+        # the warm-start exists to speed up; one-shot clients still work
+        # — their close is just the first EOF.
+        with self._conns_lock:
+            self._conns.add(conn)
         try:
-            req = read_frame(conn)
-            if req is None:
-                return
-            try:
-                resp = self.service.handle(req)
-            except Exception as e:
-                log.exception("sidecar request failed")
-                resp = {"ok": False, "error": repr(e)}
-            write_frame(conn, resp)
+            while not self._stop.is_set():
+                req = read_frame(conn)
+                if req is None:
+                    return
+                try:
+                    resp = self._dispatch(req)
+                except Exception as e:
+                    log.exception("sidecar request failed")
+                    resp = {"ok": False, "error": repr(e)}
+                write_frame(conn, resp)
+        except OSError:
+            pass  # peer vanished mid-write: nothing to answer
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
@@ -292,9 +368,19 @@ def request(addr: str, req: dict, timeout: float = 300.0) -> dict:
     return resp
 
 
-def serve_sidecar(host: str, port: int) -> int:
-    """CLI entry: serve until interrupted."""
-    server = SidecarServer(host, port)
+def serve_sidecar(host: str, port: int, pool_dir: str = "",
+                  state_dir: str = "") -> int:
+    """CLI entry: serve until interrupted. ``pool_dir`` enables the
+    multi-tenant knowledge service (doc/knowledge.md) on the same
+    wire."""
+    knowledge = None
+    if pool_dir:
+        from namazu_tpu.knowledge import KnowledgeService
+
+        knowledge = KnowledgeService(pool_dir, state_dir=state_dir)
+        log.info("knowledge service enabled: pool %s",
+                 knowledge.pool_dir)
+    server = SidecarServer(host, port, knowledge=knowledge)
     server.start()
     try:
         threading.Event().wait()

@@ -139,11 +139,24 @@ class Config:
             return False
 
     def set(self, path: str, value: Any) -> None:
+        # mirror get()'s camelCase fallback: a reference-style config
+        # holds e.g. "explorePolicyParam", and creating a snake_case
+        # sibling table would SHADOW it on every later lookup — one
+        # `run --knowledge` would silently reset every other policy
+        # param to defaults
         segs = path.split(".")
         cur = self._data
         for seg in segs[:-1]:
-            cur = cur.setdefault(seg, {})
-        cur[segs[-1]] = value
+            if seg not in cur and isinstance(cur.get(_camel(seg)), dict):
+                seg = _camel(seg)
+            nxt = cur.setdefault(seg, {})
+            if not isinstance(nxt, dict):
+                nxt = cur[seg] = {}
+            cur = nxt
+        leaf = segs[-1]
+        if leaf not in cur and _camel(leaf) in cur:
+            leaf = _camel(leaf)
+        cur[leaf] = value
 
     def policy_param(self, key: str, default: Any = None) -> Any:
         return self.get(f"explore_policy_param.{key}", default)

@@ -38,6 +38,14 @@ PHASE_A_RUNS = 10
 PHASE_B_MAX_RUNS = 8
 
 
+# slow: the comparison is stochastic THROUGH the real timing-sensitive
+# experiment loop — under tier-1's CPU contention (the whole suite plus
+# searches sharing 2 cores) the calibrated repro regimes shift and the
+# phase-B rate can land under phase A's even when the schedule is fine
+# (it failed clean-HEAD full-suite runs during PR 5 while passing in
+# isolation). The committed ABRESULT artifacts carry the real metric;
+# run this on a quiet machine: pytest tests/test_ab_north_star.py -m ''
+@pytest.mark.slow
 def test_tpu_search_repro_rate_at_least_random(tmp_path):
     cfg = tmp_path / "config.toml"
     cfg.write_text(RECORD_CONFIG)

@@ -115,6 +115,52 @@ def test_pool_load_rekeys_old_format_filenames(tmp_path):
     assert len(pool_load(pool, H)) == 1  # ...one signature loaded
 
 
+def test_concurrent_pool_add_writers_dedupe_exactly_once(tmp_path):
+    """Many writers (parallel campaign runs, sidecar requests, knowledge
+    pushes) racing the same signatures into one pool dir: every distinct
+    signature must land EXACTLY once — the atomic tmp+rename makes
+    same-digest racers converge on one file — and no torn/temp artifacts
+    may survive the race."""
+    import os
+    import threading
+
+    pool = str(tmp_path / "pool")
+    encs = [_enc(i) for i in range(6)]
+    n_writers = 8
+    barrier = threading.Barrier(n_writers)
+    errors = []
+
+    def writer():
+        try:
+            barrier.wait()
+            for e in encs:
+                pool_add(pool, e, e, None, H)
+        except Exception as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    threads = [threading.Thread(target=writer) for _ in range(n_writers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert pool_size(pool) == 6  # exactly-once per signature
+    entries = pool_load(pool, H)
+    assert {e.digest for e in entries} == {trace_digest(e) for e in encs}
+    assert not [n for n in os.listdir(pool) if n.endswith(".tmp")]
+
+
+def test_pool_put_reports_new_vs_duplicate(tmp_path):
+    from namazu_tpu.models.failure_pool import pool_put
+
+    pool = str(tmp_path / "pool")
+    enc = _enc(0)
+    d1, added1 = pool_put(pool, enc, enc, None, H)
+    d2, added2 = pool_put(pool, enc, enc, None, H)
+    assert d1 == d2
+    assert added1 and not added2  # the knowledge service's dedupe count
+
+
 def test_pool_skips_other_bucket_count(tmp_path):
     pool = str(tmp_path / "pool")
     enc = _enc(0)

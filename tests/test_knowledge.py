@@ -1,0 +1,411 @@
+"""Global failure-knowledge plane (doc/knowledge.md): the multi-tenant
+service hosted by the sidecar, the degradation-immune client, the
+warm-start of cold campaigns, exactly-once content-keyed ingest across
+restarts, and the shared surrogate's feature-space scoping.
+"""
+
+import os
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from namazu_tpu import obs
+from namazu_tpu.endpoint.agent import read_frame, write_frame
+from namazu_tpu.knowledge import KnowledgeClient, KnowledgeService
+from namazu_tpu.models.failure_pool import (
+    entry_to_jsonable,
+    pool_size,
+    trace_digest,
+)
+from namazu_tpu.models.ingest import IngestParams, ingest_history
+from namazu_tpu.obs import metrics, spans
+from namazu_tpu.ops import trace_encoding as te
+from namazu_tpu.sidecar import SidecarServer, request
+
+from tests.test_failure_pool import _FakeStorage, _enc, _search, _trace, H
+
+SCEN = "scenario-1"
+
+
+@pytest.fixture
+def fresh_registry():
+    reg = metrics.MetricsRegistry()
+    metrics.set_registry(reg)
+    yield reg
+    metrics.reset()
+
+
+@pytest.fixture
+def served(tmp_path):
+    """A knowledge-hosting sidecar + a cooldown-free client."""
+    svc = KnowledgeService(str(tmp_path / "pool"))
+    srv = SidecarServer(port=0, knowledge=svc)
+    srv.start()
+    client = KnowledgeClient(f"127.0.0.1:{srv.port}", tenant="t1",
+                             scenario=SCEN, cooldown_s=0.0)
+    yield srv, svc, client
+    client.close()
+    srv.shutdown()
+
+
+def _entry(seed: int) -> dict:
+    enc = _enc(seed)
+    return entry_to_jsonable(enc, enc, np.linspace(0, 0.1, H), H)
+
+
+# -- wire + service ------------------------------------------------------
+
+
+def test_push_pull_roundtrip_and_dedupe(served):
+    _, svc, client = served
+    r = client.push(entries=[_entry(0), _entry(1)])
+    assert r["accepted"] == 2 and r["duplicates"] == 0
+    # content-keyed: a re-push (another run, a retry, a restart) is a
+    # dedupe hit, never a second pool entry
+    r = client.push(entries=[_entry(0)])
+    assert r["accepted"] == 0 and r["duplicates"] == 1
+    entries, table = client.pull(H)
+    assert {e.digest for e in entries} == \
+        {trace_digest(_enc(0)), trace_digest(_enc(1))}
+    assert table is None  # no best pushed yet
+    # exclusion mirrors the local pool contract
+    entries, _ = client.pull(H, exclude=[trace_digest(_enc(0))])
+    assert {e.digest for e in entries} == {trace_digest(_enc(1))}
+
+
+def test_scenario_table_keeps_best_fitness(served):
+    _, _, client = served
+    client.push(best={"delays": [0.01] * H, "fitness": 1.0, "H": H})
+    client.push(best={"delays": [0.02] * H, "fitness": 3.0, "H": H})
+    client.push(best={"delays": [0.03] * H, "fitness": 2.0, "H": H})
+    table = client.scenario_table(H)
+    assert table["fitness"] == 3.0
+    np.testing.assert_allclose(table["delays"], 0.02)
+    # another scenario sees nothing (fitness scales don't compare
+    # across oracles)
+    other = KnowledgeClient(client.addr, tenant="t2", scenario="other",
+                            cooldown_s=0.0)
+    assert other.scenario_table(H) is None
+    # a mismatched bucket count refuses the table rather than serving a
+    # schedule that would index out of the tenant's genome
+    assert client.scenario_table(H * 2) is None
+
+
+def test_stats_and_tenant_tracking(served):
+    _, _, client = served
+    client.push(entries=[_entry(0)])
+    client.pull(H)
+    other = KnowledgeClient(client.addr, tenant="t2", scenario=SCEN,
+                            cooldown_s=0.0)
+    other.pull(H)
+    stats = client.stats()
+    assert stats["pool_size"] == 1
+    assert stats["tenant_count"] == 2
+    assert stats["tenants"]["t1"]["pushes"] == 1
+    assert stats["tenants"]["t2"]["pulls"] == 1
+    assert stats["pushes"] == 1 and stats["pulls"] >= 2
+
+
+def test_cross_hint_space_entries_rejected(served):
+    _, _, client = served
+    bad = _entry(0)
+    bad["hint_space"] = "someone-elses-format"
+    r = client.push(entries=[bad, _entry(1)])
+    assert r["rejected"] == 1 and r["accepted"] == 1
+
+
+def test_malformed_faultable_rejected_not_pooled(served):
+    """A length-mismatched array must be rejected at the wire, never
+    persisted — a poisoned pool entry would break every later pull for
+    every tenant."""
+    _, _, client = served
+    bad = _entry(0)
+    bad["faultable"] = bad["faultable"][:-1]
+    r = client.push(entries=[bad])
+    assert r["rejected"] == 1 and r["accepted"] == 0
+    entries, _ = client.pull(H)  # the pull still serves (and is empty)
+    assert entries == []
+
+
+def test_keep_alive_connection_serves_many_requests(served):
+    """One connection, many framed request/response pairs (the PR 5
+    persistent-connection pattern) — and an old one-shot client (the
+    module-level ``request``) still works against the same server."""
+    srv, _, _ = served
+    with socket.create_connection(("127.0.0.1", srv.port)) as s:
+        for op in ({"op": "ping"}, {"op": "stats"}, {"op": "ping"}):
+            write_frame(s, op)
+            resp = read_frame(s)
+            assert resp["ok"]
+    assert request(f"127.0.0.1:{srv.port}", {"op": "ping"})["ok"]
+
+
+def test_ping_advertises_knowledge_only_when_hosted(served, tmp_path):
+    srv, _, _ = served
+    assert request(f"127.0.0.1:{srv.port}",
+                   {"op": "ping"})["knowledge"] is True
+    plain = SidecarServer(port=0)
+    plain.start()
+    try:
+        resp = request(f"127.0.0.1:{plain.port}", {"op": "ping"})
+        assert "knowledge" not in resp  # pre-knowledge shape unchanged
+        # knowledge ops against a knowledge-less sidecar are refused
+        # explicitly (clients cool down instead of re-asking every run)
+        resp = request(f"127.0.0.1:{plain.port}",
+                       {"op": "pool_pull", "H": H})
+        assert not resp["ok"] and "pool-dir" in resp["error"]
+    finally:
+        plain.shutdown()
+
+
+# -- degradation + restart recovery --------------------------------------
+
+
+def test_outage_degrades_and_recovers(tmp_path):
+    """The acceptance contract: a dead service yields None (local-only
+    search), and a restarted one is picked up again — with the re-pushed
+    backlog deduping instead of duplicating (content-keyed pool)."""
+    pool = str(tmp_path / "pool")
+    svc = KnowledgeService(pool)
+    srv = SidecarServer(port=0, knowledge=svc)
+    srv.start()
+    port = srv.port
+    client = KnowledgeClient(f"127.0.0.1:{port}", tenant="t1",
+                             scenario=SCEN, cooldown_s=0.0)
+    assert client.push(entries=[_entry(0)])["accepted"] == 1
+
+    srv.shutdown()  # outage mid-campaign
+    assert client.pull(H) is None
+    assert client.push(entries=[_entry(1)]) is None
+
+    # restart on the same port + pool dir (a supervisor would)
+    svc2 = KnowledgeService(pool)
+    srv2 = SidecarServer(host="127.0.0.1", port=port, knowledge=svc2)
+    srv2.start()
+    try:
+        r = client.push(entries=[_entry(0), _entry(1)])
+        assert r is not None
+        # entry 0 survived the restart on disk: dedupe, not duplicate
+        assert r["duplicates"] == 1 and r["accepted"] == 1
+        assert pool_size(pool) == 2
+    finally:
+        client.close()
+        srv2.shutdown()
+
+
+def test_outage_cooldown_suppresses_probes():
+    client = KnowledgeClient("127.0.0.1:1", cooldown_s=300.0)
+    assert client.pull(H) is None
+    assert not client.available()  # cooling down: no wire traffic
+    assert client.pull(H) is None  # immediate, no reconnect attempt
+
+
+def test_scenario_tables_survive_restart(tmp_path):
+    pool = str(tmp_path / "pool")
+    svc = KnowledgeService(pool)
+    svc.handle({"op": "pool_push", "tenant": "t", "scenario": SCEN,
+                "best": {"delays": [0.01] * H, "fitness": 2.0, "H": H}})
+    svc2 = KnowledgeService(pool)  # crash-safe JSON state reloads
+    resp = svc2.handle({"op": "pool_pull", "tenant": "t",
+                        "scenario": SCEN, "H": H, "max_entries": 0})
+    assert resp["scenario_table"]["fitness"] == 2.0
+
+
+# -- ingest integration: the cross-campaign warm-start -------------------
+
+
+def test_cold_campaign_warm_starts_from_knowledge(served, fresh_registry):
+    """Campaign A records failures and streams them up; a COLD campaign
+    B (fresh storage, fresh search, no local pool) pulls a non-empty
+    warm-start: archives populated, references served, and
+    nmz_knowledge_warmstart_installs_total > 0 — the acceptance
+    criterion's smoke."""
+    srv, _, client = served
+    p = IngestParams(H=H, knowledge=client.addr,
+                     knowledge_tenant="campA", knowledge_scenario=SCEN)
+    sA = _search()
+    ingest_history(sA, _FakeStorage([(_trace(0), True),
+                                     (_trace(1, 0.05), False)]), p)
+    assert pool_size(served[1].pool_dir) == 1
+
+    pB = IngestParams(H=H, knowledge=client.addr,
+                      knowledge_tenant="campB", knowledge_scenario=SCEN)
+    sB = _search()
+    refs = ingest_history(sB, _FakeStorage([]), pB)
+    assert refs  # pooled arrival views serve as references
+    assert sB.distinct_failure_signatures() == 1
+    assert fresh_registry.value(spans.KNOWLEDGE_WARMSTART,
+                                kind="archive") == 1
+    # re-ingest: nothing new to warm-start, nothing duplicated
+    ingest_history(sB, _FakeStorage([]), pB)
+    assert sB.distinct_failure_signatures() == 1
+    assert sB._failure_n == 1
+
+
+def test_ingest_survives_knowledge_outage(fresh_registry):
+    """A dead knowledge address must not fail (or slow) ingest: the
+    local pool path still runs and the outage is counted."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        p = IngestParams(H=H, failure_pool=os.path.join(tmp, "pool"),
+                         knowledge="127.0.0.1:1",
+                         knowledge_tenant=f"outage-{os.getpid()}")
+        s = _search()
+        refs = ingest_history(
+            s, _FakeStorage([(_trace(0), True), (_trace(1, 0.05), False)]),
+            p)
+        assert refs
+        assert pool_size(os.path.join(tmp, "pool")) == 1  # local path ran
+        assert fresh_registry.value(spans.KNOWLEDGE_OUTAGES) >= 1
+
+
+# -- shared surrogate ----------------------------------------------------
+
+
+def test_shared_surrogate_trains_and_predicts(served):
+    _, _, client = served
+    rng = np.random.RandomState(0)
+    examples = []
+    for i in range(8):
+        label = float(i % 2)
+        feats = rng.rand(16).astype(np.float32) + label
+        examples.append({"digest": f"d{i}", "feats": feats.tolist(),
+                         "label": label})
+    r = client.push(examples=examples, pairs_fp="fp1")
+    assert r["trained"] is True
+    probs = client.predict(rng.rand(3, 16), pairs_fp="fp1")
+    assert probs is not None and probs.shape == (3,)
+    assert np.all((probs >= 0) & (probs <= 1))
+    # another feature space is walled off: untrained -> None -> the
+    # tenant keeps its fitness argmax
+    assert client.predict(rng.rand(3, 16), pairs_fp="fp2") is None
+
+
+def test_remote_surrogate_hook_ranks_candidates():
+    """models/search.py consults the remote hook only while the local
+    surrogate is too thin; a remote argmax pick must come back as a
+    valid BestSchedule, and a None (outage) must fall through to the
+    fitness argmax."""
+    s = _search(surrogate_topk=4)
+    calls = []
+
+    def remote(feats):
+        calls.append(feats.shape)
+        return np.linspace(0, 1, feats.shape[0])
+
+    s.remote_surrogate = remote
+    best = s.run([_enc(0)], generations=2)
+    assert np.isfinite(best.fitness)
+    assert calls and calls[0][0] <= 4  # ranked the fitness top-k
+
+    s2 = _search(surrogate_topk=4)
+    s2.remote_surrogate = lambda feats: None  # outage
+    best2 = s2.run([_enc(0)], generations=2)
+    assert np.isfinite(best2.fitness)  # argmax fallback, not a failure
+
+
+# -- policy warm-start of the hot-path table -----------------------------
+
+
+def test_policy_installs_scenario_table_on_cold_start(served, tmp_path):
+    from namazu_tpu.policy import create_policy
+    from namazu_tpu.storage import new_storage
+    from namazu_tpu.utils.config import Config
+
+    _, _, client = served
+    client.push(best={"delays": [0.04] * 32, "fitness": 1.0, "H": 32})
+
+    st = new_storage("naive", str(tmp_path / "st"))
+    st.create()
+    pol = create_policy("tpu_search")
+    pol.load_config(Config({
+        "explore_policy": "tpu_search",
+        "explore_policy_param": {
+            "seed": 5, "max_interval": 50, "hint_buckets": 32,
+            "feature_pairs": 32, "population": 64, "generations": 2,
+            "migrate_k": 2, "surrogate_topk": 0,
+            "knowledge": client.addr, "knowledge_scenario": SCEN,
+        },
+    }))
+    pol.set_history_storage(st)
+    pol.start()
+    pol.wait_for_search(timeout=120)
+    try:
+        # cold start (no checkpoint, no history): the fleet's table is
+        # on the hot path instead of the hash fallback
+        assert pol._delays is not None
+        np.testing.assert_allclose(pol._delays, 0.04)
+        assert pol._table_source() == "table"
+    finally:
+        pol.shutdown()
+
+
+def test_config_set_reuses_camelcase_table():
+    """`run --knowledge` sets explore_policy_param.knowledge; on a
+    reference-style camelCase config that must land INSIDE the existing
+    explorePolicyParam table — a snake_case sibling would shadow it and
+    silently reset every other policy param to defaults."""
+    from namazu_tpu.utils.config import Config
+
+    cfg = Config({"explorePolicyParam": {"seed": 7,
+                                         "checkpoint": "s.npz"}})
+    cfg.set("explore_policy_param.knowledge", "127.0.0.1:10993")
+    assert cfg.policy_param("knowledge") == "127.0.0.1:10993"
+    assert cfg.policy_param("seed") == 7  # not shadowed away
+    assert cfg.policy_param("checkpoint") == "s.npz"
+
+
+def test_policy_scenario_fingerprint_stability():
+    """Same experiment config -> same scenario key (campaigns pool
+    without coordination); different oracle -> different key."""
+    from namazu_tpu.policy import create_policy
+    from namazu_tpu.utils.config import Config
+
+    def load(run_script, validate):
+        pol = create_policy("tpu_search")
+        pol.load_config(Config({
+            "explore_policy": "tpu_search",
+            "run": run_script, "validate": validate,
+            "explore_policy_param": {"hint_buckets": 32},
+        }))
+        return pol.scenario
+
+    a = load("sh run.sh", "sh validate.sh")
+    b = load("sh run.sh", "sh validate.sh")
+    c = load("sh run.sh", "sh other_validate.sh")
+    assert a == b != c
+
+
+# -- fsck over the shared pool dir ---------------------------------------
+
+
+def test_tools_fsck_fresh_pool_dir(tmp_path):
+    """fsck on a just-started service's pool (empty but for _state/)
+    must report 0 entries and exit 0, not crash on load_storage."""
+    from namazu_tpu.cli import cli_main
+
+    pool = tmp_path / "pool"
+    (pool / "_state").mkdir(parents=True)
+    assert cli_main(["tools", "fsck", str(pool)]) == 0
+    (pool / "_state").rmdir()
+    assert cli_main(["tools", "fsck", str(pool)]) == 0  # fully empty too
+
+
+def test_tools_fsck_pool_dir(tmp_path):
+    from namazu_tpu.cli import cli_main
+    from namazu_tpu.models.failure_pool import pool_add
+
+    pool = tmp_path / "pool"
+    enc = _enc(0)
+    pool_add(str(pool), enc, enc, None, H)
+    assert cli_main(["tools", "fsck", str(pool)]) == 0
+    # a hard-killed writer's leftovers: stray temp + torn entry
+    (pool / "deadbeef.npz.123.tmp").write_bytes(b"partial")
+    (pool / ("f" * 32 + ".npz")).write_bytes(b"torn npz")
+    assert cli_main(["tools", "fsck", str(pool)]) == 1
+    assert cli_main(["tools", "fsck", str(pool), "--repair"]) == 1
+    assert cli_main(["tools", "fsck", str(pool)]) == 0  # clean now
+    assert pool_size(str(pool)) == 1  # the good entry survived

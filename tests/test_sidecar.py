@@ -122,6 +122,30 @@ def test_cached_search_reloads_newer_checkpoint(server, history, tmp_path):
     assert r2["generations_run"] == disk_gen + 4
 
 
+def test_keep_alive_search_requests_share_one_connection(server, history,
+                                                         tmp_path):
+    """The wire is keep-alive since the knowledge plane (one connection,
+    many framed request/response pairs); the search op — seconds of
+    work per request — must ride it just like the cheap ops, and the
+    old one-shot `request` client keeps working against the same
+    server (covered by every other test here)."""
+    import socket
+
+    from namazu_tpu.endpoint.agent import read_frame, write_frame
+
+    ckpt = str(tmp_path / "ka.npz")
+    with socket.create_connection(("127.0.0.1", server.port)) as s:
+        write_frame(s, {"op": "ping"})
+        assert read_frame(s)["ok"]
+        write_frame(s, search_req(history, ckpt))
+        r1 = read_frame(s)
+        assert r1["ok"] and np.isfinite(r1["fitness"])
+        write_frame(s, search_req(history, ckpt))
+        r2 = read_frame(s)
+        assert r2["ok"]
+        assert r2["generations_run"] > r1["generations_run"]
+
+
 def test_unknown_op_and_bad_storage(server):
     addr = f"127.0.0.1:{server.port}"
     assert not request(addr, {"op": "nope"})["ok"]
