@@ -46,7 +46,11 @@ from typing import Any, Dict, List, Optional
 
 from namazu_tpu.cli.run_cmd import EXIT_TIMEOUT
 from namazu_tpu.utils.atomic import atomic_write_json
-from namazu_tpu.utils.cmd import CmdFactory, kill_process_group
+from namazu_tpu.utils.cmd import (
+    CmdFactory,
+    kill_process_group,
+    sweep_stale_pgid_files,
+)
 from namazu_tpu.utils.log import get_logger
 from namazu_tpu.utils.retry import backoff_delays
 
@@ -235,6 +239,12 @@ class Campaign:
         finally:
             with self._child_lock:
                 self._child = None
+            # a hard-killed child (SIGKILL skips its cleanup) can leave
+            # its run script's process group orphaned in its own
+            # session, outside the group we just killed — the pgid
+            # breadcrumb run_cmd wrote points the sweep at it
+            # (doc/robustness.md "Chaos plane")
+            sweep_stale_pgid_files(spec.storage_dir)
         wall_s = time.monotonic() - t0
         rc = child.returncode
         if timed_out:

@@ -1,0 +1,100 @@
+"""Self-chaos plane: deterministic fault injection for our own stack.
+
+Namazu's reason to exist is amplifying rare failure interleavings in
+*other* systems; this package turns the same discipline on the serving
+plane we ship. Explicit seams in the transport
+(inspector/rest_transceiver.py), the REST endpoint (endpoint/rest.py),
+the storage layer (utils/atomic.py), the knowledge client
+(knowledge/client.py) and the orchestrator (orchestrator/core.py)
+consult a process-global :class:`~namazu_tpu.chaos.plan.FaultPlan`
+through :func:`decide`. With no plan installed — the production
+default — every seam is one module-global read and a ``None`` check,
+the same cost contract as ``obs_enabled`` (pinned by the bench gate in
+the acceptance criteria).
+
+Install a plan explicitly (:func:`install`) or through the environment
+(:func:`install_from_env`): ``NMZ_CHAOS`` holds a JSON document
+``{"seed": S, "faults": {point: rule, ...}}``, which is how the chaos
+harness and the campaign kill-tests reach seams inside child
+processes (``nmz-tpu run`` / ``inspectors`` install from env at
+startup).
+
+The fault-point catalog, rule grammar, and the invariant definitions
+live in doc/robustness.md ("Chaos plane"). Scenario presets are in
+:mod:`namazu_tpu.chaos.scenarios`; the invariant harness in
+:mod:`namazu_tpu.chaos.harness`; the crash-recovery event journal in
+:mod:`namazu_tpu.chaos.journal`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+from namazu_tpu.chaos.plan import FaultPlan
+
+__all__ = [
+    "FaultPlan", "ENV_VAR", "decide", "enabled", "plan",
+    "install", "clear", "install_from_env", "env_value",
+]
+
+#: the cross-process channel: a JSON {"seed": S, "faults": {...}}
+ENV_VAR = "NMZ_CHAOS"
+
+_plan: Optional[FaultPlan] = None
+
+
+def enabled() -> bool:
+    return _plan is not None
+
+
+def plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+def decide(point: str) -> Optional[Dict[str, Any]]:
+    """The one call every seam makes. Disabled (no plan installed) =
+    one global read + None check — nothing else on the hot path."""
+    p = _plan
+    if p is None:
+        return None
+    return p.decide(point)
+
+
+def install(new_plan: FaultPlan) -> FaultPlan:
+    """Install ``new_plan`` process-globally; returns it."""
+    global _plan
+    _plan = new_plan
+    return new_plan
+
+
+def clear() -> None:
+    global _plan
+    _plan = None
+
+
+def install_from_env(environ: Optional[Dict[str, str]] = None
+                     ) -> Optional[FaultPlan]:
+    """Install a plan from ``NMZ_CHAOS`` if set (and none is installed
+    yet — an explicitly installed plan wins); returns the active plan.
+    A malformed value raises: a chaos run with a silently-ignored spec
+    would report a meaningless green."""
+    env = os.environ if environ is None else environ
+    raw = env.get(ENV_VAR, "")
+    if not raw or _plan is not None:
+        return _plan
+    try:
+        doc = json.loads(raw)
+        seed = int(doc["seed"])
+        faults = doc.get("faults") or {}
+    except (ValueError, TypeError, KeyError) as e:
+        raise ValueError(f"bad {ENV_VAR} value: {e}") from e
+    return install(FaultPlan(seed, faults))
+
+
+def env_value(seed: int, faults: Dict[str, Dict[str, Any]]) -> str:
+    """The ``NMZ_CHAOS`` string for a (seed, faults) pair — what the
+    harness/tests put in a child's environment."""
+    return json.dumps({"seed": int(seed), "faults": faults},
+                      sort_keys=True)

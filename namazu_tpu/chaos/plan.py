@@ -1,0 +1,138 @@
+"""FaultPlan: a seeded, deterministic schedule of named fault points.
+
+The chaos plane's contract mirrors what the tool itself promises its
+users (PAPER.md): faults must be *reproducible*. A fault point is a
+named seam in the serving plane (``wire.post.drop``,
+``storage.rename``, ``knowledge.eof`` — the catalog lives in
+doc/robustness.md); every time the code reaches a seam it *consults*
+the plan, and the plan answers fire/don't-fire as a **pure function of
+(seed, point, consult index)**:
+
+    u = sha256(f"{seed}:{point}:{index}")[:8] / 2**64
+    fires  iff  index in rule["at"]
+           or  (u < rule["prob"] and index >= rule["after"])
+
+No wall clock, no shared RNG stream, no cross-point coupling — so the
+schedule for any point is bit-for-bit identical across runs, platforms
+and thread interleavings given the same seed. (What *varies* under
+thread races is only which real-world operation lands on consult index
+n; the decision sequence itself never does.) ``schedule()`` exposes the
+pure function for tests and the invariant harness.
+
+A rule is a plain dict::
+
+    {"prob": 0.25}                   # fire ~25% of consults
+    {"at": [0, 3]}                   # fire exactly on consults 0 and 3
+    {"prob": 0.5, "after": 10}       # let the run warm up first
+    {"prob": 1.0, "max_fires": 2}    # stateful cap (not part of the
+                                     # pure schedule; documented)
+
+plus arbitrary payload keys the seam interprets (``delay_s``,
+``status``, ``retry_after``, ...) which :meth:`FaultPlan.decide`
+returns to the caller when the point fires.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Dict, List, Optional
+
+#: rule keys that control firing; everything else is payload handed to
+#: the seam when the point fires
+_CONTROL_KEYS = ("prob", "at", "after", "max_fires")
+
+
+class FaultPlan:
+    def __init__(self, seed: int, faults: Dict[str, Dict[str, Any]]):
+        self.seed = int(seed)
+        self.faults: Dict[str, Dict[str, Any]] = {}
+        for point, rule in (faults or {}).items():
+            if not isinstance(rule, dict):
+                raise ValueError(
+                    f"fault rule for {point!r} must be a dict, got "
+                    f"{rule!r}")
+            self.faults[str(point)] = dict(rule)
+        self._lock = threading.Lock()
+        self._consults: Dict[str, int] = {}
+        self._fired: Dict[str, int] = {}
+
+    # -- the pure schedule ------------------------------------------------
+
+    @staticmethod
+    def _u(seed: int, point: str, index: int) -> float:
+        """Uniform [0, 1) draw for one (seed, point, index) triple —
+        the whole source of chaos randomness, deliberately hash-based so
+        per-point schedules are independent and replayable."""
+        digest = hashlib.sha256(
+            f"{seed}:{point}:{index}".encode()).digest()
+        return int.from_bytes(digest[:8], "big") / 2.0 ** 64
+
+    def would_fire(self, point: str, index: int) -> bool:
+        """The pure fire function (ignores the stateful ``max_fires``
+        cap, which depends on consult history)."""
+        rule = self.faults.get(point)
+        if rule is None:
+            return False
+        at = rule.get("at")
+        if at is not None and index in at:
+            return True
+        prob = float(rule.get("prob", 0.0))
+        if prob <= 0.0 or index < int(rule.get("after", 0)):
+            return False
+        return self._u(self.seed, point, index) < prob
+
+    def schedule(self, point: str, n: int) -> List[bool]:
+        """The first ``n`` fire decisions for ``point`` — what "same
+        seed reproduces the same fault schedule bit-for-bit" means,
+        and how tests assert it."""
+        return [self.would_fire(point, i) for i in range(n)]
+
+    # -- the consulted (stateful) side ------------------------------------
+
+    def decide(self, point: str) -> Optional[Dict[str, Any]]:
+        """Consult ``point`` once: None = don't fire, else the rule's
+        payload dict (plus ``point`` and the consult ``index``). Each
+        call advances the point's consult counter."""
+        rule = self.faults.get(point)
+        if rule is None:
+            return None
+        with self._lock:
+            index = self._consults.get(point, 0)
+            self._consults[point] = index + 1
+            max_fires = rule.get("max_fires")
+            if (max_fires is not None
+                    and self._fired.get(point, 0) >= int(max_fires)):
+                return None
+            if not self.would_fire(point, index):
+                return None
+            self._fired[point] = self._fired.get(point, 0) + 1
+        payload = {k: v for k, v in rule.items()
+                   if k not in _CONTROL_KEYS}
+        payload["point"] = point
+        payload["index"] = index
+        # metric + log only when a fault actually fires (rare): the
+        # consult path itself stays allocation-free. Lazy import — the
+        # chaos package must stay importable from leaf modules
+        # (utils/atomic.py) without dragging the obs plane in at
+        # import time.
+        from namazu_tpu.obs.spans import chaos_fault_injected
+
+        chaos_fault_injected(point)
+        return payload
+
+    def report(self) -> Dict[str, Any]:
+        """Consult/fire counts per point — the harness embeds this in
+        every scenario report so a violation names the faults that
+        actually landed."""
+        with self._lock:
+            return {
+                "seed": self.seed,
+                "points": sorted(self.faults),
+                "consults": dict(self._consults),
+                "fired": dict(self._fired),
+            }
+
+    def fired(self, point: str) -> int:
+        with self._lock:
+            return self._fired.get(point, 0)

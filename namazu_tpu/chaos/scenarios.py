@@ -1,0 +1,124 @@
+"""Scenario catalog for the chaos matrix (doc/robustness.md).
+
+Each scenario is one FaultPlan spec plus the harness *kind* that drives
+it. Kinds:
+
+* ``pipeline`` — the full loopback event plane: real RestTransceivers
+  posting deferred events through the REST endpoint into an
+  orchestrator + random policy, faults armed on the wire/endpoint
+  seams. Invariants: exactly-once dispatch, nothing parked forever,
+  fsck-clean storage, fault-free-replay trace equivalence.
+* ``storage`` — a crash-safe-storage workout: repeated run recording
+  under injected rename/fsync/torn-tmp failures; invariant: every run
+  is either complete or quarantined, and ``fsck --repair`` leaves the
+  storage clean and loadable.
+* ``knowledge`` — push/pull against a real knowledge-hosting sidecar
+  through mid-stream EOFs, a hard stop, and a restart; invariant: no
+  exception ever escapes into campaign code, the pooled state survives
+  the restart exactly-once, and the pool fscks clean.
+* ``crash`` — orchestrator ``kill -9`` mid-run (harness-choreographed
+  abandon + journal-recovering successor on the same port); invariant:
+  every parked event is recovered and dispatched exactly once, proven
+  by the flight-recorder uuid join across both incarnations.
+
+The specs keep each scenario to ONE fault family so the invariant
+arithmetic (e.g. ``lost == fired("wire.post.drop")``) stays exact.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+SCENARIOS: Dict[str, dict] = {
+    "wire_drop": {
+        "kind": "pipeline",
+        "desc": "event batches vanish pre-wire; the loss ledger must "
+                "match the plan's fired count exactly",
+        "faults": {"wire.post.drop": {"prob": 0.25, "max_fires": 3}},
+    },
+    "wire_dup": {
+        "kind": "pipeline",
+        "desc": "every POST may be duplicated on the wire; the "
+                "endpoint dedupe ring must keep dispatch exactly-once",
+        "faults": {"wire.post.dup": {"prob": 0.35}},
+    },
+    "wire_lost_reply": {
+        "kind": "pipeline",
+        "desc": "a 200 is poisoned into a lost reply; the bounded "
+                "retry replays and the replay must dedupe",
+        "faults": {"wire.post.lost_reply": {"prob": 0.3, "max_fires": 4}},
+    },
+    "wire_sever": {
+        "kind": "pipeline",
+        "desc": "the keep-alive poll socket is severed; the receive "
+                "loop must reconnect and replay unacked events "
+                "idempotently",
+        "faults": {"wire.poll.sever": {"prob": 0.25, "max_fires": 3}},
+    },
+    "ingress_429": {
+        "kind": "pipeline",
+        "desc": "a 429 storm with Retry-After; the transceiver must "
+                "honor the header inside its bounded retry, losing "
+                "nothing",
+        "faults": {"endpoint.ingress.refuse": {
+            "prob": 0.35, "max_fires": 6,
+            "status": 429, "retry_after": 0.05}},
+    },
+    "poll_stall": {
+        "kind": "pipeline",
+        "desc": "long-polls stall server-side; delivery slows but "
+                "nothing is lost or doubled",
+        "faults": {"endpoint.poll.stall": {
+            "prob": 0.3, "max_fires": 3, "delay_s": 0.25}},
+    },
+    "storage_torn": {
+        "kind": "storage",
+        "desc": "renames fail and tmp files tear mid-write; fsck must "
+                "find + repair every mess and complete runs stay "
+                "readable",
+        "faults": {"storage.tear": {"prob": 0.2},
+                   "storage.rename": {"prob": 0.2}},
+    },
+    "storage_fsync": {
+        "kind": "storage",
+        "desc": "fsyncs fail (ENOSPC/EIO class); destinations must "
+                "hold complete documents throughout",
+        "faults": {"storage.fsync": {"prob": 0.3}},
+    },
+    "knowledge_outage": {
+        "kind": "knowledge",
+        "desc": "mid-stream EOFs, a dead service, a delayed restart; "
+                "the client degrades without raising and the pooled "
+                "state survives exactly-once",
+        "faults": {"knowledge.eof": {"prob": 0.3, "max_fires": 3}},
+    },
+    "crash_restart": {
+        "kind": "crash",
+        "desc": "orchestrator killed with every event parked; the "
+                "journal-recovering successor + transceiver replay "
+                "must dispatch each exactly once",
+        "faults": {},
+    },
+}
+
+#: the CI smoke matrix — wire, endpoint, storage, knowledge, and crash
+#: fault families all covered (>= 6 scenarios per the acceptance bar)
+DEFAULT_MATRIX: List[str] = [
+    "wire_drop", "wire_dup", "wire_lost_reply", "wire_sever",
+    "ingress_429", "storage_torn", "knowledge_outage", "crash_restart",
+]
+
+
+def resolve_matrix(spec: str) -> List[str]:
+    """``"all"``, ``"default"``, or a comma-separated scenario list."""
+    if spec in ("", "default"):
+        names = list(DEFAULT_MATRIX)
+    elif spec == "all":
+        names = sorted(SCENARIOS)
+    else:
+        names = [s.strip() for s in spec.split(",") if s.strip()]
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        raise ValueError(
+            f"unknown scenario(s) {unknown}; known: {sorted(SCENARIOS)}")
+    return names

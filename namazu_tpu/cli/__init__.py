@@ -16,6 +16,7 @@ from typing import List, Optional
 def build_parser() -> argparse.ArgumentParser:
     from namazu_tpu.cli import (
         campaign_cmd,
+        chaos_cmd,
         container_cmd,
         init_cmd,
         inspectors_cmd,
@@ -38,6 +39,7 @@ def build_parser() -> argparse.ArgumentParser:
     tools_cmd.register(sub)
     container_cmd.register(sub)
     sidecar_cmd.register(sub)
+    chaos_cmd.register(sub)
     return parser
 
 

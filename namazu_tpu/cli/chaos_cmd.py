@@ -1,0 +1,113 @@
+"""``nmz-tpu chaos [example] --seed S --matrix M`` — the chaos matrix.
+
+Runs the seeded fault-injection scenario matrix
+(namazu_tpu/chaos/scenarios.py) through the invariant harness
+(namazu_tpu/chaos/harness.py) and reports per-scenario verdicts. Exit
+status 0 = every invariant held in every scenario; 1 = at least one
+violation (the report names it). The same seed reproduces the same
+fault schedule bit-for-bit, so a red matrix is a *repro*, not a flake
+— doc/robustness.md "Chaos plane".
+
+The optional example dir (default ``examples/flaky-init``) supplies
+the ``explore_policy_param`` table the pipeline scenarios' policy is
+configured from; the harness pins the knobs determinism needs (exact
+policy delays, seeded RNGs, port 0, no testee fault actions) on top of
+it. A missing example dir is an error — a typo must not silently run
+the built-in defaults while claiming the example.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+from namazu_tpu.chaos.scenarios import DEFAULT_MATRIX, SCENARIOS, \
+    resolve_matrix
+from namazu_tpu.utils.log import init_log
+
+DEFAULT_EXAMPLE = "examples/flaky-init"
+
+
+def register(sub) -> None:
+    p = sub.add_parser(
+        "chaos",
+        help="run the seeded fault-injection matrix against the "
+             "serving plane and check the survivability invariants "
+             "(doc/robustness.md)")
+    p.add_argument("example", nargs="?", default=DEFAULT_EXAMPLE,
+                   help="example dir whose config's "
+                        "explore_policy_param table seeds the pipeline "
+                        "scenarios' policy (determinism knobs pinned "
+                        f"on top; default {DEFAULT_EXAMPLE})")
+    p.add_argument("--seed", type=int, default=1,
+                   help="matrix seed; the whole fault schedule is a "
+                        "pure function of it (default 1)")
+    p.add_argument("--matrix", default="default",
+                   help="comma-separated scenario names, 'default' "
+                        f"({','.join(DEFAULT_MATRIX)}), or 'all'")
+    p.add_argument("--events", type=int, default=8,
+                   help="events per entity per scenario (default 8)")
+    p.add_argument("--workdir", default="",
+                   help="scenario scratch dir (default: a fresh temp "
+                        "dir)")
+    p.add_argument("--out", default="",
+                   help="write the full JSON report here (the CI "
+                        "artifact)")
+    p.add_argument("--list", action="store_true",
+                   help="list scenarios and exit")
+    p.set_defaults(func=run)
+
+
+def run(args) -> int:
+    if args.list:
+        for name in sorted(SCENARIOS):
+            spec = SCENARIOS[name]
+            print(f"{name:<18} [{spec['kind']:<9}] {spec['desc']}")
+        return 0
+    try:
+        names = resolve_matrix(args.matrix)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    cfg_path = os.path.join(args.example, "config.toml")
+    if not os.path.exists(cfg_path):
+        print(f"error: {cfg_path} not found (the example dir supplies "
+              "the pipeline scenarios' policy params)", file=sys.stderr)
+        return 2
+    from namazu_tpu.utils.config import Config
+
+    base_policy_param = Config.from_file(cfg_path).get(
+        "explore_policy_param", {}) or {}
+    init_log()
+    workdir = args.workdir or tempfile.mkdtemp(prefix="nmz-chaos-")
+    os.makedirs(workdir, exist_ok=True)
+
+    from namazu_tpu.chaos.harness import run_matrix
+
+    report = run_matrix(names, args.seed, workdir, events=args.events,
+                        base_policy_param=dict(base_policy_param))
+    report["example"] = os.path.abspath(args.example)
+    report["workdir"] = workdir
+    for res in report["scenarios"]:
+        verdict = "OK " if res["ok"] else "FAIL"
+        print(f"{verdict} {res['scenario']:<18} [{res['kind']:<9}] "
+              f"seed={res['seed']} {res['wall_s']}s")
+        if not res["ok"]:
+            for inv, detail in res["invariants"].items():
+                if not detail["ok"]:
+                    print(f"     violated: {inv}: "
+                          f"{json.dumps(detail, default=str)[:400]}")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True, default=str)
+        print(f"wrote {args.out}")
+    if report["ok"]:
+        print(f"chaos matrix green: {len(names)} scenario(s), seed "
+              f"{args.seed}")
+        return 0
+    print(f"chaos matrix RED: violations in "
+          f"{', '.join(report['violations'])} (seed {args.seed} "
+          "reproduces this exactly)", file=sys.stderr)
+    return 1

@@ -82,6 +82,11 @@ def _common_flags(p) -> None:
 
 def _make_transceiver(args, default_entity: str):
     """Build transceiver (+ autopilot orchestrator for local://)."""
+    # chaos harnesses reach wire seams inside inspector processes via
+    # the environment (doc/robustness.md); a no-op unless NMZ_CHAOS set
+    from namazu_tpu import chaos
+
+    chaos.install_from_env()
     entity = args.entity_id or default_entity
     url = args.orchestrator_url
     if url.startswith("local://"):

@@ -24,16 +24,28 @@ def register(sub) -> None:
     p.add_argument("--config", default=None, help="config file")
     p.add_argument("--rest-port", type=int, default=None,
                    help=f"REST port (default {DEFAULT_REST_PORT}; 0 = auto)")
+    p.add_argument("--journal-dir", default=None,
+                   help="crash-recovery event journal dir "
+                        "(doc/robustness.md): a restarted orchestrator "
+                        "pointed at the same dir resumes the parked "
+                        "events a kill -9 stranded")
     p.set_defaults(func=run)
 
 
 def run(args) -> int:
     init_log()
+    # chaos fault plans reach standalone orchestrators via NMZ_CHAOS
+    # (no-op unless set; doc/robustness.md "Chaos plane")
+    from namazu_tpu import chaos
+
+    chaos.install_from_env()
     cfg = Config.from_file(args.config) if args.config else Config()
     if args.rest_port is not None:
         cfg.set("rest_port", args.rest_port)
     elif int(cfg.get("rest_port", -1)) < 0:
         cfg.set("rest_port", DEFAULT_REST_PORT)
+    if args.journal_dir:
+        cfg.set("event_journal_dir", args.journal_dir)
 
     from namazu_tpu.policy.plugins import load_policy_plugins
 

@@ -40,6 +40,13 @@ def register(sub) -> None:
                  f"process group is killed on expiry); default: the "
                  f"config's {phase}_deadline_s, 0 = none")
     p.add_argument(
+        "--journal", action="store_true",
+        help="write the crash-recovery event journal into the run's "
+             "working dir (doc/robustness.md): a killed orchestrator's "
+             "parked events survive and a restart over the same dir "
+             "resumes them. Also enabled by event_journal = true in "
+             "the config")
+    p.add_argument(
         "--knowledge", default="", metavar="HOST:PORT",
         help="global failure-knowledge service address (a sidecar "
              "started with --pool-dir, doc/knowledge.md): cold runs "
@@ -73,6 +80,12 @@ def run(args) -> int:
               "afterwards)", file=sys.stderr)
         return 1
     cfg = Config.from_file(cfg_path)
+    # chaos plane (doc/robustness.md): fault plans reach child `run`
+    # processes (campaign slots, kill-tests) via NMZ_CHAOS; no-op unless
+    # set, and an explicitly installed plan wins
+    from namazu_tpu import chaos
+
+    chaos.install_from_env()
     if args.knowledge:
         # CLI wins over the config snapshot (same precedence as the
         # deadline flags): `campaign --knowledge` forwards this to every
@@ -87,7 +100,16 @@ def run(args) -> int:
     if not cfg.is_set("run_id"):
         cfg.set("run_id", os.path.basename(os.path.normpath(working_dir)))
     init_log(os.path.join(working_dir, "nmz.log"))
+    if args.journal or bool(cfg.get("event_journal")):
+        # the journal lives in the run's own dir: recovery is per-run,
+        # and fsck/quarantine semantics over the storage stay untouched
+        cfg.set("event_journal_dir", working_dir)
     factory = CmdFactory(working_dir=working_dir, materials_dir=materials_dir)
+    # record the run script's process group while a phase is in flight:
+    # if THIS process is SIGKILLed mid-run (the orchestrator crash the
+    # chaos plane injects), the campaign supervisor sweeps the group so
+    # testee processes cannot orphan into the next slot
+    factory.pgid_file = os.path.join(working_dir, "phase.pgid")
 
     from namazu_tpu.policy.plugins import load_policy_plugins
 

@@ -190,6 +190,12 @@ class EndpointHub:
         with self._lock:
             return dict(self._last_seen)
 
+    def routes(self) -> Dict[str, str]:
+        """Snapshot of the entity -> endpoint routing table (the event
+        journal persists it so recovery can restore dispatch routes)."""
+        with self._lock:
+            return dict(self._entity_route)
+
     def stalled_entities(self, timeout_s: float,
                          now: Optional[float] = None) -> Dict[str, float]:
         """Entities silent for more than ``timeout_s``, with their

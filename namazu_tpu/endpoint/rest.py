@@ -33,6 +33,14 @@ run summaries), ``GET /traces/<run_id>`` (Chrome-trace JSON;
 ``GET /analytics`` (cross-run experiment statistics, ``?format=json``
 default or ``ndjson``) — doc/observability.md.
 
+Bounded ingress (doc/robustness.md "Chaos plane"): with
+``ingress_cap`` > 0, event POSTs arriving while more than that many
+events sit undrained in the hub queue are refused with **429 +
+Retry-After** (``nmz_ingress_rejections_total``) instead of growing
+the queue without limit; the transceiver's bounded retry honors the
+header. The ``endpoint.*`` chaos fault points (injected refusals,
+long-poll stalls) are seamed through the same handlers.
+
 Implementation: stdlib ThreadingHTTPServer — one thread per in-flight
 request, which long-polling requires anyway; no third-party HTTP stack.
 """
@@ -42,6 +50,7 @@ from __future__ import annotations
 import itertools
 import json
 import re
+import socket as _socket
 import threading
 import time
 from collections import OrderedDict
@@ -49,7 +58,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib.parse import urlparse, parse_qs
 
-from namazu_tpu import obs
+from namazu_tpu import chaos, obs
 from namazu_tpu.endpoint.hub import Endpoint
 from namazu_tpu.signal.action import Action
 from namazu_tpu.signal.base import SignalError, signal_from_jsonable
@@ -189,14 +198,56 @@ class ActionQueue:
             return len(self._items)
 
 
+class _TrackingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that knows its open connections, so a
+    simulated crash (`Orchestrator.abandon`, the chaos harness's
+    in-process kill -9) can sever them the way real process death
+    would — otherwise an inspector's keep-alive long-poll keeps talking
+    to a zombie handler thread of a dead orchestrator instead of
+    reconnecting to its successor."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._open_requests: set = set()
+        self._open_lock = threading.Lock()
+
+    def process_request(self, request, client_address):
+        with self._open_lock:
+            self._open_requests.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._open_lock:
+            self._open_requests.discard(request)
+        super().shutdown_request(request)
+
+    def sever_connections(self) -> int:
+        with self._open_lock:
+            socks = list(self._open_requests)
+        for sock in socks:
+            try:
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+        return len(socks)
+
+
 class RestEndpoint(Endpoint):
     NAME = "rest"
 
     def __init__(self, port: int = 10080, host: str = "127.0.0.1",
-                 poll_timeout: float = 30.0):
+                 poll_timeout: float = 30.0, ingress_cap: int = 0,
+                 retry_after_s: float = 1.0):
         self._host = host
         self._port = port
         self.poll_timeout = poll_timeout
+        # bounded ingress (doc/robustness.md): when more than this many
+        # events sit undrained in the hub's queue, new POSTs are refused
+        # with 429 + Retry-After instead of growing the queue without
+        # limit — the transceiver's bounded retry honors the header.
+        # 0 = unbounded (the pre-backpressure behavior).
+        self.ingress_cap = max(0, int(ingress_cap))
+        self.retry_after_s = max(0.0, float(retry_after_s))
         self._queues: Dict[str, ActionQueue] = {}
         self._queues_lock = threading.Lock()
         self._server: Optional[ThreadingHTTPServer] = None
@@ -249,19 +300,55 @@ class RestEndpoint(Endpoint):
             def log_message(self, fmt, *args):  # route to our logger
                 log.debug("http: " + fmt, *args)
 
-            def _reply(self, code: int, body: Optional[dict] = None) -> None:
+            def _reply(self, code: int, body: Optional[dict] = None,
+                       headers: Optional[Dict[str, str]] = None) -> None:
                 data = json.dumps(body).encode() if body is not None else b""
-                self._reply_raw(code, data, "application/json")
+                self._reply_raw(code, data, "application/json",
+                                headers=headers)
 
             def _reply_raw(self, code: int, data: bytes,
-                           content_type: str) -> None:
+                           content_type: str,
+                           headers: Optional[Dict[str, str]] = None
+                           ) -> None:
                 obs.rest_request(self.command, code)
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 if data:
                     self.wfile.write(data)
+
+            def _reject_ingress(self, reason: str, status: int = 429,
+                                retry_after: Optional[float] = None
+                                ) -> None:
+                """Refuse an event POST (backpressure or chaos): the
+                429/503 + Retry-After contract the transceiver's
+                bounded retry honors (doc/robustness.md)."""
+                if retry_after is None:
+                    retry_after = endpoint.retry_after_s
+                obs.ingress_rejected(endpoint.NAME, reason)
+                self._reply(
+                    status,
+                    {"error": f"ingress refused ({reason}); retry after "
+                              f"{retry_after:g}s"},
+                    headers={"Retry-After": f"{retry_after:g}"})
+
+            def _ingress_refused(self) -> bool:
+                """Consult the chaos seam, then the bounded-ingress cap;
+                True = a refusal was already sent."""
+                fault = chaos.decide("endpoint.ingress.refuse")
+                if fault is not None:
+                    self._reject_ingress(
+                        "chaos", status=int(fault.get("status", 429)),
+                        retry_after=float(fault.get("retry_after", 0.05)))
+                    return True
+                cap = endpoint.ingress_cap
+                if cap > 0 and endpoint.hub.event_queue.qsize() >= cap:
+                    self._reject_ingress("backpressure")
+                    return True
+                return False
 
             def _read_body(self) -> bytes:
                 length = int(self.headers.get("Content-Length") or 0)
@@ -280,8 +367,18 @@ class RestEndpoint(Endpoint):
                 self._reply(404, {"error": f"no route {url.path}"})
 
             def _post_event(self, entity: str, uuid: str) -> None:
+                # the body must be READ even when refusing — an unread
+                # body desyncs the keep-alive connection (the next
+                # request line would parse mid-JSON) — but shed load
+                # before the JSON parse, which is the expensive part
                 try:
-                    sig = signal_from_jsonable(json.loads(self._read_body()))
+                    raw = self._read_body()
+                except ValueError as e:  # malformed Content-Length
+                    return self._reply(400, {"error": str(e)})
+                if self._ingress_refused():
+                    return
+                try:
+                    sig = signal_from_jsonable(json.loads(raw))
                 except (SignalError, ValueError) as e:
                     return self._reply(400, {"error": str(e)})
                 if not isinstance(sig, Event):
@@ -306,7 +403,13 @@ class RestEndpoint(Endpoint):
                 uuids idempotent), then fanned into the hub in ONE
                 call."""
                 try:
-                    body = json.loads(self._read_body())
+                    raw = self._read_body()  # always drain (keep-alive)
+                except ValueError as e:  # malformed Content-Length
+                    return self._reply(400, {"error": str(e)})
+                if self._ingress_refused():
+                    return
+                try:
+                    body = json.loads(raw)
                 except ValueError as e:
                     return self._reply(400, {"error": str(e)})
                 if isinstance(body, dict):
@@ -379,6 +482,11 @@ class RestEndpoint(Endpoint):
                     return self._reply(404, {"error": f"no route {url.path}"})
                 entity = m.group(1)
                 query = parse_qs(url.query)
+                # chaos seam: stall a long-poll (the inspector's receive
+                # loop must ride it out, not die)
+                fault = chaos.decide("endpoint.poll.stall")
+                if fault is not None:
+                    time.sleep(float(fault.get("delay_s", 0.2)))
                 raw_batch = (query.get("batch") or [None])[0]
                 if raw_batch is None:
                     # per-event wire (pre-batch inspectors): one head
@@ -514,7 +622,7 @@ class RestEndpoint(Endpoint):
                 self._reply(200, {"deleted": [a.uuid for a in deleted],
                                   "missing": missing})
 
-        self._server = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._server = _TrackingHTTPServer((self._host, self._port), Handler)
         self._server.daemon_threads = True
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="rest-endpoint", daemon=True
@@ -527,6 +635,13 @@ class RestEndpoint(Endpoint):
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+
+    def sever(self) -> int:
+        """Tear every open connection (simulated crash — see
+        :class:`_TrackingHTTPServer`); returns how many were cut."""
+        if self._server is None:
+            return 0
+        return self._server.sever_connections()
 
     # -- action dispatch -------------------------------------------------
 

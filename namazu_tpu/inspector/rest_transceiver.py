@@ -34,6 +34,17 @@ already queued. Throughput deployments opt into windows explicitly —
 ``use_batch=False`` speaks the exact pre-batch per-event wire (POST per
 event, single-action GET, per-uuid DELETE) — still over the persistent
 connections — for orchestrators that predate the batch routes.
+
+Survivability (doc/robustness.md "Chaos plane"): a 429/503 with
+``Retry-After`` (the endpoint's bounded-ingress backpressure) rides the
+bounded retry honoring the server's requested delay (capped +
+jittered) instead of raising into inspector code; posted-but-unanswered
+deferred events are kept in a bounded ring and **replayed** when the
+receive loop recovers from a transport error — the signature of an
+orchestrator restart — which the server-side dedupe (journal-seeded on
+recovery) makes idempotent. The ``wire.*`` chaos fault points
+(drop/dup/delay/lost-reply/sever) are seamed through the POST and poll
+paths and cost one no-op check when chaos is disabled.
 """
 
 from __future__ import annotations
@@ -44,10 +55,11 @@ import socket
 import threading
 import time
 import urllib.error
+from collections import OrderedDict
 from typing import List, Optional
 from urllib.parse import urlsplit
 
-from namazu_tpu import obs
+from namazu_tpu import chaos, obs
 from namazu_tpu.endpoint.rest import API_ROOT
 from namazu_tpu.inspector.transceiver import Transceiver
 from namazu_tpu.signal.action import Action
@@ -69,15 +81,35 @@ class TransientHTTPStatus(OSError):
     """A retryable response status (5xx-class / overload): the old
     urllib path raised HTTPError (a URLError subclass) for these, so
     they rode the bounded POST retry — an OSError subclass keeps them
-    inside ``_TRANSPORT_ERRORS``."""
+    inside ``_TRANSPORT_ERRORS``. ``retry_after`` carries the server's
+    Retry-After header (seconds) when it sent one — a 429 from the
+    endpoint's bounded ingress tells the client exactly when to come
+    back, and the bounded retry honors it (capped + jittered,
+    utils/retry.py) instead of guessing."""
+
+    def __init__(self, msg: str, retry_after: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after = retry_after
 
 
-def _check_post_status(status: int, what: str) -> None:
+def _check_post_status(status: int, what: str,
+                       retry_after: Optional[float] = None) -> None:
     if status == 200:
         return
     if status >= 500 or status in (408, 429):
-        raise TransientHTTPStatus(f"{what} -> {status}")
+        raise TransientHTTPStatus(f"{what} -> {status}",
+                                  retry_after=retry_after)
     raise RuntimeError(f"{what} -> {status}")
+
+
+def _retry_after_hint(exc: BaseException) -> Optional[float]:
+    """The bounded retry's delay_hint: honor a server-sent Retry-After
+    (observed into ``nmz_transport_retry_after_seconds``)."""
+    hint = getattr(exc, "retry_after", None)
+    if hint is None:
+        return None
+    obs.transport_retry_after(float(hint))
+    return float(hint)
 
 
 class _KeepAliveConn:
@@ -104,6 +136,10 @@ class _KeepAliveConn:
         # poll window)
         self._abort = abort
         self._conn: Optional[http.client.HTTPConnection] = None
+        #: Retry-After (seconds) from the most recent response, None
+        #: when absent — read by the POST path right after request()
+        #: so a 429's advice reaches the bounded retry
+        self.last_retry_after: Optional[float] = None
 
     def request(self, method: str, path: str,
                 body: Optional[bytes] = None):
@@ -141,6 +177,12 @@ class _KeepAliveConn:
                 conn.request(method, path, body=body, headers=headers)
                 resp = conn.getresponse()
                 data = resp.read()
+                raw_ra = resp.getheader("Retry-After")
+                try:
+                    self.last_retry_after = (None if raw_ra is None
+                                             else max(0.0, float(raw_ra)))
+                except ValueError:
+                    self.last_retry_after = None  # HTTP-date form: skip
                 if resp.will_close:
                     self.close()
                 return resp.status, data
@@ -212,6 +254,17 @@ class RestTransceiver(Transceiver):
         self._buf_cond = threading.Condition()
         self._flush_lock = threading.Lock()
         self._flush_thread: Optional[threading.Thread] = None
+        # reconnect-and-replay (doc/robustness.md): deferred events
+        # POSTed but not yet answered by an action. When the receive
+        # loop recovers from a transport error — the signature of an
+        # orchestrator restart — these are re-POSTed: a restarted
+        # endpoint accepts the ones its journal recovery seeded into
+        # its dedupe ring as duplicates (idempotent), and the ones the
+        # old process never journaled as fresh, so nothing is lost
+        # either way. Bounded: oldest evicted past the cap.
+        self._unacked: "OrderedDict[str, Event]" = OrderedDict()
+        self._unacked_lock = threading.Lock()
+        self._replay_armed = False
 
     # -- outbound --------------------------------------------------------
 
@@ -232,6 +285,7 @@ class RestTransceiver(Transceiver):
                 cap=self.backoff_max,
                 # an interruptible sleep: shutdown() aborts the backoff
                 sleep=self._stop.wait,
+                delay_hint=_retry_after_hint,
                 on_retry=lambda e, n, d: log.debug(
                     "event POST failed (%s); retry %d in %.2fs", e, n, d),
             )
@@ -249,6 +303,7 @@ class RestTransceiver(Transceiver):
                 base=self.backoff_step,
                 cap=self.backoff_max,
                 sleep=self._stop.wait,
+                delay_hint=_retry_after_hint,
                 on_retry=lambda e, n, d: log.debug(
                     "batch POST failed (%s); retry %d in %.2fs",
                     e, n, d),
@@ -270,13 +325,55 @@ class RestTransceiver(Transceiver):
     def _post_once(self, event: Event, ignore_stop: bool = False) -> None:
         if self._stop.is_set() and not ignore_stop:
             return  # shutting down: don't fight over a dying server
+        if self._wire_fault([event]):
+            return
         path = f"{self._path}/events/{event.entity_id}/{event.uuid}"
+        body = event.to_json().encode()
         with self._conn_lock:
             t0 = time.perf_counter()
-            status, _ = self._post_conn.request(
-                "POST", path, body=event.to_json().encode())
+            status, _ = self._post_conn.request("POST", path, body=body)
             obs.transport_rtt("post", time.perf_counter() - t0)
-        _check_post_status(status, f"POST {path}")
+            retry_after = self._post_conn.last_retry_after
+            if status == 200 \
+                    and chaos.decide("wire.post.dup") is not None:
+                # duplicate the POST on the wire: the endpoint's dedupe
+                # ring must absorb it
+                self._post_conn.request("POST", path, body=body)
+        _check_post_status(status, f"POST {path}", retry_after=retry_after)
+        self._note_posted([event])
+        if chaos.decide("wire.post.lost_reply") is not None:
+            # poison the 200 into a lost reply: the caller's bounded
+            # retry replays, and the replay must dedupe server-side
+            raise TransientHTTPStatus(f"chaos: 200 for POST {path} "
+                                      "lost in flight")
+
+    def _wire_fault(self, events: List[Event]) -> bool:
+        """Pre-wire chaos seams shared by both POST paths: True = the
+        send was dropped (the events never reach the wire — the lost-
+        event case the invariant harness accounts against the plan's
+        fired count)."""
+        fault = chaos.decide("wire.post.delay")
+        if fault is not None:
+            self._stop.wait(float(fault.get("delay_s", 0.05)))
+        if chaos.decide("wire.post.drop") is not None:
+            log.debug("chaos: dropped %d event(s) pre-wire", len(events))
+            return True
+        return False
+
+    def _note_posted(self, events: List[Event]) -> None:
+        """Track successfully-POSTed deferred events until their action
+        arrives (the reconnect-and-replay window)."""
+        with self._unacked_lock:
+            for event in events:
+                if getattr(event, "deferred", False):
+                    self._unacked[event.uuid] = event
+            while len(self._unacked) > self.UNACKED_CAP:
+                self._unacked.popitem(last=False)
+
+    #: bound on the posted-but-unanswered ring (an orchestrator would
+    #: have to park this many of ONE entity's deferred events for
+    #: replay coverage to shrink)
+    UNACKED_CAP = 1024
 
     def _ensure_flusher(self) -> None:
         if self._flush_thread is not None or self._stop.is_set():
@@ -338,6 +435,7 @@ class RestTransceiver(Transceiver):
                         base=self.backoff_step,
                         cap=self.backoff_max,
                         sleep=self._stop.wait,
+                        delay_hint=_retry_after_hint,
                         on_retry=lambda e, n, d: log.debug(
                             "batch POST failed (%s); retry %d in %.2fs",
                             e, n, d),
@@ -345,6 +443,8 @@ class RestTransceiver(Transceiver):
 
     def _post_batch_once(self, chunk: List[Event],
                          entity: Optional[str] = None) -> None:
+        if self._wire_fault(chunk):
+            return
         entity = self.entity_id if entity is None else entity
         body = json.dumps([ev.to_jsonable() for ev in chunk]).encode()
         path = f"{self._path}/events/{entity}/batch"
@@ -352,6 +452,10 @@ class RestTransceiver(Transceiver):
             t0 = time.perf_counter()
             status, _ = self._post_conn.request("POST", path, body=body)
             obs.transport_rtt("post_batch", time.perf_counter() - t0)
+            retry_after = self._post_conn.last_retry_after
+            if status == 200 \
+                    and chaos.decide("wire.post.dup") is not None:
+                self._post_conn.request("POST", path, body=body)
         if status in (400, 404):
             # a pre-batch orchestrator has no .../batch route (its
             # per-event route reads "batch" as a uuid and 400s the list
@@ -363,8 +467,12 @@ class RestTransceiver(Transceiver):
             for event in chunk:
                 self._post_once(event, ignore_stop=True)
             return
-        _check_post_status(status, f"POST {path}")
+        _check_post_status(status, f"POST {path}", retry_after=retry_after)
+        self._note_posted(chunk)
         obs.event_batch("flush", len(chunk))
+        if chaos.decide("wire.post.lost_reply") is not None:
+            raise TransientHTTPStatus(f"chaos: 200 for POST {path} "
+                                      "lost in flight")
 
     # -- inbound ---------------------------------------------------------
 
@@ -420,17 +528,70 @@ class RestTransceiver(Transceiver):
                     SignalError) as e:
                 backoff = min(backoff + self.backoff_step, self.backoff_max)
                 log.debug("poll error (%s); backing off %.1fs", e, backoff)
+                # arm replay: when the server answers again it may be a
+                # RESTARTED orchestrator that lost our in-flight events
+                self._replay_armed = True
                 self._stop.wait(backoff)
                 continue
+            if self._replay_armed:
+                self._replay_armed = False
+                self._replay_unacked()
             for action in actions:
                 self.dispatch_action(action)
         self._recv_conn.close()
+
+    def dispatch_action(self, action) -> None:
+        # the event is answered: it leaves the replay window before the
+        # waiter hand-off (a replay racing this ack at worst re-posts an
+        # already-answered uuid, which the dedupe ring absorbs)
+        with self._unacked_lock:
+            self._unacked.pop(action.event_uuid, None)
+        super().dispatch_action(action)
+
+    def _replay_unacked(self) -> None:
+        """Re-POST every posted-but-unanswered deferred event after the
+        server came back (doc/robustness.md): against the same process
+        the dedupe ring answers ``duplicate``; against a restarted one
+        the journal-seeded ring dedupes recovered events and accepts
+        the rest fresh — either way the events exist server-side
+        exactly once afterwards. Best-effort: a replay that fails rides
+        the next reconnect (the loop re-arms on the next poll error)."""
+        with self._unacked_lock:
+            events = list(self._unacked.values())
+        if not events:
+            return
+        log.warning("transport recovered; replaying %d unacked "
+                    "event(s) (server-side dedupe makes this "
+                    "idempotent)", len(events))
+        by_entity: "dict[str, List[Event]]" = {}
+        for event in events:
+            by_entity.setdefault(event.entity_id, []).append(event)
+        for entity, batch in by_entity.items():
+            for i in range(0, len(batch), self.batch_max):
+                chunk = batch[i:i + self.batch_max]
+                try:
+                    if self.use_batch:
+                        self._post_batch_once(chunk, entity)
+                    else:
+                        for event in chunk:
+                            self._post_once(event)
+                except Exception as e:
+                    log.debug("unacked replay failed (%s); will retry "
+                              "on the next reconnect", e)
+                    self._replay_armed = True
+                    return
 
     def _poll_once(self) -> List[Action]:
         """One long-poll cycle over the receive thread's persistent
         connection; returns the acknowledged actions (empty on a 204
         timeout). Batch mode drains up to ``poll_batch`` actions and
         acks them with one multi-uuid DELETE."""
+        if chaos.decide("wire.poll.sever") is not None:
+            # tear the keep-alive socket under the receive thread: the
+            # loop must back off, reconnect, and (via the replay arm)
+            # re-offer unacked events — never die or lose its waiters
+            self._recv_conn.close()
+            raise OSError("chaos: keep-alive severed")
         if self.use_batch:
             return self._poll_once_batch()
         path = f"{self._path}/actions/{self.entity_id}"

@@ -26,7 +26,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from namazu_tpu import obs
+from namazu_tpu import chaos, obs
 from namazu_tpu.endpoint.agent import read_frame, write_frame
 from namazu_tpu.models.failure_pool import (
     MAX_LOAD,
@@ -93,18 +93,39 @@ class KnowledgeClient:
             self._close_sock()
 
     def _roundtrip(self, req: dict) -> dict:
-        """One framed request/response on the persistent connection,
-        with one transparent reconnect on a stale socket (the service
-        may have restarted between runs). Caller holds the lock."""
+        """One framed request/response on the persistent connection.
+
+        Failure classes are deliberately distinct (doc/robustness.md):
+
+        * **connection-level** — reset / EOF mid-reply / torn frame on
+          an established socket. The usual cause is a service that
+          restarted between runs (our keep-alive socket went stale) or
+          dropped this one connection; the service itself is fine, so
+          the request gets ONE immediate transparent retry on a fresh
+          socket instead of burning a 30 s outage cooldown.
+        * **availability-level** — connect refused (``_connect``
+          raises, never reaches the retry) or a timeout (the service is
+          up but hung; re-asking a fresh socket would just double the
+          stall): these propagate at once and the caller opens the
+          cooldown.
+
+        Caller holds the lock."""
         for attempt in (0, 1):
             if self._sock is None:
                 self._sock = self._connect()
             try:
                 write_frame(self._sock, req)
+                # chaos seam: the service dies mid-reply (framed EOF)
+                if chaos.decide("knowledge.eof") is not None:
+                    self._close_sock()
+                    raise ConnectionResetError("chaos: mid-stream EOF")
                 resp = read_frame(self._sock)
                 if resp is None:
-                    raise ConnectionError("connection closed mid-request")
+                    raise ConnectionError("connection closed mid-reply")
                 return resp
+            except (socket.timeout, TimeoutError) as e:
+                self._close_sock()
+                raise ConnectionError(f"timeout: {e}") from e
             except (OSError, ValueError) as e:
                 self._close_sock()
                 if attempt:
@@ -119,6 +140,10 @@ class KnowledgeClient:
         with self._lock:
             now = time.monotonic()
             if now < self._down_until:
+                return None
+            # chaos seam: a hard outage (as if the port were closed)
+            if chaos.decide("knowledge.outage") is not None:
+                self._mark_outage("chaos: injected outage")
                 return None
             try:
                 resp = self._roundtrip(req)

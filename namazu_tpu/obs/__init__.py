@@ -63,10 +63,14 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     action_dispatched,
     action_unroutable,
     carry,
+    chaos_fault_injected,
     entity_stalled,
     event_batch,
     event_intercepted,
     experiment_stats,
+    ingress_rejected,
+    journal_events,
+    journal_recovered,
     knowledge_outage,
     knowledge_pull,
     knowledge_push,
@@ -89,6 +93,7 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     search_stall,
     sidecar_request,
     span,
+    transport_retry_after,
     transport_rtt,
 )
 

@@ -79,6 +79,16 @@ BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
 ACTIONS_UNROUTABLE = "nmz_actions_unroutable_total"
 ENTITY_STALLED = "nmz_entity_stalled_total"
 
+# chaos + survivability plane (doc/robustness.md "Chaos plane"):
+# injected faults by point, ingress backpressure rejections, the
+# server-requested Retry-After delays the transceiver honored, and the
+# crash-recovery journal's traffic
+CHAOS_FAULTS = "nmz_chaos_faults_injected_total"
+INGRESS_REJECTIONS = "nmz_ingress_rejections_total"
+TRANSPORT_RETRY_AFTER = "nmz_transport_retry_after_seconds"
+JOURNAL_EVENTS = "nmz_journal_events_total"
+JOURNAL_RECOVERED = "nmz_journal_recovered_events_total"
+
 # global failure-knowledge plane (doc/knowledge.md): cross-campaign
 # pool traffic, warm-start installs, the shared surrogate's training
 # cadence, and the service's tenant/pool occupancy
@@ -267,6 +277,59 @@ def entity_stalled(entity: str) -> None:
         "released)",
         ("entity",),
     ).labels(entity=_entity_label(reg, entity)).inc()
+
+
+def chaos_fault_injected(point: str) -> None:
+    """A chaos fault point fired (namazu_tpu/chaos): the injected-fault
+    ledger a scenario report joins against its invariants."""
+    if not metrics.enabled():
+        return
+    metrics.get().counter(
+        CHAOS_FAULTS,
+        "chaos-plane faults injected, by fault point",
+        ("point",),
+    ).labels(point=point).inc()
+
+
+def ingress_rejected(endpoint: str, reason: str) -> None:
+    """The REST endpoint refused an event POST — backpressure (the
+    bounded ingress queue is full) or an injected chaos refusal."""
+    if not metrics.enabled():
+        return
+    metrics.get().counter(
+        INGRESS_REJECTIONS,
+        "event POSTs refused with 429/503 (backpressure or chaos)",
+        ("endpoint", "reason"),
+    ).labels(endpoint=endpoint, reason=reason).inc()
+
+
+def transport_retry_after(seconds: float) -> None:
+    """The transceiver honored a server-sent Retry-After before its
+    next POST attempt (capped + jittered; doc/robustness.md)."""
+    if not metrics.enabled():
+        return
+    metrics.get().histogram(
+        TRANSPORT_RETRY_AFTER,
+        "server-requested Retry-After delays honored by the transceiver",
+    ).observe(seconds)
+
+
+def journal_events(n: int) -> None:
+    if n <= 0 or not metrics.enabled():
+        return
+    metrics.get().counter(
+        JOURNAL_EVENTS,
+        "inbound events appended to the crash-recovery journal",
+    ).inc(n)
+
+
+def journal_recovered(n: int) -> None:
+    if n <= 0 or not metrics.enabled():
+        return
+    metrics.get().counter(
+        JOURNAL_RECOVERED,
+        "parked events recovered from the journal after a restart",
+    ).inc(n)
 
 
 def event_batch(stage: str, size: int) -> None:

@@ -20,12 +20,14 @@ three worker threads around queues —
 
 from __future__ import annotations
 
+import os
 import queue
+import signal as _signal
 import threading
 import uuid as _uuid
 from typing import Optional
 
-from namazu_tpu import obs
+from namazu_tpu import chaos, obs
 from namazu_tpu.endpoint.hub import EndpointHub
 from namazu_tpu.endpoint.local import LocalEndpoint
 from namazu_tpu.policy.base import POLICY_DONE, ExplorePolicy, create_policy
@@ -79,6 +81,18 @@ class Orchestrator:
         # run behind delays nobody will ever observe. 0 = disabled.
         self.liveness_timeout_s = float(
             config.get("entity_liveness_timeout_s", 0) or 0)
+        # crash-recovery event journal (doc/robustness.md "Chaos
+        # plane"): a write-ahead log of inbound events + dispatched
+        # releases in the run's dir, so a killed-and-restarted
+        # orchestrator resumes its parked events instead of losing the
+        # run. Off unless the config names a dir ("" = the
+        # pre-journal behavior, zero hot-path cost).
+        journal_dir = str(config.get("event_journal_dir", "") or "")
+        self.journal = None
+        if journal_dir:
+            from namazu_tpu.chaos.journal import EventJournal
+
+            self.journal = EventJournal(journal_dir)
         self._watchdog_stop = threading.Event()
         # entities currently declared dead; an entity leaves the set
         # when it is seen again (metric + warning fire per transition,
@@ -95,7 +109,10 @@ class Orchestrator:
         if rest_port >= 0:
             from namazu_tpu.endpoint.rest import RestEndpoint
 
-            hub.add_endpoint(RestEndpoint(port=rest_port))
+            hub.add_endpoint(RestEndpoint(
+                port=rest_port,
+                # bounded ingress (doc/robustness.md): 0 = unbounded
+                ingress_cap=int(config.get("rest_ingress_cap", 0) or 0)))
         agent_port = int(config.get("agent_port", -1))
         if agent_port >= 0:
             try:
@@ -119,6 +136,11 @@ class Orchestrator:
             return
         self._started = True
         obs.begin_run(self.run_id)
+        # recover BEFORE the endpoints open: the dedupe ring must know
+        # the journaled uuids before an inspector's reconnect-and-
+        # replay can reach the wire, or the replay doubles every
+        # recovered event
+        self._recover_journal()
         self.hub.start()
         self.policy.start()
         self.dumb.start()
@@ -130,6 +152,32 @@ class Orchestrator:
         if self.liveness_timeout_s > 0:
             self._add_thread(self._watchdog_loop, "watchdog")
         log.debug("orchestrator started (enabled=%s)", self.enabled)
+
+    def _recover_journal(self) -> None:
+        """Reload parked events a killed predecessor journaled but
+        never dispatched (doc/robustness.md): seed the REST dedupe ring
+        with their uuids (an inspector-side replay must ack idempotent,
+        not double), then re-post them through the hub — which restores
+        the entity routes AND the liveness bookkeeping, so the re-armed
+        watchdog force-releases events whose entity never speaks
+        again."""
+        if self.journal is None:
+            return
+        recovered = self.journal.unreleased()
+        if not recovered:
+            return
+        rest = self.hub.endpoint("rest")
+        if rest is not None and hasattr(rest, "note_event_uuid"):
+            for event, _ in recovered:
+                rest.note_event_uuid(event.uuid)
+        for event, endpoint_name in recovered:
+            self.hub.post_event(event, endpoint_name or "local")
+        obs.journal_recovered(len(recovered))
+        log.warning(
+            "recovered %d parked event(s) from the event journal; "
+            "resuming the run (liveness watchdog %s)", len(recovered),
+            f"re-armed at {self.liveness_timeout_s:.1f}s"
+            if self.liveness_timeout_s > 0 else "disabled")
 
     def shutdown(self) -> SingleTrace:
         """Stop all loops, flushing in dependency order so no action is
@@ -160,11 +208,44 @@ class Orchestrator:
         self.hub.control_queue.put(_STOP)  # type: ignore[arg-type]
         self._threads["control"].join(timeout=10)
         self.hub.shutdown()
+        if self.journal is not None:
+            # every parked event was flushed above and its release
+            # journaled: the run completed, so remove the file — a
+            # later orchestrator over the same dir must not re-parse
+            # (or endlessly grow) a fully-released history. A crash
+            # ANYWHERE before this line leaves the journal for
+            # recovery, which is the point.
+            self.journal.remove()
         log.debug("orchestrator shut down; trace length %d", len(self.trace))
         # close the flight-recorder run LAST: the drains above still
         # stamp released/dispatched records against it
         obs.end_run(self.run_id)
         return self.trace
+
+    def abandon(self) -> None:
+        """Die WITHOUT the graceful drain — the in-process stand-in for
+        ``kill -9`` the chaos harness's crash scenarios use: endpoints
+        are torn down so the ports free up and a successor can bind
+        them, but policies are NOT flushed, parked events are NOT
+        released, and the journal gets no further records. Everything a
+        real SIGKILL would leak (daemon worker threads parked on their
+        queues) leaks here too; only a journal-recovering successor can
+        resume the run."""
+        self._shut_down = True
+        self._watchdog_stop.set()
+        # sever live connections first, like process death would: an
+        # inspector's keep-alive long-poll must error and reconnect (to
+        # the successor), not keep talking to zombie handler threads
+        for name in ("rest",):
+            ep = self.hub.endpoint(name)
+            if ep is not None and hasattr(ep, "sever"):
+                ep.sever()
+        self.hub.shutdown()
+        if self.journal is not None:
+            self.journal.close()
+        obs.end_run(self.run_id)
+        log.warning("orchestrator abandoned (simulated crash); parked "
+                    "events remain journaled but undispatched")
 
     # -- loops -----------------------------------------------------------
 
@@ -193,6 +274,22 @@ class Orchestrator:
                     stop = True
                     break
                 batch.append(nxt)
+            if self.journal is not None:
+                # write-ahead: the batch is durable BEFORE the policy
+                # sees it, so a crash from here on can lose nothing
+                try:
+                    self.journal.append_events(batch, self.hub.routes())
+                    obs.journal_events(len(batch))
+                except OSError:
+                    log.exception("event journal append failed; "
+                                  "continuing without durability")
+                # chaos seam: die like kill -9 WOULD — after the journal
+                # write, before dispatch (the recovery window the crash
+                # scenarios exercise)
+                if chaos.decide("orchestrator.crash") is not None:
+                    log.error("chaos: orchestrator.crash fired; "
+                              "SIGKILLing this process")
+                    os.kill(os.getpid(), _signal.SIGKILL)
             target = self.policy if self.enabled else self.dumb
             for ev in batch:
                 obs.mark(ev, "enqueued")
@@ -262,11 +359,13 @@ class Orchestrator:
             # barriers so in-process execution keeps its place in the
             # release order
             forward: list = []
+            released_uuids: list = []
             for item in batch:
                 if item is _FWD_DONE:
                     done += 1
                     continue
                 action: Action = item  # type: ignore[assignment]
+                released_uuids.append(action.event_uuid or action.uuid)
                 action.mark_triggered()
                 obs.mark(action, "dispatched")
                 kind = ("orchestrator" if action.orchestrator_side_only
@@ -289,6 +388,15 @@ class Orchestrator:
                     forward.append(action)
             if forward:
                 self.hub.send_actions(forward)
+            if self.journal is not None and released_uuids:
+                # release records land AFTER dispatch: the crash window
+                # between the two is at-least-once, which the endpoint
+                # dedupe + waiter-keyed dispatch absorb; the reverse
+                # order would lose events (chaos/journal.py)
+                try:
+                    self.journal.append_releases(released_uuids)
+                except OSError:
+                    log.exception("event journal release append failed")
             if done >= self._n_policies:
                 return
 

@@ -185,8 +185,15 @@ class QueueBackedPolicy(ExplorePolicy):
         return event.default_action()
 
     def force_release_entity(self, entity_id: str) -> int:
-        return self._queue.expedite(
-            lambda ev: getattr(ev, "entity_id", None) == entity_id)
+        events = self._queue.expedite(
+            lambda ev: getattr(ev, "entity_id", None) == entity_id,
+            collect=True)
+        # attribute the non-policy release: the chaos invariant checker
+        # and `tools trace diff` must be able to tell "the watchdog
+        # freed this" from "the policy chose this" (doc/robustness.md)
+        for event in events:
+            obs.record_decision(event, self.name, source="watchdog")
+        return len(events)
 
     def shutdown(self) -> None:
         """Release all still-delayed events immediately, wait for the

@@ -21,6 +21,8 @@ import os
 import tempfile
 from typing import Any
 
+from namazu_tpu import chaos
+
 
 def atomic_write(path: str, data: bytes) -> None:
     """Atomically replace ``path``'s content with ``data``."""
@@ -30,12 +32,27 @@ def atomic_write(path: str, data: bytes) -> None:
     # atomic within one filesystem
     fd, tmp = tempfile.mkstemp(
         dir=dir_path, prefix=os.path.basename(path) + ".", suffix=".tmp")
+    # chaos seam (doc/robustness.md): a torn tmp simulates a hard kill
+    # mid-write — half the payload lands, NOTHING is cleaned up, and the
+    # stray .tmp is exactly what `tools fsck` exists to sweep
+    if chaos.decide("storage.tear") is not None:
+        try:
+            os.write(fd, data[: max(1, len(data) // 2)])
+        finally:
+            os.close(fd)
+        raise OSError(f"chaos: write torn mid-flight (left {tmp})")
     try:
         try:
             os.write(fd, data)
+            # chaos seam: a failed fsync (ENOSPC/EIO class) before the
+            # rename — the destination must stay untouched
+            if chaos.decide("storage.fsync") is not None:
+                raise OSError("chaos: injected fsync failure")
             os.fsync(fd)
         finally:
             os.close(fd)
+        if chaos.decide("storage.rename") is not None:
+            raise OSError("chaos: injected rename failure")
         os.replace(tmp, path)
     except BaseException:
         # failed before the rename landed: the destination is untouched;

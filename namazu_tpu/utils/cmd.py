@@ -68,6 +68,12 @@ class CmdFactory:
     def __init__(self, working_dir: str = "", materials_dir: str = ""):
         self.working_dir = working_dir
         self.materials_dir = materials_dir
+        # when set, deadline-mode phases write their process-group id
+        # here while in flight (removed on completion): the breadcrumb
+        # a supervisor needs to kill testee groups orphaned by a HARD
+        # kill of this process — SIGKILL skips every finally, so the
+        # group's pgid must already be on disk (doc/robustness.md)
+        self.pgid_file: str = ""
 
     def env(self) -> dict:
         env = dict(os.environ)
@@ -113,6 +119,7 @@ class CmdFactory:
                 argv, env=self.env(), cwd=run_cwd, timeout=timeout)
         proc = subprocess.Popen(
             argv, env=self.env(), cwd=run_cwd, start_new_session=True)
+        self._write_pgid(proc)
         try:
             proc.wait(timeout=deadline)
         except subprocess.TimeoutExpired:
@@ -125,4 +132,62 @@ class CmdFactory:
             # no-orphans guarantee as the deadline path
             kill_process_group(proc)
             raise
+        finally:
+            self._clear_pgid()
         return subprocess.CompletedProcess(argv, proc.returncode)
+
+    def _write_pgid(self, proc: subprocess.Popen) -> None:
+        if not self.pgid_file:
+            return
+        try:
+            with open(self.pgid_file, "w") as f:
+                f.write(str(os.getpgid(proc.pid)))
+        except OSError:
+            pass  # best effort: supervision degrades, the run continues
+
+    def _clear_pgid(self) -> None:
+        if self.pgid_file:
+            try:
+                os.unlink(self.pgid_file)
+            except OSError:
+                pass
+
+
+def sweep_stale_pgid_files(storage_dir: str) -> int:
+    """Kill process groups whose ``phase.pgid`` breadcrumb outlived its
+    writer (the `run` process was hard-killed mid-phase, so its finally
+    never removed the file and never killed the group). Called by the
+    campaign supervisor after every attempt; returns how many groups
+    were swept. The pgid-recycling race is accepted: the supervisor
+    runs this immediately after the slot ends, and a recycled pgid
+    would have to land inside that window on a group id we just
+    created."""
+    swept = 0
+    try:
+        run_dirs = sorted(os.listdir(storage_dir))
+    except OSError:
+        return 0
+    for name in run_dirs:
+        path = os.path.join(storage_dir, name, "phase.pgid")
+        try:
+            with open(path) as f:
+                pgid = int(f.read().strip())
+        except (OSError, ValueError):
+            continue
+        try:
+            os.killpg(pgid, 0)
+        except (OSError, ProcessLookupError):
+            pass  # group already gone: just the breadcrumb to sweep
+        else:
+            log.warning("sweeping orphaned process group %d left by a "
+                        "hard-killed run (%s)", pgid, path)
+            try:
+                os.killpg(pgid, signal.SIGKILL)
+                swept += 1
+            except (OSError, ProcessLookupError):
+                pass
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    return swept

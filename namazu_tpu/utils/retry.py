@@ -38,6 +38,8 @@ def retry_call(
     rng: Optional[random.Random] = None,
     sleep: Callable[[float], None] = time.sleep,
     on_retry: Optional[Callable[[BaseException, int, float], None]] = None,
+    delay_hint: Optional[Callable[[BaseException],
+                                  Optional[float]]] = None,
 ) -> T:
     """Call ``fn`` up to ``attempts`` times, backing off between tries.
 
@@ -46,9 +48,18 @@ def retry_call(
     before each backoff sleep (logging hook). ``sleep`` is injectable so
     tests and interruptible callers (e.g. a transceiver whose stop event
     doubles as the sleeper) control the wait.
+
+    ``delay_hint(exc)`` lets the failure itself suggest the wait — a
+    server's ``Retry-After`` on a 429 (doc/robustness.md). A returned
+    hint replaces the drawn backoff: never LESS than the hint
+    (re-knocking early would burn an attempt on a refusal the server
+    already announced), jittered up to +25% so a whole fleet told
+    "come back in 1s" does not re-knock in one synchronized wave, and
+    capped at ``cap`` last; ``None`` keeps the normal backoff.
     """
     if attempts < 1:
         raise ValueError(f"attempts must be >= 1, got {attempts}")
+    rng = rng or random.Random()
     delays = backoff_delays(attempts - 1, base=base, cap=cap, rng=rng)
     attempt = 0
     while True:
@@ -60,6 +71,9 @@ def retry_call(
                 delay = next(delays)
             except StopIteration:
                 raise e from None
+            hint = delay_hint(e) if delay_hint is not None else None
+            if hint is not None and hint >= 0:
+                delay = min(cap, float(hint) * rng.uniform(1.0, 1.25))
             if on_retry is not None:
                 on_retry(e, attempt, delay)
             sleep(delay)

@@ -171,26 +171,31 @@ class ScheduledQueue:
                     wait = remaining if wait is None else min(wait, remaining)
                 self._cond.wait(wait)
 
-    def expedite(self, predicate) -> int:
+    def expedite(self, predicate, collect: bool = False):
         """Make every resident item with ``predicate(item)`` true ripe
         immediately (FIFO among themselves by sequence number); returns
-        how many were expedited. The liveness watchdog's lever: events
-        parked on behalf of an entity declared dead are released now so
-        their actions (and the trace) do not wait out delays nobody will
-        ever observe."""
+        how many were expedited — or, with ``collect``, the expedited
+        items themselves (enqueue order), so the caller can attribute
+        the forced release (the watchdog stamps each event's flight-
+        recorder decision with ``source="watchdog"``). The liveness
+        watchdog's lever: events parked on behalf of an entity declared
+        dead are released now so their actions (and the trace) do not
+        wait out delays nobody will ever observe."""
         with self._cond:
-            changed = 0
+            changed = []
             heap = []
             for (release, seq, put_ts, item) in self._heap:
                 if predicate(item):
                     release = 0.0
-                    changed += 1
+                    changed.append((seq, item))
                 heap.append((release, seq, put_ts, item))
             if changed:
                 self._heap = heap
                 heapq.heapify(self._heap)
                 self._cond.notify_all()
-            return changed
+            if collect:
+                return [item for _, item in sorted(changed)]
+            return len(changed)
 
     def reseed(self, seed: Optional[int]) -> None:
         """Reset the delay-sampling RNG (used when a policy's config sets a

@@ -606,3 +606,111 @@ def test_kill_process_group_helper(tmp_path):
     time.sleep(0.2)
     kill_process_group(proc, grace=1.0)
     assert proc.poll() is not None
+
+
+# -- orchestrator kill -9 mid-run (chaos injector) -----------------------
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_orchestrator_sigkill_mid_run_campaign_recovers(
+        tmp_path, monkeypatch):
+    """kill -9 of the orchestrator mid-run, injected deterministically
+    via the chaos plane (NMZ_CHAOS -> orchestrator.crash) instead of
+    ad-hoc monkeypatching: the campaign classifies the slot infra and
+    retries it, the storage ends up quarantined or journal-recoverable
+    (both legal), no testee process is orphaned (the phase.pgid sweep),
+    and the pre-crash events are sitting in the run's event journal."""
+    from namazu_tpu import chaos as chaos_mod
+    from namazu_tpu.campaign import Campaign, CampaignSpec, EXIT_OK
+    from namazu_tpu.chaos.journal import EventJournal
+    from namazu_tpu.cli import cli_main
+
+    port = _free_port()
+    materials = tmp_path / "materials"
+    materials.mkdir()
+    (materials / "post_events.py").write_text(
+        "import sys, time, urllib.request\n"
+        "from namazu_tpu.signal import PacketEvent\n"
+        "port = sys.argv[1]\n"
+        "for i in range(6):\n"
+        "    ev = PacketEvent.create('k9', 'k9', 'peer', hint=f'h{i}')\n"
+        "    url = (f'http://127.0.0.1:{port}/api/v3/events/k9/'\n"
+        "           f'{ev.uuid}')\n"
+        "    req = urllib.request.Request(\n"
+        "        url, data=ev.to_json().encode(),\n"
+        "        headers={'Content-Type': 'application/json'},\n"
+        "        method='POST')\n"
+        "    for _ in range(30):\n"
+        "        try:\n"
+        "            urllib.request.urlopen(req, timeout=5)\n"
+        "            break\n"
+        "        except Exception:\n"
+        "            time.sleep(0.1)\n")
+    config = tmp_path / "config.toml"
+    config.write_text(
+        'explore_policy = "dumb"\n'
+        f'rest_port = {port}\n'
+        'event_journal = true\n'
+        'run = """sleep 300 & echo $! > "$NMZ_WORKING_DIR/orphan.pid"; '
+        'PALLAS_AXON_POOL_IPS= python '
+        f'"$NMZ_MATERIALS_DIR/post_events.py" {port}; sleep 300"""\n'
+        'validate = "true"\n'
+    )
+    storage = str(tmp_path / "st")
+    assert cli_main(["init", str(config), str(materials), storage]) == 0
+
+    # the third event-loop batch SIGKILLs the orchestrator (run child)
+    monkeypatch.setenv(chaos_mod.ENV_VAR, chaos_mod.env_value(
+        1, {"orchestrator.crash": {"at": [2]}}))
+    spec = CampaignSpec(storage_dir=storage, runs=1, retries=1,
+                        run_wall_deadline_s=120, run_deadline_s=60,
+                        backoff_base_s=0.05, backoff_cap_s=0.1, seed=1,
+                        max_consecutive_infra=5)
+    rc = Campaign(spec).run(resume=False)
+    assert rc == EXIT_OK  # budget spent; the stop rule did not trip
+
+    from namazu_tpu.campaign import load_checkpoint
+    slots = load_checkpoint(storage)["slots"]
+    assert len(slots) == 1
+    # classified infra (signal death) and retried to the budget
+    assert slots[0]["class"] == "infra"
+    assert len(slots[0]["attempts"]) == 2
+    assert all(a["exit_status"] == -9 for a in slots[0]["attempts"])
+
+    # no orphaned testee processes: the sweep killed the run script's
+    # session (which SIGKILL of the orchestrator had orphaned)
+    for i in (0, 1):
+        pid_file = os.path.join(storage, f"{i:08x}", "orphan.pid")
+        assert os.path.exists(pid_file), "run script never started"
+        with open(pid_file) as f:
+            orphan = int(f.read().strip())
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and _pid_alive(orphan):
+            time.sleep(0.1)
+        assert not _pid_alive(orphan)
+        # the pgid breadcrumb was consumed by the sweep
+        assert not os.path.exists(
+            os.path.join(storage, f"{i:08x}", "phase.pgid"))
+        # the pre-crash events survived in the journal: recoverable
+        journal = EventJournal(os.path.join(storage, f"{i:08x}"))
+        assert journal.exists()
+        assert len(journal.unreleased()) >= 1
+
+    # storage: quarantined or journal-recovered are both legal; after
+    # fsck --repair the storage must be clean
+    monkeypatch.delenv(chaos_mod.ENV_VAR)
+    st = load_storage(storage)
+    st.fsck(repair=True)
+    report = st.fsck()
+    assert report["incomplete_unmarked"] == []
+    assert report["tmp_artifacts"] == []
+    assert cli_main(["tools", "fsck", storage]) == 0
