@@ -552,11 +552,146 @@ def _scenario_knowledge(name: str, spec: dict, seed: int, workdir: str,
     return {"invariants": invariants, "fault_report": plan.report()}
 
 
+def _scenario_edge(name: str, spec: dict, seed: int, workdir: str,
+                   events: int,
+                   base_policy_param: Optional[dict] = None
+                   ) -> Dict[str, Any]:
+    """Zero-RTT dispatch under staleness (doc/performance.md): edge
+    transceivers decide against a published table while
+    ``table.publish.stale`` suppresses their re-syncs across a LIVE
+    mid-run rollover. Invariants: dispatch stays exactly-once (the
+    edge either decides locally or posts centrally — never both),
+    every record carries exactly ONE unambiguous ``table_version``
+    drawn from the published set, and the asynchronous backhaul
+    reconciles a COMPLETE flight-recorder trace — the stale window
+    changes provenance tags, never coverage."""
+    from namazu_tpu.inspector.rest_transceiver import RestTransceiver
+    from namazu_tpu.orchestrator import Orchestrator
+    from namazu_tpu.policy import create_policy
+    from namazu_tpu.signal.action import Action
+    from namazu_tpu.storage import new_storage
+
+    run_id = f"{name}-edge"
+    storage = new_storage("naive", os.path.join(workdir, "storage"))
+    storage.create()
+    storage.create_new_working_dir()
+    cfg = Config({
+        "rest_port": 0,
+        "run_id": run_id,
+        "explore_policy": "tpu_search",
+        "explore_policy_param": {
+            "search_on_start": False, "max_interval": 0, "seed": seed},
+    })
+    policy = create_policy("tpu_search")
+    policy.load_config(cfg)
+    policy.install_table([0.0] * policy.H, source="chaos")
+    versions = {policy.table_publisher.version}
+    orc = Orchestrator(cfg, policy, collect_trace=True)
+    orc.start()
+    port = orc.hub.endpoint("rest").port
+    plan = chaos.install(FaultPlan(seed, spec["faults"]))
+    entities = ["ent0", "ent1"]
+    txs = {}
+    posted: List[str] = []
+    waiters: Dict[str, Any] = {}
+    received: Dict[str, int] = {}
+    errors: List[str] = []
+    try:
+        for entity in entities:
+            tx = RestTransceiver(entity, f"http://127.0.0.1:{port}",
+                                 use_batch=True, flush_window=0.0,
+                                 poll_linger=0.005, edge=True,
+                                 backhaul_window=0.01)
+            tx.start()
+            if tx.sync_table() is None:
+                errors.append(f"{entity}: table sync failed")
+            txs[entity] = tx
+        for i in range(events):
+            if i == events // 2:
+                # the rollover the stale seam holds the edges against
+                policy.install_table([0.0] * policy.H,
+                                     source="chaos-rollover")
+                versions.add(policy.table_publisher.version)
+                time.sleep(0.05)  # let a backhaul reply piggyback it
+            for entity in entities:
+                ev = PacketEvent.create(entity, entity, "peer",
+                                        hint=f"h{i % 4}")
+                try:
+                    waiters[ev.uuid] = txs[entity].send_event(ev)
+                    posted.append(ev.uuid)
+                except Exception as e:
+                    errors.append(f"{ev.uuid}: {e}")
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and len(received) < len(posted):
+            for uuid, q in waiters.items():
+                if uuid not in received:
+                    try:
+                        q.get_nowait()
+                        received[uuid] = 1
+                    except Exception:
+                        pass
+            time.sleep(0.02)
+    finally:
+        # shutdown BEFORE clearing the plan: the final backhaul flush
+        # must reconcile even while the seam is still armed
+        for tx in txs.values():
+            tx.shutdown()
+        trace = orc.shutdown()
+        chaos.clear()
+        try:
+            storage.record_new_trace(trace)
+            storage.record_result(True, 0.1)
+        except Exception as e:
+            storage.quarantine_current_run(str(e))
+    run = obs.trace_run(run_id)
+    docs = ([entry["json"] for entry in run.snapshot()["records"]]
+            if run is not None else [])
+    by_uuid = {d["event"]: d for d in docs}
+    missing = [u for u in posted if u not in by_uuid
+               or "dispatched" not in (by_uuid[u].get("t") or {})]
+    bad_versions = [
+        u for u, d in by_uuid.items()
+        if (d.get("decision") or {}).get("decision_source") == "edge"
+        and (d.get("decision") or {}).get("table_version")
+        not in versions]
+    edge_decided = sum(
+        1 for d in docs
+        if (d.get("decision") or {}).get("decision_source") == "edge")
+    counts = collections.Counter(
+        a.event_uuid for a in trace
+        if isinstance(a, Action) and a.event_uuid)
+    doubles = {u: c for u, c in counts.items() if c > 1}
+    unanswered = [u for u in posted if u not in received]
+    invariants = {
+        "exactly_once": _inv(
+            not doubles and not unanswered and not errors
+            and set(counts) >= set(posted),
+            posted=len(posted), dispatched=len(counts),
+            doubles=doubles, unanswered=unanswered, errors=errors),
+        "trace_complete": _inv(
+            not missing and len(docs) >= len(posted),
+            records=len(docs), missing=missing),
+        "versions_unambiguous": _inv(
+            not bad_versions, published=sorted(versions),
+            bad=bad_versions),
+        # scenario validity: the seam actually held an edge stale, and
+        # the edge path actually decided events (not a silent central
+        # fallback pass)
+        "stale_window_exercised": _inv(
+            plan.fired("table.publish.stale") >= 1 and edge_decided > 0,
+            stale_fires=plan.fired("table.publish.stale"),
+            edge_decided=edge_decided),
+        "fsck_clean": _fsck_invariant(storage),
+    }
+    return {"invariants": invariants, "fault_report": plan.report()}
+
+
 _KINDS = {
     "pipeline": _scenario_pipeline,
     "storage": _scenario_storage,
     "knowledge": _scenario_knowledge,
     "crash": _scenario_crash,
+    "edge": _scenario_edge,
 }
 
 

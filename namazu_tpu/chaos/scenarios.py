@@ -20,6 +20,12 @@ it. Kinds:
   abandon + journal-recovering successor on the same port); invariant:
   every parked event is recovered and dispatched exactly once, proven
   by the flight-recorder uuid join across both incarnations.
+* ``edge`` — zero-RTT edge dispatch under staleness
+  (doc/performance.md): edges decide against a published delay table
+  while ``table.publish.stale`` pins them stale across a live
+  rollover; invariant: exactly-once dispatch, one unambiguous
+  ``table_version`` per record, and a complete backhaul-reconciled
+  trace.
 
 The specs keep each scenario to ONE fault family so the invariant
 arithmetic (e.g. ``lost == fired("wire.post.drop")``) stays exact.
@@ -99,13 +105,23 @@ SCENARIOS: Dict[str, dict] = {
                 "must dispatch each exactly once",
         "faults": {},
     },
+    "edge_stale": {
+        "kind": "edge",
+        "desc": "edges forced stale across a live table rollover; "
+                "dispatch must stay exactly-once, every record must "
+                "carry one unambiguous table_version, and the "
+                "backhaul must reconcile a complete trace",
+        "faults": {"table.publish.stale": {"prob": 1.0, "max_fires": 3}},
+    },
 }
 
-#: the CI smoke matrix — wire, endpoint, storage, knowledge, and crash
-#: fault families all covered (>= 6 scenarios per the acceptance bar)
+#: the CI smoke matrix — wire, endpoint, storage, knowledge, crash,
+#: and edge fault families all covered (>= 6 scenarios per the
+#: acceptance bar)
 DEFAULT_MATRIX: List[str] = [
     "wire_drop", "wire_dup", "wire_lost_reply", "wire_sever",
     "ingress_429", "storage_torn", "knowledge_outage", "crash_restart",
+    "edge_stale",
 ]
 
 

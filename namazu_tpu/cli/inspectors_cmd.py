@@ -74,10 +74,17 @@ def register(sub) -> None:
 
 def _common_flags(p) -> None:
     p.add_argument("--orchestrator-url", default="local://",
-                   help="local:// (autopilot) or http://host:port")
+                   help="local:// (autopilot), http://host:port, or "
+                        "uds:///path/to.sock (same-host framed wire)")
     p.add_argument("--entity-id", default=None)
     p.add_argument("--autopilot", default=None,
                    help="config file for the embedded autopilot orchestrator")
+    p.add_argument("--edge", action="store_true",
+                   help="zero-RTT edge dispatch (doc/performance.md): "
+                        "decide deferred events locally against the "
+                        "orchestrator's published delay table, with "
+                        "asynchronous trace backhaul; falls back to "
+                        "the central wire until a table is published")
 
 
 def _make_transceiver(args, default_entity: str):
@@ -97,7 +104,8 @@ def _make_transceiver(args, default_entity: str):
         orc.start()
         trans = new_transceiver(url, entity, orc.local_endpoint)
         return trans, orc
-    return new_transceiver(url, entity), None
+    return new_transceiver(url, entity,
+                           edge=bool(getattr(args, "edge", False))), None
 
 
 def run_proc(args) -> int:

@@ -51,6 +51,12 @@ class EndpointHub:
     def __init__(self) -> None:
         self.event_queue: "queue.Queue[Event]" = queue.Queue()
         self.control_queue: "queue.Queue[Control]" = queue.Queue()
+        # the zero-RTT dispatch plane's table source (policy/
+        # edge_table.py TablePublisher), attached by the orchestrator
+        # when its policy publishes one; None = no table plane (non-
+        # table policies) — endpoints then advertise no version and
+        # serve no table
+        self.table_publisher = None
         self._endpoints: Dict[str, Endpoint] = {}
         self._entity_route: Dict[str, str] = {}
         # liveness bookkeeping for the orchestrator's watchdog: monotonic
@@ -121,6 +127,56 @@ class EndpointHub:
             obs.record_intercepted(event, endpoint_name)
             self.event_queue.put(event)
         obs.event_batch("ingress", len(events))
+
+    def post_edge_backhaul(self, items, endpoint_name: str) -> None:
+        """Asynchronous backhaul of edge-decided events
+        (doc/performance.md "Zero-RTT dispatch"): ``items`` is a list
+        of ``(event, decision)`` pairs the edge already dispatched
+        against a published table. Routing/liveness bookkeeping is
+        identical to :meth:`post_events` (an edge entity's backhaul
+        keeps its watchdog liveness fresh), the lifecycle stamps come
+        from the EDGE's clocks (same host, shared CLOCK_MONOTONIC), and
+        the tagged events ride the normal event queue so the
+        orchestrator's single event loop reconciles them — recorder,
+        analytics, and the collected trace see exactly what a central
+        run records, modulo the ``decision_source="edge"`` tag."""
+        if not items:
+            return
+        with self._lock:
+            for event, _ in items:
+                self._note_inbound(event, endpoint_name)
+        per_entity: Dict[str, int] = {}
+        put = self.event_queue.put
+        for event, decision in items:
+            event.mark_arrived(now=decision.get("arrived_wall"))
+            per_entity[event.entity_id] = \
+                per_entity.get(event.entity_id, 0) + 1
+            # the tag the orchestrator's event loop partitions on: an
+            # edge-decided event must never reach the policy (it was
+            # already decided AND dispatched at the edge). The full
+            # recorder write (obs.record_edge) happens there too, in
+            # ONE pass per event — not stage-by-stage here.
+            event._edge_decision = decision
+            event._edge_endpoint = endpoint_name
+            put(event)
+        for entity, n in per_entity.items():
+            obs.event_intercepted(endpoint_name, entity, n)
+            obs.edge_decision(entity, n)
+        obs.event_batch("backhaul", len(items))
+
+    # -- zero-RTT table plane (doc/performance.md) ----------------------
+
+    def table_version(self) -> Optional[int]:
+        """The published table's current version, None when this hub
+        has no table plane at all."""
+        pub = self.table_publisher
+        return None if pub is None else pub.version
+
+    def table_doc(self):
+        """``(version, doc_or_None)`` of the published table; (0, None)
+        without a table plane."""
+        pub = self.table_publisher
+        return (0, None) if pub is None else pub.current()
 
     def post_control(self, control: Control) -> None:
         self.control_queue.put(control)

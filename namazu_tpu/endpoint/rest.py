@@ -70,10 +70,20 @@ log = get_logger("endpoint.rest")
 
 API_ROOT = "/api/v3"
 
+#: the version piggyback header on batch POST / batch poll responses —
+#: how an edge notices a table rollover within one batch
+#: (doc/performance.md "Zero-RTT dispatch"); re-exported here so wire
+#: code has one import site, defined next to the publisher it serves
+from namazu_tpu.policy.edge_table import (  # noqa: F401  (re-export)
+    TABLE_VERSION_HEADER,
+)
+
 _EVENTS_RE = re.compile(rf"^{API_ROOT}/events/([^/]+)/([^/]+)$")
 _EVENTS_BATCH_RE = re.compile(rf"^{API_ROOT}/events/([^/]+)/batch$")
+_EVENTS_BACKHAUL_RE = re.compile(rf"^{API_ROOT}/events/([^/]+)/backhaul$")
 _ACTIONS_RE = re.compile(rf"^{API_ROOT}/actions/([^/]+)(?:/([^/]+))?$")
 _CONTROL_RE = re.compile(rf"^{API_ROOT}/control$")
+_POLICY_TABLE_RE = re.compile(rf"^{API_ROOT}/policy/table$")
 _TRACES_RE = re.compile(r"^/traces(?:/([^/]+))?$")
 
 
@@ -198,6 +208,142 @@ class ActionQueue:
             return len(self._items)
 
 
+class QueuedEndpoint(Endpoint):
+    """Shared machinery for endpoints built around per-entity
+    :class:`ActionQueue` instances and an inbound-uuid dedupe ring —
+    the REST wire and the ``uds://`` framed wire (endpoint/uds.py)
+    carry the same batch/ack/backhaul semantics over different
+    transports, so the queue fan-through, the idempotency ring, and
+    the edge-backhaul ingestion live here once."""
+
+    _SEEN_EVENT_CAP = 4096
+    #: backhaul uuids get their OWN (larger) ring: the zero-RTT path
+    #: runs ~50x the central wire's rate, and sharing one ring would
+    #: let a few tens of milliseconds of backhaul evict a central
+    #: retry's uuid before its >=0.5s backoff replays it — doubling the
+    #: event the ring exists to dedupe. The two populations never
+    #: overlap (an event is either edge-decided or centrally posted),
+    #: so splitting them loses nothing.
+    _SEEN_BACKHAUL_CAP = 65536
+
+    def __init__(self) -> None:
+        self._queues: Dict[str, ActionQueue] = {}
+        self._queues_lock = threading.Lock()
+        # event-uuid dedup ring: the transceiver retries a POST whose
+        # ack was lost in flight (doc/robustness.md), so an uuid seen
+        # twice means the first attempt already reached the hub — ack
+        # without re-posting, or one network blip doubles an event in
+        # the trace. Bounded: uuids are unique per event, so a small
+        # recent window is enough to cover the retry horizon.
+        self._seen_event_uuids: "OrderedDict[str, None]" = OrderedDict()
+        self._seen_backhaul_uuids: "OrderedDict[str, None]" = \
+            OrderedDict()
+        self._seen_lock = threading.Lock()
+
+    def note_event_uuid(self, uuid: str) -> bool:
+        """Record an inbound event uuid; True if it was already seen
+        (i.e. this POST is a retry duplicate)."""
+        with self._seen_lock:
+            if uuid in self._seen_event_uuids:
+                return True
+            self._seen_event_uuids[uuid] = None
+            while len(self._seen_event_uuids) > self._SEEN_EVENT_CAP:
+                self._seen_event_uuids.popitem(last=False)
+            return False
+
+    def note_backhaul_uuid(self, uuid: str) -> bool:
+        """The backhaul face of the ring (separate population + cap —
+        see _SEEN_BACKHAUL_CAP)."""
+        with self._seen_lock:
+            if uuid in self._seen_backhaul_uuids:
+                return True
+            self._seen_backhaul_uuids[uuid] = None
+            while len(self._seen_backhaul_uuids) \
+                    > self._SEEN_BACKHAUL_CAP:
+                self._seen_backhaul_uuids.popitem(last=False)
+            return False
+
+    # -- action dispatch -------------------------------------------------
+
+    def _queue_for(self, entity: str) -> ActionQueue:
+        with self._queues_lock:
+            q = self._queues.get(entity)
+            if q is None:
+                q = self._queues[entity] = ActionQueue()
+            return q
+
+    def send_action(self, action: Action) -> None:
+        self._queue_for(action.entity_id).put(action)
+
+    def send_actions(self, actions: List[Action]) -> None:
+        """Batch fan-through: group by entity (order preserved within
+        each), resolve every queue under ONE ``_queues_lock``
+        acquisition, then one ``put_many`` (one queue lock + one
+        wakeup) per entity — instead of lock/unlock churn per action."""
+        if len(actions) == 1:
+            return self.send_action(actions[0])
+        by_entity: Dict[str, List[Action]] = {}
+        for action in actions:
+            by_entity.setdefault(action.entity_id, []).append(action)
+        with self._queues_lock:
+            queues = {}
+            for entity in by_entity:
+                q = self._queues.get(entity)
+                if q is None:
+                    q = self._queues[entity] = ActionQueue()
+                queues[entity] = q
+        for entity, batch in by_entity.items():
+            queues[entity].put_many(batch)
+
+    def ack_action(self, entity: str, action: Action) -> None:
+        """Observability for one acknowledged (delivered) action."""
+        obs.mark(action, "acked")
+        obs.record_acked(action)
+        obs.rest_ack(entity, obs.latency(action, "dispatched"))
+
+    # -- zero-RTT edge backhaul (doc/performance.md) ---------------------
+
+    def ingest_backhaul(self, doc, entity: str):
+        """Decode + dedupe one backhaul request body
+        (``{"items": [{"event": ..., "decision": ...}, ...]}``) and
+        reconcile the fresh items into the hub. Returns
+        ``(accepted, duplicates)``; raises ValueError on a malformed
+        body — like the batch POST route, validation is atomic (the
+        client retries the whole chunk, the dedupe ring absorbs the
+        replay of already-accepted uuids)."""
+        items = doc.get("items") if isinstance(doc, dict) else None
+        if not isinstance(items, list) or not items:
+            raise ValueError(
+                "backhaul body must be {\"items\": [{\"event\": ..., "
+                "\"decision\": ...}, ...]}")
+        pairs = []
+        for i, item in enumerate(items):
+            if not isinstance(item, dict):
+                raise ValueError(f"backhaul item {i} is not an object")
+            try:
+                sig = signal_from_jsonable(item.get("event"))
+            except (SignalError, ValueError, TypeError) as e:
+                raise ValueError(f"backhaul item {i}: {e}") from e
+            if not isinstance(sig, Event):
+                raise ValueError(f"backhaul item {i} is not an event")
+            if sig.entity_id != entity:
+                raise ValueError(
+                    f"backhaul item {i} entity {sig.entity_id!r} does "
+                    f"not match url entity {entity!r}")
+            decision = item.get("decision")
+            if not isinstance(decision, dict) \
+                    or "table_version" not in decision:
+                raise ValueError(
+                    f"backhaul item {i} carries no decision/"
+                    "table_version")
+            pairs.append((sig, decision))
+        fresh = [(ev, d) for ev, d in pairs
+                 if not self.note_backhaul_uuid(ev.uuid)]
+        if fresh:
+            self.hub.post_edge_backhaul(fresh, self.NAME)
+        return len(fresh), len(pairs) - len(fresh)
+
+
 class _TrackingHTTPServer(ThreadingHTTPServer):
     """ThreadingHTTPServer that knows its open connections, so a
     simulated crash (`Orchestrator.abandon`, the chaos harness's
@@ -232,12 +378,13 @@ class _TrackingHTTPServer(ThreadingHTTPServer):
         return len(socks)
 
 
-class RestEndpoint(Endpoint):
+class RestEndpoint(QueuedEndpoint):
     NAME = "rest"
 
     def __init__(self, port: int = 10080, host: str = "127.0.0.1",
                  poll_timeout: float = 30.0, ingress_cap: int = 0,
                  retry_after_s: float = 1.0):
+        super().__init__()
         self._host = host
         self._port = port
         self.poll_timeout = poll_timeout
@@ -248,32 +395,9 @@ class RestEndpoint(Endpoint):
         # 0 = unbounded (the pre-backpressure behavior).
         self.ingress_cap = max(0, int(ingress_cap))
         self.retry_after_s = max(0.0, float(retry_after_s))
-        self._queues: Dict[str, ActionQueue] = {}
-        self._queues_lock = threading.Lock()
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_mono = time.monotonic()  # /healthz uptime anchor
-        # event-POST dedup ring: the transceiver retries a POST whose
-        # 200 was lost in flight (doc/robustness.md), so an uuid seen
-        # twice means the first attempt already reached the hub — ack
-        # without re-posting, or one network blip doubles an event in
-        # the trace. Bounded: uuids are unique per event, so a small
-        # recent window is enough to cover the retry horizon.
-        self._seen_event_uuids: "OrderedDict[str, None]" = OrderedDict()
-        self._seen_lock = threading.Lock()
-
-    _SEEN_EVENT_CAP = 4096
-
-    def note_event_uuid(self, uuid: str) -> bool:
-        """Record an inbound event uuid; True if it was already seen
-        (i.e. this POST is a retry duplicate)."""
-        with self._seen_lock:
-            if uuid in self._seen_event_uuids:
-                return True
-            self._seen_event_uuids[uuid] = None
-            while len(self._seen_event_uuids) > self._SEEN_EVENT_CAP:
-                self._seen_event_uuids.popitem(last=False)
-            return False
 
     # -- lifecycle -------------------------------------------------------
 
@@ -354,11 +478,24 @@ class RestEndpoint(Endpoint):
                 length = int(self.headers.get("Content-Length") or 0)
                 return self.rfile.read(length) if length else b""
 
+            def _tv_headers(self) -> Dict[str, str]:
+                """The table-version piggyback (zero-RTT dispatch):
+                present on batch POST / batch poll / backhaul replies
+                whenever this hub has a table plane — the one signal an
+                edge needs to notice a rollover within one batch."""
+                version = endpoint.hub.table_version()
+                if version is None:
+                    return {}
+                return {TABLE_VERSION_HEADER: str(version)}
+
             def do_POST(self) -> None:
                 url = urlparse(self.path)
                 m = _EVENTS_BATCH_RE.match(url.path)
                 if m:
                     return self._post_event_batch(m.group(1))
+                m = _EVENTS_BACKHAUL_RE.match(url.path)
+                if m:
+                    return self._post_event_backhaul(m.group(1))
                 m = _EVENTS_RE.match(url.path)
                 if m:
                     return self._post_event(m.group(1), m.group(2))
@@ -441,7 +578,32 @@ class RestEndpoint(Endpoint):
                 if fresh:
                     endpoint.hub.post_events(fresh, endpoint.NAME)
                 self._reply(200, {"accepted": len(fresh),
-                                  "duplicates": len(events) - len(fresh)})
+                                  "duplicates": len(events) - len(fresh)},
+                            headers=self._tv_headers())
+
+            def _post_event_backhaul(self, entity: str) -> None:
+                """Asynchronous backhaul of edge-decided events
+                (doc/performance.md "Zero-RTT dispatch"): the edge
+                already dispatched these against a published table;
+                this request reconciles their trace records + decision
+                detail into the orchestrator. The reply always carries
+                the server's current ``table_version`` so a stale edge
+                learns of a rollover from its own backhaul."""
+                try:
+                    raw = self._read_body()  # always drain (keep-alive)
+                except ValueError as e:
+                    return self._reply(400, {"error": str(e)})
+                if self._ingress_refused():
+                    return
+                try:
+                    accepted, duplicates = endpoint.ingest_backhaul(
+                        json.loads(raw), entity)
+                except ValueError as e:
+                    return self._reply(400, {"error": str(e)})
+                self._reply(200, {
+                    "accepted": accepted, "duplicates": duplicates,
+                    "table_version": endpoint.hub.table_version() or 0,
+                }, headers=self._tv_headers())
 
             def _post_control(self, query: Dict[str, list]) -> None:
                 ops = query.get("op") or []
@@ -474,6 +636,8 @@ class RestEndpoint(Endpoint):
                     })
                 if url.path == "/analytics":
                     return self._get_analytics(parse_qs(url.query))
+                if _POLICY_TABLE_RE.match(url.path):
+                    return self._get_policy_table()
                 m = _TRACES_RE.match(url.path)
                 if m:
                     return self._get_traces(m.group(1), parse_qs(url.query))
@@ -517,10 +681,25 @@ class RestEndpoint(Endpoint):
                 actions = endpoint._queue_for(entity).peek_batch(
                     max_n, endpoint.poll_timeout, linger=linger)
                 if not actions:
-                    return self._reply(204)
+                    return self._reply(204, headers=self._tv_headers())
                 obs.event_batch("actions_poll", len(actions))
                 self._reply(200, {"actions": [a.to_jsonable()
-                                              for a in actions]})
+                                              for a in actions]},
+                            headers=self._tv_headers())
+
+            def _get_policy_table(self) -> None:
+                """The published hash->delay table (zero-RTT dispatch):
+                200 + the versioned doc when one is publishable, 204
+                (with the version header) when the current version has
+                no table — non-table policies, cold start, fault-
+                bearing installs, disabled orchestration."""
+                version, doc = endpoint.hub.table_doc()
+                headers = ({TABLE_VERSION_HEADER: str(version)}
+                           if endpoint.hub.table_publisher is not None
+                           else {})
+                if doc is None:
+                    return self._reply(204, headers=headers)
+                self._reply(200, doc, headers=headers)
 
             def _get_analytics(self, query) -> None:
                 """Experiment-analytics surface (obs/analytics.py): the
@@ -595,9 +774,7 @@ class RestEndpoint(Endpoint):
                     self._reply(404, {"error": f"no action {uuid} for {entity}"})
 
             def _ack(self, entity: str, action: Action) -> None:
-                obs.mark(action, "acked")
-                obs.record_acked(action)
-                obs.rest_ack(entity, obs.latency(action, "dispatched"))
+                endpoint.ack_action(entity, action)
 
             def _delete_batch(self, entity: str) -> None:
                 """Multi-uuid acknowledge: ``{"uuids": [...]}`` in the
@@ -642,35 +819,3 @@ class RestEndpoint(Endpoint):
         if self._server is None:
             return 0
         return self._server.sever_connections()
-
-    # -- action dispatch -------------------------------------------------
-
-    def _queue_for(self, entity: str) -> ActionQueue:
-        with self._queues_lock:
-            q = self._queues.get(entity)
-            if q is None:
-                q = self._queues[entity] = ActionQueue()
-            return q
-
-    def send_action(self, action: Action) -> None:
-        self._queue_for(action.entity_id).put(action)
-
-    def send_actions(self, actions: List[Action]) -> None:
-        """Batch fan-through: group by entity (order preserved within
-        each), resolve every queue under ONE ``_queues_lock``
-        acquisition, then one ``put_many`` (one queue lock + one
-        wakeup) per entity — instead of lock/unlock churn per action."""
-        if len(actions) == 1:
-            return self.send_action(actions[0])
-        by_entity: Dict[str, List[Action]] = {}
-        for action in actions:
-            by_entity.setdefault(action.entity_id, []).append(action)
-        with self._queues_lock:
-            queues = {}
-            for entity in by_entity:
-                q = self._queues.get(entity)
-                if q is None:
-                    q = self._queues[entity] = ActionQueue()
-                queues[entity] = q
-        for entity, batch in by_entity.items():
-            queues[entity].put_many(batch)

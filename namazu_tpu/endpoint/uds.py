@@ -1,0 +1,345 @@
+"""UDS endpoint: framed JSON over AF_UNIX for same-host inspectors.
+
+The ``uds://`` wire (doc/performance.md "Zero-RTT dispatch"): same
+batch/ack/backhaul semantics as the REST endpoint, but spoken as
+length-prefixed JSON frames (``uint32-LE length + UTF-8 JSON`` — the
+codec the guest-agent endpoint and the sidecar already use,
+endpoint/agent.py) over a Unix domain socket. No HTTP parse, no
+request-line/header overhead, no TCP handshake — for a same-host
+inspector the per-request cost is one frame each way on a persistent
+connection.
+
+Ops (one request frame -> one response frame, any number per
+connection; every response carries ``table_version`` when the hub has
+a table plane, the piggyback an edge needs to notice a rollover):
+
+* ``{"op": "post_batch", "entity": e, "events": [...]}``
+  -> ``{"ok": true, "accepted": N, "duplicates": M}``
+  (validated atomically like the REST batch route; uuids ride the
+  shared dedupe ring, so a replayed batch acks idempotently)
+* ``{"op": "poll", "entity": e, "batch": N, "linger_ms": L,
+  "timeout_s": T}`` -> ``{"ok": true, "actions": [...]}``
+  (long-poll; empty ``actions`` = timeout, not an error)
+* ``{"op": "ack", "entity": e, "uuids": [...]}``
+  -> ``{"ok": true, "deleted": [...], "missing": [...]}``
+* ``{"op": "backhaul", "entity": e, "items": [...]}``
+  -> ``{"ok": true, "accepted": N, "duplicates": M}``
+* ``{"op": "table"}`` -> ``{"ok": true, "version": V,
+  "table": doc_or_null}``
+
+Connection model mirrors the REST transceiver's: the client holds one
+connection for the outbound ops and one owned by its receive thread
+(a parked ``poll`` must never block a ``post_batch``). Each server
+connection gets its own handler thread — long-polling requires one
+anyway.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import stat
+import threading
+from typing import List, Optional, Set
+
+from namazu_tpu import obs
+from namazu_tpu.endpoint.agent import read_frame, write_frame
+from namazu_tpu.endpoint.rest import QueuedEndpoint
+from namazu_tpu.signal.base import SignalError, signal_from_jsonable
+from namazu_tpu.signal.event import Event
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("endpoint.uds")
+
+
+class UdsEndpoint(QueuedEndpoint):
+    NAME = "uds"
+
+    def __init__(self, path: str, poll_timeout: float = 30.0,
+                 ingress_cap: int = 0, retry_after_s: float = 1.0):
+        super().__init__()
+        self.path = path
+        self.poll_timeout = poll_timeout
+        # bounded ingress, same contract as the REST endpoint
+        # (doc/robustness.md): over-cap post/backhaul ops are refused
+        # with a retry_after hint instead of growing the hub queue
+        # unboundedly. 0 = unbounded.
+        self.ingress_cap = max(0, int(ingress_cap))
+        self.retry_after_s = max(0.0, float(retry_after_s))
+        self._server: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: Set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+        self._stop = threading.Event()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._server is not None:
+            return
+        self._reclaim_stale_socket()
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(self.path)
+        srv.listen(64)
+        self._server = srv
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="uds-endpoint", daemon=True)
+        self._accept_thread.start()
+        log.info("UDS endpoint on %s", self.path)
+
+    def _reclaim_stale_socket(self) -> None:
+        """A socket inode left by a dead predecessor would EADDRINUSE
+        the bind. Unlink ONLY a socket with no live listener behind it:
+        a probe connect that succeeds means another orchestrator is
+        serving this path, and stealing it would silently split the
+        entity's event stream across two servers. Anything that is not
+        a socket (regular file, directory, FIFO) is never clobbered —
+        the bind fails loudly instead."""
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            return  # nothing there
+        if not stat.S_ISSOCK(st.st_mode):
+            return
+        probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            probe.settimeout(0.2)
+            try:
+                probe.connect(self.path)
+            except OSError:
+                # no listener: stale — reclaim the path
+                try:
+                    os.unlink(self.path)
+                except OSError:
+                    pass
+                return
+        finally:
+            try:
+                probe.close()
+            except OSError:
+                pass
+        raise RuntimeError(
+            f"uds path {self.path!r} already has a live listener "
+            "(another orchestrator?); refusing to take it over")
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        srv, self._server = self._server, None
+        if srv is not None:
+            try:
+                srv.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    def sever(self) -> int:
+        """Cut every live connection (simulated crash, like
+        RestEndpoint.sever): a parked client poll must error and
+        reconnect, not keep talking to a dead orchestrator."""
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        return len(conns)
+
+    # -- connection handling ---------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            srv = self._server
+            if srv is None:
+                return
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return  # closed by shutdown
+            with self._conns_lock:
+                self._conns.add(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name="uds-conn", daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    req = read_frame(conn)
+                except (SignalError, ValueError, OSError):
+                    # oversized frame, malformed JSON from a desynced
+                    # client, or a socket error: drop the connection
+                    # cleanly (same set the client-side _FramedConn
+                    # treats as connection-fatal)
+                    break
+                if req is None:
+                    break  # EOF
+                if not isinstance(req, dict):
+                    # valid JSON but not an op object: answer (the
+                    # framed stream stays in sync) instead of letting
+                    # _handle's AttributeError escape the handler
+                    try:
+                        write_frame(conn, {"ok": False,
+                                           "error": "frame must be a "
+                                                    "JSON object"})
+                    except OSError:
+                        break
+                    continue
+                try:
+                    resp = self._handle(req)
+                except Exception as e:  # a handler bug must answer,
+                    # not silently desync the framed stream
+                    log.exception("uds op failed: %r", req.get("op"))
+                    resp = {"ok": False, "error": repr(e)}
+                version = self.hub.table_version() \
+                    if getattr(self, "hub", None) is not None else None
+                if version is not None:
+                    resp.setdefault("table_version", version)
+                try:
+                    write_frame(conn, resp)
+                except OSError:
+                    break
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- ops --------------------------------------------------------------
+
+    def _handle(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "post_batch":
+            return self._op_post_batch(req)
+        if op == "poll":
+            return self._op_poll(req)
+        if op == "ack":
+            return self._op_ack(req)
+        if op == "backhaul":
+            return self._op_backhaul(req)
+        if op == "table":
+            return self._op_table()
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _ingress_refusal(self) -> Optional[dict]:
+        """The uds face of the bounded-ingress plane: consult the chaos
+        seam, then the cap; a refusal doc mirrors the REST 429 +
+        Retry-After contract (``transient`` tells the transceiver's
+        bounded retry to honor ``retry_after`` instead of treating it
+        as a hard error)."""
+        from namazu_tpu import chaos
+
+        fault = chaos.decide("endpoint.ingress.refuse")
+        if fault is not None:
+            retry_after = float(fault.get("retry_after", 0.05))
+            obs.ingress_rejected(self.NAME, "chaos")
+            return {"ok": False, "transient": True,
+                    "retry_after": retry_after,
+                    "error": f"ingress refused (chaos); retry after "
+                             f"{retry_after:g}s"}
+        cap = self.ingress_cap
+        if cap > 0 and self.hub.event_queue.qsize() >= cap:
+            obs.ingress_rejected(self.NAME, "backpressure")
+            return {"ok": False, "transient": True,
+                    "retry_after": self.retry_after_s,
+                    "error": f"ingress refused (backpressure); retry "
+                             f"after {self.retry_after_s:g}s"}
+        return None
+
+    def _op_post_batch(self, req: dict) -> dict:
+        entity = str(req.get("entity") or "")
+        body = req.get("events")
+        if not entity or not isinstance(body, list) or not body:
+            return {"ok": False,
+                    "error": "post_batch needs entity + a non-empty "
+                             "events array"}
+        refusal = self._ingress_refusal()
+        if refusal is not None:
+            return refusal
+        events: List[Event] = []
+        for i, item in enumerate(body):
+            try:
+                sig = signal_from_jsonable(item)
+            except (SignalError, ValueError, TypeError) as e:
+                return {"ok": False, "error": f"batch item {i}: {e}"}
+            if not isinstance(sig, Event):
+                return {"ok": False,
+                        "error": f"batch item {i} is not an event"}
+            if sig.entity_id != entity:
+                return {"ok": False,
+                        "error": f"batch item {i} entity "
+                                 f"{sig.entity_id!r} does not match "
+                                 f"{entity!r}"}
+            events.append(sig)
+        fresh = [ev for ev in events if not self.note_event_uuid(ev.uuid)]
+        if fresh:
+            self.hub.post_events(fresh, self.NAME)
+        return {"ok": True, "accepted": len(fresh),
+                "duplicates": len(events) - len(fresh)}
+
+    def _op_poll(self, req: dict) -> dict:
+        entity = str(req.get("entity") or "")
+        if not entity:
+            return {"ok": False, "error": "poll needs entity"}
+        try:
+            batch = max(1, int(req.get("batch", 1)))
+            linger = min(max(0.0, float(req.get("linger_ms", 0))),
+                         1000.0) / 1000.0
+            timeout = min(max(0.0, float(req.get("timeout_s",
+                                                 self.poll_timeout))),
+                          self.poll_timeout)
+        except (TypeError, ValueError) as e:
+            return {"ok": False, "error": f"bad poll params: {e}"}
+        actions = self._queue_for(entity).peek_batch(
+            batch, timeout, linger=linger)
+        if actions:
+            obs.event_batch("actions_poll", len(actions))
+        return {"ok": True,
+                "actions": [a.to_jsonable() for a in actions]}
+
+    def _op_ack(self, req: dict) -> dict:
+        entity = str(req.get("entity") or "")
+        uuids = req.get("uuids")
+        if (not entity or not isinstance(uuids, list) or not uuids
+                or not all(isinstance(u, str) for u in uuids)):
+            return {"ok": False,
+                    "error": "ack needs entity + a uuids array"}
+        deleted, missing = self._queue_for(entity).delete_many(uuids)
+        for action in deleted:
+            self.ack_action(entity, action)
+        return {"ok": True, "deleted": [a.uuid for a in deleted],
+                "missing": missing}
+
+    def _op_backhaul(self, req: dict) -> dict:
+        entity = str(req.get("entity") or "")
+        if not entity:
+            return {"ok": False, "error": "backhaul needs entity"}
+        refusal = self._ingress_refusal()
+        if refusal is not None:
+            return refusal
+        try:
+            accepted, duplicates = self.ingest_backhaul(req, entity)
+        except ValueError as e:
+            return {"ok": False, "error": str(e)}
+        return {"ok": True, "accepted": accepted,
+                "duplicates": duplicates}
+
+    def _op_table(self) -> dict:
+        version, doc = self.hub.table_doc()
+        return {"ok": True, "version": version, "table": doc}

@@ -1,0 +1,540 @@
+"""Edge dispatch: decide and release events locally, backhaul the trace.
+
+The transceiver side of the zero-RTT path (doc/performance.md
+"Zero-RTT dispatch"). A transceiver holding a current published table
+(policy/edge_table.py) computes each deferred event's delay locally —
+``delays[fnv64a(hint) % H]``, bit-exact with the central
+``TPUSearchPolicy._delay_for`` — and hands the accepting action
+straight to the event's waiter, without a round trip to the
+orchestrator. What still flows centrally is **asynchronous backhaul**:
+the event plus its decision detail (``decision_source="edge"``,
+``table_version``, the delay, and the edge's own lifecycle stamps), so
+the flight recorder, analytics, failure ingest, and the collected
+trace see exactly what they see today.
+
+Staleness protocol: every batch/poll/backhaul response piggybacks the
+server's current table version; :meth:`EdgeDispatcher.note_server_version`
+compares it against the held table and re-syncs on any mismatch —
+dropping the table FIRST (so concurrent senders fall back to the
+central wire immediately, loss-free) and then fetching the new doc. A
+stale edge therefore re-syncs within one batch, and every decision
+carries exactly the version of the table object that made it (never an
+ambiguous mix). The ``table.publish.stale`` chaos seam suppresses one
+re-sync so the invariant harness can prove dispatch stays exactly-once
+and the trace complete even while an edge runs stale.
+
+Backhaul durability: items stay buffered until a flush is acknowledged;
+a failed flush re-queues them at the buffer head and retries with
+backoff, and :meth:`shutdown` performs a final synchronous flush —
+pending backhaul records are never silently dropped at transceiver
+shutdown (mirroring the buffered-events-on-shutdown guarantee of the
+batched wire). Replayed backhaul whose ack was lost dedupes on the
+endpoint's uuid ring.
+
+Clock note: the edge stamps lifecycle times with ``time.monotonic()``
+/ ``time.time()`` in its own process. The edge path is for SAME-HOST
+inspectors (loopback REST, the ``uds://`` wire), where
+``CLOCK_MONOTONIC`` is system-wide — the orchestrator's recorder can
+merge edge stamps with its own on one axis.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from namazu_tpu import chaos
+from namazu_tpu.obs import spans as _spans
+from namazu_tpu.policy.replayable import fnv64a
+from namazu_tpu.signal.action import EventAcceptanceAction
+from namazu_tpu.signal.base import fast_uuid4
+from namazu_tpu.signal.event import Event
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("inspector.edge")
+
+_new = object.__new__
+
+
+class EdgeTable:
+    """One immutable published table (policy/edge_table.py doc) plus a
+    bounded hint->delay memo — hints repeat heavily (they ARE the
+    semantic identity), so the fnv64a pass runs once per distinct hint
+    instead of once per event."""
+
+    __slots__ = ("version", "H", "delays", "max_interval", "_memo")
+
+    #: memo bound; a hint space past this is re-hashed (cleared whole —
+    #: eviction bookkeeping would cost more than the hash it saves)
+    MEMO_CAP = 4096
+
+    def __init__(self, doc: Dict[str, Any]) -> None:
+        if doc.get("mode") != "delay":
+            raise ValueError(f"unsupported table mode {doc.get('mode')!r}")
+        self.version = int(doc["version"])
+        self.H = int(doc["H"])
+        self.delays = [float(x) for x in doc["delays"]]
+        if self.H <= 0 or len(self.delays) != self.H:
+            raise ValueError(
+                f"table has {len(self.delays)} delays for H={self.H}")
+        self.max_interval = float(doc.get("max_interval", 0.0))
+        self._memo: Dict[str, float] = {}
+
+    def delay_for(self, hint: str) -> float:
+        delay = self._memo.get(hint)
+        if delay is None:
+            if len(self._memo) >= self.MEMO_CAP:
+                self._memo.clear()
+            delay = self.delays[fnv64a(hint.encode()) % self.H]
+            self._memo[hint] = delay
+        return delay
+
+
+class EdgeDispatcher:
+    """The per-transceiver edge engine: local decide + paced release +
+    buffered backhaul + version sync. Wire-agnostic — the owning
+    transceiver provides three callbacks:
+
+    * ``deliver(action)`` — hand the accepting action to the waiter
+      (``Transceiver.dispatch_action``);
+    * ``fetch_table() -> (version, doc_or_None)`` — one table fetch
+      over the owning wire;
+    * ``send_backhaul(entity, items) -> server_version`` — POST one
+      backhaul chunk; raises on failure (items are re-queued).
+    """
+
+    #: backhaul chunk cap per request
+    BACKHAUL_MAX = 512
+
+    def __init__(self, entity_id: str,
+                 deliver: Callable[[Any], None],
+                 fetch_table: Callable[[], Tuple[int, Optional[dict]]],
+                 send_backhaul: Callable[[str, List[dict]], Optional[int]],
+                 backhaul_window: float = 0.05,
+                 backhaul_max: Optional[int] = None,
+                 deliver_many: Optional[Callable[[list], None]] = None
+                 ) -> None:
+        self.entity_id = entity_id
+        self._deliver = deliver
+        self._deliver_many = deliver_many
+        self._fetch_table = fetch_table
+        self._send_backhaul = send_backhaul
+        self.backhaul_window = max(0.0, float(backhaul_window))
+        self.backhaul_max = int(backhaul_max or self.BACKHAUL_MAX)
+        self._table: Optional[EdgeTable] = None
+        #: server version for which a fetch returned no doc (withdrawn/
+        #: suspended/never-published) — remembered so every response
+        #: carrying that same version does not re-trigger a fetch
+        self._no_doc_version = 0
+        self._sync_lock = threading.Lock()
+        self._stop = threading.Event()
+        # delayed releases: (release_mono, seq, event, partial item)
+        self._heap: list = []
+        self._heap_seq = 0
+        self._heap_cond = threading.Condition()
+        self._release_thread: Optional[threading.Thread] = None
+        # backhaul buffer of ready wire items, flushed by size/window
+        self._backhaul: List[dict] = []
+        self._bh_cond = threading.Condition()
+        self._bh_since = 0.0
+        self._bh_thread: Optional[threading.Thread] = None
+        self._threads_lock = threading.Lock()
+        #: decisions made since start (edge-side tally; the canonical
+        #: nmz_edge_decisions_total counts orchestrator-side, where the
+        #: backhaul reconciles)
+        self.decisions = 0
+
+    # -- table state -----------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        return self._table is not None
+
+    @property
+    def table_version(self) -> Optional[int]:
+        table = self._table
+        return table.version if table is not None else None
+
+    def note_server_version(self, version: Optional[int]) -> None:
+        """Compare a piggybacked server version against the held table
+        and re-sync on mismatch. The one staleness choke point — every
+        response on the owning wire routes its version here."""
+        if version is None:
+            return
+        table = self._table
+        held = table.version if table is not None \
+            else self._no_doc_version
+        if version == held:
+            return
+        if table is not None \
+                and chaos.decide("table.publish.stale") is not None:
+            # chaos: stay stale this round — the invariant harness
+            # proves dispatch remains exactly-once and the backhaul
+            # reconciles anyway (every decision still carries the stale
+            # table's own unambiguous version)
+            log.debug("chaos: table.publish.stale — holding v%d against "
+                      "server v%d", table.version, version)
+            return
+        self.sync()
+
+    def sync(self) -> Optional[int]:
+        """Fetch and install the server's current table (None doc =
+        central fallback); returns the installed version or None.
+        Concurrent senders keep deciding against whatever table
+        reference they already read — each decision is tagged with that
+        table's own version, so a mid-batch rollover never produces an
+        ambiguously-versioned record."""
+        with self._sync_lock:
+            # drop FIRST: between here and the fetch completing, every
+            # send falls back to the central wire — loss-free, and a
+            # fetch failure cannot leave a known-stale table active
+            self._table = None
+            try:
+                version, doc = self._fetch_table()
+            except Exception as e:
+                log.debug("table fetch failed (%s); staying on the "
+                          "central wire", e)
+                self._no_doc_version = 0
+                return None
+            if doc is None:
+                self._no_doc_version = int(version)
+                return None
+            try:
+                self._table = EdgeTable(doc)
+            except (KeyError, TypeError, ValueError) as e:
+                log.warning("unusable published table (%s); staying on "
+                            "the central wire", e)
+                self._no_doc_version = int(version)
+                return None
+            log.debug("edge table v%d installed (%d buckets)",
+                      self._table.version, self._table.H)
+            return self._table.version
+
+    # -- the decision hot path -------------------------------------------
+
+    def partition(self, events: List[Event]):
+        """Split ``events`` into ``(edge_eligible, central)`` with NO
+        side effects — one table read, the same eligibility rule as
+        :meth:`try_dispatch_batch`. Lets the transceiver run the
+        fallible central wire work FIRST and release the eligible
+        subset only after it succeeded, so a caller retrying a raised
+        ``send_events`` burst can never re-release an already-decided
+        event."""
+        if self._table is None or self._stop.is_set():
+            return [], list(events)
+        eligible: List[Event] = []
+        central: List[Event] = []
+        for event in events:
+            (eligible if event.deferred else central).append(event)
+        return eligible, central
+
+    def try_dispatch(self, event: Event) -> bool:
+        """Decide + release ``event`` locally if the edge is active and
+        the event is edge-eligible (deferred, i.e. its answer is the
+        accepting action the table schedules). Returns False to send
+        the event down the central wire instead."""
+        table = self._table
+        if table is None or not event.deferred or self._stop.is_set():
+            return False
+        hint = event.replay_hint()
+        delay = table.delay_for(hint)
+        m0 = time.monotonic()
+        w0 = time.time()
+        event.mark_arrived(now=w0)
+        self.decisions += 1
+        if delay <= 0.0:
+            # the zero-RTT fast path: the waiter unblocks on the caller
+            # thread, then the trace record rides the async backhaul
+            self._release(event, hint, table.version, delay, m0, w0)
+            self._drain_if_stopped()
+            return True
+        with self._heap_cond:
+            heapq.heappush(
+                self._heap,
+                (m0 + delay, self._heap_seq,
+                 event, (hint, table.version, delay, m0, w0)))
+            self._heap_seq += 1
+            self._heap_cond.notify()
+        self._ensure_release_thread()
+        self._drain_if_stopped()
+        return True
+
+    def try_dispatch_batch(self, events: List[Event]) -> List[Event]:
+        """Batch decision point (``Transceiver.send_events``): one
+        table read, one heap/cond acquisition, one backhaul append run
+        for the whole burst. Returns the events NOT edge-eligible
+        (table absent, non-deferred) — the caller routes those down
+        the central wire. Decision values and per-event stamps are
+        identical to :meth:`try_dispatch`; only per-event lock/branch
+        overhead is amortized (doc/performance.md)."""
+        table = self._table
+        if table is None or self._stop.is_set():
+            return list(events)
+        rejected: List[Event] = []
+        ripe = []     # (event, hint, delay)
+        parked = []
+        w0 = time.time()
+        for event in events:
+            if not event.deferred:
+                rejected.append(event)
+                continue
+            hint = event.replay_hint()
+            delay = table.delay_for(hint)
+            event.arrived = w0
+            if delay <= 0.0:
+                ripe.append((event, hint, delay))
+            else:
+                parked.append((event, hint, delay))
+        self.decisions += len(ripe) + len(parked)
+        if parked:
+            m0 = time.monotonic()
+            with self._heap_cond:
+                for event, hint, delay in parked:
+                    heapq.heappush(
+                        self._heap,
+                        (m0 + delay, self._heap_seq,
+                         event, (hint, table.version, delay, m0, w0)))
+                    self._heap_seq += 1
+                self._heap_cond.notify()
+            self._ensure_release_thread()
+        if ripe:
+            # per-BURST clock stamps (m0/w1/m1 bracket the whole ripe
+            # run, not each event): at the rates this path serves a
+            # burst spans well under a millisecond, and three clock
+            # reads per burst beat three per event
+            version = table.version
+            accept = self._accept_action
+            m0 = time.monotonic()
+            w1 = time.time()
+            actions = []
+            for event, hint, delay in ripe:
+                action = accept(event, hint)
+                action.triggered_time = w1
+                actions.append(action)
+            if self._deliver_many is not None:
+                self._deliver_many(actions)
+            else:
+                deliver = self._deliver
+                for action in actions:
+                    deliver(action)
+            m1 = time.monotonic()
+            self._enqueue_backhaul(
+                [(event, version, delay, m0, m1, w0, w1)
+                 for event, hint, delay in ripe])
+        if parked or ripe:
+            self._drain_if_stopped()
+        return rejected
+
+    def _drain_if_stopped(self) -> None:
+        """Close the dispatch-vs-shutdown race: a dispatcher that
+        passed the stop check before :meth:`shutdown` completed may
+        park an event or queue a backhaul record AFTER the final
+        drain/flush — with the worker threads already gone, both would
+        be silently stranded. Dispatch paths call this after
+        publishing, and shutdown sets the stop flag before draining,
+        so one side always sees the other's work; both drains pop
+        under the same locks, so draining twice is loss-free."""
+        if not self._stop.is_set():
+            return
+        self._drain_parked()
+        if self.pending_backhaul():
+            self._flush_backhaul_once()
+
+    def _drain_parked(self) -> None:
+        """Deliver every still-parked release NOW, in (release_time,
+        seq) order — the stop-path mirror of the release loop."""
+        with self._heap_cond:
+            parked = sorted(self._heap)
+            self._heap = []
+        for _, _, event, meta in parked:
+            hint, version, delay, m0, w0 = meta
+            self._release(event, hint, version, delay, m0, w0)
+
+    @staticmethod
+    def _accept_action(event: Event, hint: str):
+        """Mint the accepting action directly — ``object.__new__`` plus
+        explicit attribute sets, bypassing the ``Signal.__init__``
+        chain (option-dict copy + schema validation) that costs ~5µs
+        per action and would alone halve the zero-RTT rate.
+        EventAcceptanceAction declares no OPTION_FIELDS and carries an
+        empty option, so the skipped validation is a no-op by
+        construction (pinned by test_edge_dispatch: the fast mint
+        must equal ``Action.for_event`` field-for-field)."""
+        action = _new(EventAcceptanceAction)
+        action.entity_id = event.entity_id
+        action.option = {}
+        action.uuid = fast_uuid4()
+        action.arrived = None
+        action.event_uuid = event.uuid
+        action.event_class = event.class_name()
+        action.event_hint = hint
+        action.event_arrived = event.arrived
+        action.triggered_time = None
+        _spans.carry(action, event)
+        return action
+
+    def _release(self, event: Event, hint: str, version: int,
+                 delay: float, m0: float, w0: float) -> None:
+        action = self._accept_action(event, hint)
+        m1 = time.monotonic()
+        w1 = time.time()
+        action.triggered_time = w1
+        self._deliver(action)
+        # raw tuple on the hot path; the wire dict is built at flush
+        # time (off the caller thread) — serialization cost must not
+        # ride the zero-RTT path
+        self._enqueue_backhaul([(event, version, delay, m0, m1, w0, w1)])
+
+    def _enqueue_backhaul(self, items) -> None:
+        with self._bh_cond:
+            was_empty = not self._backhaul
+            self._backhaul.extend(items)
+            if was_empty:
+                self._bh_since = time.monotonic()
+                self._bh_cond.notify()
+        if not self._stop.is_set():
+            self._ensure_backhaul_thread()
+
+    # -- delayed release --------------------------------------------------
+
+    def _ensure_release_thread(self) -> None:
+        if self._release_thread is not None or self._stop.is_set():
+            return
+        with self._threads_lock:
+            if self._release_thread is None and not self._stop.is_set():
+                t = threading.Thread(
+                    target=self._release_loop,
+                    name=f"edge-release-{self.entity_id}", daemon=True)
+                t.start()
+                self._release_thread = t
+
+    def _release_loop(self) -> None:
+        while True:
+            with self._heap_cond:
+                while not self._heap and not self._stop.is_set():
+                    self._heap_cond.wait(0.5)
+                if self._stop.is_set():
+                    return  # shutdown drains the heap itself
+                release_at = self._heap[0][0]
+                now = time.monotonic()
+                if release_at > now:
+                    self._heap_cond.wait(min(release_at - now, 0.5))
+                    continue
+                _, _, event, meta = heapq.heappop(self._heap)
+            hint, version, delay, m0, w0 = meta
+            self._release(event, hint, version, delay, m0, w0)
+
+    # -- backhaul ---------------------------------------------------------
+
+    def _ensure_backhaul_thread(self) -> None:
+        if self._bh_thread is not None or self._stop.is_set():
+            return
+        with self._threads_lock:
+            if self._bh_thread is None and not self._stop.is_set():
+                t = threading.Thread(
+                    target=self._backhaul_loop,
+                    name=f"edge-backhaul-{self.entity_id}", daemon=True)
+                t.start()
+                self._bh_thread = t
+
+    def _backhaul_loop(self) -> None:
+        backoff = 0.0
+        while True:
+            with self._bh_cond:
+                while not self._backhaul and not self._stop.is_set():
+                    self._bh_cond.wait(0.5)
+                if self._stop.is_set():
+                    return  # shutdown performs the final flush
+                since = self._bh_since
+            delay = since + self.backhaul_window - time.monotonic()
+            if delay > 0 and self._stop.wait(delay):
+                return
+            if self._flush_backhaul_once():
+                backoff = 0.0
+            else:
+                # items were re-queued; retry after a bounded backoff
+                backoff = min(backoff + 0.1, 2.0)
+                if self._stop.wait(backoff):
+                    return
+
+    @staticmethod
+    def _wire_item(raw) -> dict:
+        event, version, delay, m0, m1, w0, w1 = raw
+        return {
+            "event": event.to_jsonable(),
+            "decision": {
+                "delay": delay,
+                "source": "table",
+                "decision_source": "edge",
+                "table_version": version,
+                "t_intercepted": m0,
+                "t_dispatched": m1,
+                "arrived_wall": w0,
+                "triggered_wall": w1,
+            },
+        }
+
+    def _flush_backhaul_once(self) -> bool:
+        """Drain the buffer onto the wire in entity-grouped chunks;
+        False re-queues everything un-acked at the buffer head."""
+        with self._bh_cond:
+            batch, self._backhaul = self._backhaul, []
+        if not batch:
+            return True
+        by_entity: Dict[str, List] = {}
+        for raw in batch:
+            by_entity.setdefault(raw[0].entity_id, []).append(raw)
+        entities = list(by_entity.items())
+        for e_idx, (entity, items) in enumerate(entities):
+            for i in range(0, len(items), self.backhaul_max):
+                chunk = items[i:i + self.backhaul_max]
+                try:
+                    server_version = self._send_backhaul(
+                        entity, [self._wire_item(raw) for raw in chunk])
+                except Exception as e:
+                    # keep everything not yet acknowledged at the
+                    # buffer HEAD: the chunk that raised (whose ack may
+                    # have been lost in flight — the endpoint dedupe
+                    # ring absorbs a replay) plus every untouched item
+                    remaining = items[i:]
+                    for _, later in entities[e_idx + 1:]:
+                        remaining.extend(later)
+                    with self._bh_cond:
+                        self._backhaul[:0] = remaining
+                    log.debug("backhaul flush failed (%s); %d "
+                              "record(s) re-queued", e, len(remaining))
+                    return False
+                self.note_server_version(server_version)
+        return True
+
+    def pending_backhaul(self) -> int:
+        with self._bh_cond:
+            return len(self._backhaul)
+
+    # -- shutdown ---------------------------------------------------------
+
+    def shutdown(self, flush_attempts: int = 3) -> None:
+        """Flush everything: pending delayed releases are delivered
+        immediately (mirroring the policy-side loss-free shutdown
+        flush), then the backhaul buffer gets a final bounded-retry
+        synchronous flush — no trace record is silently dropped."""
+        self._stop.set()
+        with self._heap_cond:
+            self._heap_cond.notify_all()
+        with self._bh_cond:
+            self._bh_cond.notify_all()
+        for t in (self._release_thread, self._bh_thread):
+            if t is not None and t is not threading.current_thread():
+                t.join(timeout=5.0)
+        self._drain_parked()
+        for attempt in range(max(1, flush_attempts)):
+            if self._flush_backhaul_once():
+                return
+            time.sleep(0.05 * (attempt + 1))
+        left = self.pending_backhaul()
+        if left:
+            log.warning("%d backhaul record(s) undeliverable at "
+                        "shutdown; the orchestrator's trace for them "
+                        "is incomplete", left)

@@ -55,13 +55,14 @@ import socket
 import threading
 import time
 import urllib.error
-from collections import OrderedDict
 from typing import List, Optional
 from urllib.parse import urlsplit
 
 from namazu_tpu import chaos, obs
-from namazu_tpu.endpoint.rest import API_ROOT
-from namazu_tpu.inspector.transceiver import Transceiver
+from namazu_tpu.endpoint.rest import API_ROOT, TABLE_VERSION_HEADER
+from namazu_tpu.inspector.edge import EdgeDispatcher
+from namazu_tpu.inspector.transceiver import (Transceiver,
+                                              UnackedReplayMixin)
 from namazu_tpu.signal.action import Action
 from namazu_tpu.signal.base import SignalError, signal_from_jsonable
 from namazu_tpu.signal.event import Event
@@ -140,6 +141,10 @@ class _KeepAliveConn:
         #: when absent — read by the POST path right after request()
         #: so a 429's advice reaches the bounded retry
         self.last_retry_after: Optional[float] = None
+        #: the zero-RTT table-version piggyback from the most recent
+        #: response (doc/performance.md), None when the server has no
+        #: table plane — routed to the edge dispatcher's staleness check
+        self.last_table_version: Optional[int] = None
 
     def request(self, method: str, path: str,
                 body: Optional[bytes] = None):
@@ -183,6 +188,12 @@ class _KeepAliveConn:
                                              else max(0.0, float(raw_ra)))
                 except ValueError:
                     self.last_retry_after = None  # HTTP-date form: skip
+                raw_tv = resp.getheader(TABLE_VERSION_HEADER)
+                try:
+                    self.last_table_version = (None if raw_tv is None
+                                               else int(raw_tv))
+                except ValueError:
+                    self.last_table_version = None
                 if resp.will_close:
                     self.close()
                 return resp.status, data
@@ -215,13 +226,15 @@ class _KeepAliveConn:
                 pass
 
 
-class RestTransceiver(Transceiver):
+class RestTransceiver(UnackedReplayMixin, Transceiver):
     def __init__(self, entity_id: str, orchestrator_url: str,
                  backoff_step: float = 0.5, backoff_max: float = 5.0,
                  post_attempts: int = 4, use_batch: bool = True,
                  batch_max: int = 64, flush_window: float = 0.0,
                  poll_batch: Optional[int] = None,
-                 poll_linger: float = 0.0):
+                 poll_linger: float = 0.0,
+                 edge: bool = False,
+                 backhaul_window: float = 0.05):
         super().__init__(entity_id)
         self.base = orchestrator_url.rstrip("/") + API_ROOT
         self.backoff_step = backoff_step
@@ -262,9 +275,22 @@ class RestTransceiver(Transceiver):
         # its dedupe ring as duplicates (idempotent), and the ones the
         # old process never journaled as fresh, so nothing is lost
         # either way. Bounded: oldest evicted past the cap.
-        self._unacked: "OrderedDict[str, Event]" = OrderedDict()
-        self._unacked_lock = threading.Lock()
+        self._init_unacked()
         self._replay_armed = False
+        # zero-RTT edge dispatch (doc/performance.md): opt-in; dormant
+        # until the orchestrator publishes a table (the version
+        # piggyback on any batch/poll response activates it), so
+        # non-table policies and cold-start windows run the exact
+        # central wire above
+        self._edge: Optional[EdgeDispatcher] = None
+        if edge:
+            self._edge = EdgeDispatcher(
+                entity_id,
+                deliver=self.dispatch_action,
+                deliver_many=self.dispatch_actions,
+                fetch_table=self._fetch_table_once,
+                send_backhaul=self._post_backhaul_once,
+                backhaul_window=backhaul_window)
 
     # -- outbound --------------------------------------------------------
 
@@ -276,6 +302,11 @@ class RestTransceiver(Transceiver):
         cap, window expiry, or synchronous when ``flush_window=0``)
         carries the same retry policy, and a replayed batch whose 200
         was lost dedupes server-side."""
+        if self._edge is not None and self._edge.try_dispatch(event):
+            # zero-RTT: decided + released locally against the
+            # published table; the trace record rides the async
+            # backhaul instead of this POST
+            return
         if not self.use_batch:
             retry_call(
                 lambda: self._post_once(event),
@@ -360,21 +391,6 @@ class RestTransceiver(Transceiver):
             return True
         return False
 
-    def _note_posted(self, events: List[Event]) -> None:
-        """Track successfully-POSTed deferred events until their action
-        arrives (the reconnect-and-replay window)."""
-        with self._unacked_lock:
-            for event in events:
-                if getattr(event, "deferred", False):
-                    self._unacked[event.uuid] = event
-            while len(self._unacked) > self.UNACKED_CAP:
-                self._unacked.popitem(last=False)
-
-    #: bound on the posted-but-unanswered ring (an orchestrator would
-    #: have to park this many of ONE entity's deferred events for
-    #: replay coverage to shrink)
-    UNACKED_CAP = 1024
-
     def _ensure_flusher(self) -> None:
         if self._flush_thread is not None or self._stop.is_set():
             return
@@ -453,6 +469,7 @@ class RestTransceiver(Transceiver):
             status, _ = self._post_conn.request("POST", path, body=body)
             obs.transport_rtt("post_batch", time.perf_counter() - t0)
             retry_after = self._post_conn.last_retry_after
+            table_version = self._post_conn.last_table_version
             if status == 200 \
                     and chaos.decide("wire.post.dup") is not None:
                 self._post_conn.request("POST", path, body=body)
@@ -470,9 +487,84 @@ class RestTransceiver(Transceiver):
         _check_post_status(status, f"POST {path}", retry_after=retry_after)
         self._note_posted(chunk)
         obs.event_batch("flush", len(chunk))
+        self._note_table_version(table_version)
         if chaos.decide("wire.post.lost_reply") is not None:
             raise TransientHTTPStatus(f"chaos: 200 for POST {path} "
                                       "lost in flight")
+
+    def _post_many(self, events) -> None:
+        """Batch hook (``send_events``): the central subset rides the
+        wire FIRST — its POSTs can fail, and a replayed burst dedupes
+        server-side — then the edge decides the eligible subset in one
+        vectorized pass, releasing only after the fallible wire work
+        succeeded (a caller retrying a raised burst can never
+        re-release an already-decided event). Whatever the edge still
+        rejects (table withdrawn in between) falls back to the central
+        wire, loss-free."""
+        events = list(events)
+        eligible = []
+        if self._edge is not None:
+            eligible, events = self._edge.partition(events)
+        for event in events:
+            self._post(event)
+        if eligible:
+            for event in self._edge.try_dispatch_batch(eligible):
+                self._post(event)
+
+    # -- zero-RTT edge dispatch (doc/performance.md) ---------------------
+
+    @property
+    def edge_active(self) -> bool:
+        """True while this transceiver decides events locally against
+        a held published table."""
+        return self._edge is not None and self._edge.active
+
+    def sync_table(self) -> Optional[int]:
+        """Force one table fetch+install (tests/bench priming; normal
+        operation activates lazily off the version piggyback). Returns
+        the installed version, or None (central fallback)."""
+        if self._edge is None:
+            return None
+        return self._edge.sync()
+
+    def _note_table_version(self, version: Optional[int]) -> None:
+        if self._edge is not None:
+            self._edge.note_server_version(version)
+
+    def _fetch_table_once(self):
+        """One ``GET /policy/table``: ``(version, doc_or_None)``."""
+        path = f"{self._path}/policy/table"
+        with self._conn_lock:
+            status, body = self._post_conn.request("GET", path)
+            version = self._post_conn.last_table_version
+        if status == 200:
+            doc = json.loads(body)
+            return int(doc.get("version", version or 0)), doc
+        if status in (204, 404):
+            # 204 = no publishable table at this version; 404 = a
+            # pre-table orchestrator — both mean central dispatch
+            return int(version or 0), None
+        raise RuntimeError(f"GET {path} -> {status}")
+
+    def _post_backhaul_once(self, entity: str,
+                            items: List[dict]) -> Optional[int]:
+        """POST one backhaul chunk; returns the server's current table
+        version from the reply (the edge's staleness signal). Raises on
+        failure — the dispatcher re-queues and retries, and a replayed
+        chunk whose 200 was lost dedupes server-side."""
+        body = json.dumps({"items": items}).encode()
+        path = f"{self._path}/events/{entity}/backhaul"
+        with self._conn_lock:
+            t0 = time.perf_counter()
+            status, raw = self._post_conn.request("POST", path, body=body)
+            obs.transport_rtt("backhaul", time.perf_counter() - t0)
+            retry_after = self._post_conn.last_retry_after
+        _check_post_status(status, f"POST {path}", retry_after=retry_after)
+        try:
+            doc = json.loads(raw)
+            return int(doc.get("table_version"))
+        except (TypeError, ValueError):
+            return None
 
     # -- inbound ---------------------------------------------------------
 
@@ -501,6 +593,15 @@ class RestTransceiver(Transceiver):
             self._flush()
         except Exception:
             log.debug("final flush failed during shutdown", exc_info=True)
+        if self._edge is not None:
+            # flush pending backhaul BEFORE the connections close: an
+            # edge-decided event whose trace record is still buffered
+            # must reach the flight recorder (the same loss-free
+            # guarantee the coalescing buffer gets above)
+            try:
+                self._edge.shutdown()
+            except Exception:
+                log.debug("edge shutdown flush failed", exc_info=True)
         t = self._thread
         if t is not None and t is not threading.current_thread():
             # break an in-flight long-poll: closing the socket under the
@@ -540,46 +641,12 @@ class RestTransceiver(Transceiver):
                 self.dispatch_action(action)
         self._recv_conn.close()
 
-    def dispatch_action(self, action) -> None:
-        # the event is answered: it leaves the replay window before the
-        # waiter hand-off (a replay racing this ack at worst re-posts an
-        # already-answered uuid, which the dedupe ring absorbs)
-        with self._unacked_lock:
-            self._unacked.pop(action.event_uuid, None)
-        super().dispatch_action(action)
-
-    def _replay_unacked(self) -> None:
-        """Re-POST every posted-but-unanswered deferred event after the
-        server came back (doc/robustness.md): against the same process
-        the dedupe ring answers ``duplicate``; against a restarted one
-        the journal-seeded ring dedupes recovered events and accepts
-        the rest fresh — either way the events exist server-side
-        exactly once afterwards. Best-effort: a replay that fails rides
-        the next reconnect (the loop re-arms on the next poll error)."""
-        with self._unacked_lock:
-            events = list(self._unacked.values())
-        if not events:
-            return
-        log.warning("transport recovered; replaying %d unacked "
-                    "event(s) (server-side dedupe makes this "
-                    "idempotent)", len(events))
-        by_entity: "dict[str, List[Event]]" = {}
-        for event in events:
-            by_entity.setdefault(event.entity_id, []).append(event)
-        for entity, batch in by_entity.items():
-            for i in range(0, len(batch), self.batch_max):
-                chunk = batch[i:i + self.batch_max]
-                try:
-                    if self.use_batch:
-                        self._post_batch_once(chunk, entity)
-                    else:
-                        for event in chunk:
-                            self._post_once(event)
-                except Exception as e:
-                    log.debug("unacked replay failed (%s); will retry "
-                              "on the next reconnect", e)
-                    self._replay_armed = True
-                    return
+    def _replay_chunk(self, chunk, entity: str) -> None:
+        if self.use_batch:
+            self._post_batch_once(chunk, entity)
+        else:
+            for event in chunk:
+                self._post_once(event)
 
     def _poll_once(self) -> List[Action]:
         """One long-poll cycle over the receive thread's persistent
@@ -637,6 +704,7 @@ class RestTransceiver(Transceiver):
             "GET", f"{path}?batch={self.poll_batch}"
                    f"&linger_ms={linger_ms}")
         obs.transport_rtt("poll", time.perf_counter() - t0)
+        self._note_table_version(self._recv_conn.last_table_version)
         if status == 204:
             return []
         if status != 200:

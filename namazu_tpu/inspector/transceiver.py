@@ -7,7 +7,13 @@ that queue, and a receive loop correlates incoming actions back by their
 ``event_uuid``.
 
 ``new_transceiver(url, entity_id)`` dispatches on scheme: ``local://`` for
-the in-process endpoint (autopilot/tests), ``http(s)://`` for REST.
+the in-process endpoint (autopilot/tests), ``http(s)://`` for REST,
+``uds://`` for the same-host framed-JSON AF_UNIX wire, ``agent://``
+for the guest-agent framed TCP wire. ``edge=True`` (REST/UDS) opts the
+transceiver into zero-RTT edge dispatch: dormant until the
+orchestrator publishes a delay table, then deferred events are decided
+and released locally with asynchronous backhaul (doc/performance.md
+"Zero-RTT dispatch").
 """
 
 from __future__ import annotations
@@ -27,7 +33,7 @@ log = get_logger("transceiver")
 class Transceiver:
     def __init__(self, entity_id: str):
         self.entity_id = entity_id
-        self._waiters: Dict[str, "queue.Queue[Action]"] = {}
+        self._waiters: Dict[str, "queue.SimpleQueue[Action]"] = {}
         self._lock = threading.Lock()
 
     def start(self) -> None:
@@ -36,7 +42,7 @@ class Transceiver:
     def shutdown(self) -> None:
         pass
 
-    def send_event(self, event: Event) -> "queue.Queue[Action]":
+    def send_event(self, event: Event) -> "queue.SimpleQueue[Action]":
         """Send ``event``; returns a queue that will receive the answering
         action(s). The queue is registered before sending.
 
@@ -46,8 +52,13 @@ class Transceiver:
         :meth:`send_notification` — their default NopAction is
         orchestrator-side-only and never comes back, so a registered
         waiter would leak.
+
+        The queue is a ``SimpleQueue`` (C implementation, reentrant
+        put): a waiter is minted per event, so its construction cost is
+        part of the event plane's per-event budget
+        (doc/performance.md).
         """
-        ch: "queue.Queue[Action]" = queue.Queue()
+        ch: "queue.SimpleQueue[Action]" = queue.SimpleQueue()
         with self._lock:
             self._waiters[event.uuid] = ch
         try:
@@ -57,6 +68,37 @@ class Transceiver:
                 self._waiters.pop(event.uuid, None)
             raise
         return ch
+
+    def send_events(self, events) -> "list[queue.SimpleQueue]":
+        """Batch variant of :meth:`send_event` for inspectors that
+        intercept bursts: every waiter is registered under ONE lock
+        before anything reaches the wire, then the burst posts through
+        :meth:`_post_many` (transports with a batch wire — the edge
+        dispatcher's vectorized decide, the coalesced batch POST —
+        amortize their per-event overhead there). Same contract as
+        send_event: deferred events only. On error no waiter remains
+        registered."""
+        events = list(events)
+        chans: "list[queue.SimpleQueue]" = []
+        with self._lock:
+            for event in events:
+                ch: "queue.SimpleQueue" = queue.SimpleQueue()
+                self._waiters[event.uuid] = ch
+                chans.append(ch)
+        try:
+            self._post_many(events)
+        except Exception:
+            with self._lock:
+                for event in events:
+                    self._waiters.pop(event.uuid, None)
+            raise
+        return chans
+
+    def _post_many(self, events) -> None:
+        """Transport hook for :meth:`send_events`; default = the
+        per-event loop."""
+        for event in events:
+            self._post(event)
 
     def send_notification(self, event: Event) -> None:
         """Send an observation-only event without awaiting any action."""
@@ -81,6 +123,102 @@ class Transceiver:
             )
             return
         ch.put(action)
+
+    def dispatch_actions(self, actions) -> None:
+        """Batch variant of :meth:`dispatch_action`: every waiter is
+        resolved under ONE lock acquisition, then the hand-offs happen
+        outside it — the edge dispatcher's burst delivery path, where
+        a per-action lock round would dominate the zero-RTT budget."""
+        with self._lock:
+            pop = self._waiters.pop
+            resolved = [(pop(a.event_uuid, None), a) for a in actions]
+        for ch, action in resolved:
+            if ch is None:
+                log.warning(
+                    "%s: action for unknown event %s (%r)",
+                    self.entity_id, action.event_uuid[:8], action,
+                )
+            else:
+                ch.put(action)
+
+
+class UnackedReplayMixin:
+    """Client side of the reconnect-and-replay window, shared by the
+    wire transceivers (REST, uds): a bounded insertion-ordered ring of
+    posted-but-unanswered deferred events, popped as their actions
+    arrive and re-offered after a transport recovery (server-side
+    dedupe makes the replay idempotent — doc/robustness.md). Subclasses
+    provide ``batch_max``, a ``_replay_armed`` flag their receive loop
+    sets on transport errors, and :meth:`_replay_chunk`."""
+
+    #: bound on the posted-but-unanswered ring (an orchestrator would
+    #: have to park this many of ONE entity's deferred events for
+    #: replay coverage to shrink)
+    UNACKED_CAP = 1024
+
+    def _init_unacked(self) -> None:
+        from collections import OrderedDict
+
+        self._unacked: "OrderedDict[str, Event]" = OrderedDict()
+        self._unacked_lock = threading.Lock()
+
+    def _note_posted(self, events) -> None:
+        """Track successfully-posted deferred events until their action
+        arrives (the reconnect-and-replay window)."""
+        with self._unacked_lock:
+            for event in events:
+                if getattr(event, "deferred", False):
+                    self._unacked[event.uuid] = event
+            while len(self._unacked) > self.UNACKED_CAP:
+                self._unacked.popitem(last=False)
+
+    def dispatch_action(self, action) -> None:
+        # the event is answered: it leaves the replay window before the
+        # waiter hand-off (a replay racing this ack at worst re-posts an
+        # already-answered uuid, which the dedupe ring absorbs)
+        with self._unacked_lock:
+            self._unacked.pop(action.event_uuid, None)
+        super().dispatch_action(action)
+
+    def dispatch_actions(self, actions) -> None:
+        with self._unacked_lock:
+            pop = self._unacked.pop
+            for action in actions:
+                pop(action.event_uuid, None)
+        super().dispatch_actions(actions)
+
+    def _replay_chunk(self, chunk, entity: str) -> None:
+        """One ``batch_max``-bounded re-post on the subclass's wire."""
+        raise NotImplementedError
+
+    def _replay_unacked(self) -> None:
+        """Re-post every posted-but-unanswered deferred event after the
+        server came back: against the same process the dedupe ring
+        answers ``duplicate``; against a restarted one the
+        journal-seeded ring dedupes recovered events and accepts the
+        rest fresh — either way the events exist server-side exactly
+        once afterwards. Best-effort: a replay that fails rides the
+        next reconnect (the loop re-arms on the next poll error)."""
+        with self._unacked_lock:
+            events = list(self._unacked.values())
+        if not events:
+            return
+        log.warning("transport recovered; replaying %d unacked "
+                    "event(s) (server-side dedupe makes this "
+                    "idempotent)", len(events))
+        by_entity: "dict[str, list]" = {}
+        for event in events:
+            by_entity.setdefault(event.entity_id, []).append(event)
+        for entity, batch in by_entity.items():
+            for i in range(0, len(batch), self.batch_max):
+                try:
+                    self._replay_chunk(batch[i:i + self.batch_max],
+                                       entity)
+                except Exception as e:
+                    log.debug("unacked replay failed (%s); will retry "
+                              "on the next reconnect", e)
+                    self._replay_armed = True
+                    return
 
 
 class LocalTransceiver(Transceiver):
@@ -108,6 +246,7 @@ def new_transceiver(
     url: str,
     entity_id: str,
     local_endpoint: Optional[LocalEndpoint] = None,
+    edge: bool = False,
 ) -> Transceiver:
     """Factory, parity transceiver.go:21-31."""
     if url.startswith("local://"):
@@ -117,7 +256,11 @@ def new_transceiver(
     if url.startswith(("http://", "https://")):
         from namazu_tpu.inspector.rest_transceiver import RestTransceiver
 
-        return RestTransceiver(entity_id, url)
+        return RestTransceiver(entity_id, url, edge=edge)
+    if url.startswith("uds://"):
+        from namazu_tpu.inspector.uds_transceiver import UdsTransceiver
+
+        return UdsTransceiver(entity_id, url[len("uds://"):], edge=edge)
     if url.startswith("agent://"):
         from namazu_tpu.inspector.agent_transceiver import AgentTransceiver
 

@@ -1,0 +1,358 @@
+"""UDS transceiver: framed JSON over AF_UNIX for same-host inspectors.
+
+Client side of the ``uds://`` wire (endpoint/uds.py; doc/performance.md
+"Zero-RTT dispatch"). Same batch/ack semantics as the batched REST
+transport — events ride ``post_batch`` ops with a bounded retry (the
+endpoint's dedupe ring makes replays idempotent), one receive thread
+long-polls the ``poll`` op and multi-acks with ``ack`` — but the wire
+is one length-prefixed JSON frame each way on a persistent Unix-domain
+connection: no HTTP parse, no TCP handshake, no Nagle interplay.
+
+Connection model mirrors the REST transceiver: one connection for the
+outbound ops (serialized by a lock), one owned by the receive thread,
+each with ONE transparent reconnect on a stale socket. Posted-but-
+unanswered deferred events are kept in a bounded ring and replayed when
+the receive loop recovers from a transport error (the signature of an
+orchestrator restart) — the server-side dedupe makes that idempotent.
+
+Edge dispatch (``edge=True``) works exactly as over REST: the shared
+:class:`~namazu_tpu.inspector.edge.EdgeDispatcher` decides deferred
+events against the published table (fetched with the ``table`` op,
+staleness noticed from the ``table_version`` field every response
+carries) and reconciles trace records through the ``backhaul`` op.
+
+Chaos seams (doc/robustness.md): ``wire.uds.drop`` discards a post
+batch pre-wire (the accounted-loss case), ``wire.uds.sever`` tears the
+receive connection so the loop must back off, reconnect, and replay.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import List, Optional
+
+from namazu_tpu import chaos, obs
+from namazu_tpu.endpoint.agent import read_frame, write_frame
+from namazu_tpu.inspector.edge import EdgeDispatcher
+from namazu_tpu.inspector.rest_transceiver import (
+    TransientHTTPStatus,
+    _retry_after_hint,
+)
+from namazu_tpu.inspector.transceiver import (Transceiver,
+                                              UnackedReplayMixin)
+from namazu_tpu.signal.action import Action
+from namazu_tpu.signal.base import SignalError, signal_from_jsonable
+from namazu_tpu.signal.event import Event
+from namazu_tpu.utils.log import get_logger
+from namazu_tpu.utils.retry import retry_call
+
+log = get_logger("transceiver.uds")
+
+_TRANSPORT_ERRORS = (OSError,)
+
+
+def _check_resp(resp: dict, what: str) -> None:
+    """Raise on a non-ok framed reply. A ``transient`` refusal (the
+    bounded-ingress 429 analogue) raises the retryable class carrying
+    the server's retry_after so the bounded retry honors it."""
+    if resp.get("ok"):
+        return
+    error = resp.get("error", "failed")
+    if resp.get("transient"):
+        ra = resp.get("retry_after")
+        raise TransientHTTPStatus(
+            f"{what}: {error}",
+            retry_after=None if ra is None else float(ra))
+    raise RuntimeError(f"{what}: {error}")
+
+
+class _FramedConn:
+    """One persistent framed-JSON connection to the UDS endpoint.
+
+    NOT thread-safe — each owner holds its own instance (the post path
+    under its lock, the receive thread exclusively). A request on a
+    stale socket gets ONE transparent reconnect+replay; every op here
+    is idempotent by construction (post_batch dedupes server-side, poll
+    peeks, ack reports already-gone uuids as ``missing``)."""
+
+    def __init__(self, path: str, timeout: float, abort=None):
+        self._path = path
+        self._timeout = timeout
+        self._abort = abort
+        self._sock: Optional[socket.socket] = None
+
+    def request(self, doc: dict) -> dict:
+        last_exc: Optional[BaseException] = None
+        for _attempt in (0, 1):
+            if self._abort is not None and self._abort():
+                raise OSError("connection owner is shutting down")
+            sock = self._sock
+            if sock is None:
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                sock.settimeout(self._timeout)
+                try:
+                    sock.connect(self._path)
+                except OSError as e:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    last_exc = e
+                    continue
+                self._sock = sock
+            try:
+                write_frame(sock, doc)
+                resp = read_frame(sock)
+                if resp is None:
+                    raise OSError("connection closed mid-request")
+                return resp
+            except (OSError, SignalError, ValueError) as e:
+                self.close()
+                last_exc = e
+                if self._abort is not None and self._abort():
+                    raise
+        raise last_exc  # type: ignore[misc]
+
+    def close(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                # wake a thread blocked in recv on this socket (a plain
+                # close leaves the read parked until the server answers)
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class UdsTransceiver(UnackedReplayMixin, Transceiver):
+
+    def __init__(self, entity_id: str, path: str,
+                 backoff_step: float = 0.5, backoff_max: float = 5.0,
+                 post_attempts: int = 4, batch_max: int = 64,
+                 poll_batch: Optional[int] = None,
+                 poll_linger: float = 0.0,
+                 edge: bool = False,
+                 backhaul_window: float = 0.05):
+        super().__init__(entity_id)
+        self.path = path
+        self.backoff_step = backoff_step
+        self.backoff_max = backoff_max
+        self.post_attempts = post_attempts
+        self.batch_max = max(1, int(batch_max))
+        self.poll_batch = (self.batch_max if poll_batch is None
+                           else max(1, int(poll_batch)))
+        self.poll_linger = max(0.0, float(poll_linger))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._post_conn = _FramedConn(path, timeout=30.0)
+        self._recv_conn = _FramedConn(path, timeout=65.0,
+                                      abort=self._stop.is_set)
+        self._conn_lock = threading.Lock()
+        self._init_unacked()
+        self._replay_armed = False
+        self._edge: Optional[EdgeDispatcher] = None
+        if edge:
+            self._edge = EdgeDispatcher(
+                entity_id,
+                deliver=self.dispatch_action,
+                deliver_many=self.dispatch_actions,
+                fetch_table=self._fetch_table_once,
+                send_backhaul=self._post_backhaul_once,
+                backhaul_window=backhaul_window)
+
+    # -- outbound ---------------------------------------------------------
+
+    def _post(self, event: Event) -> None:
+        if self._edge is not None and self._edge.try_dispatch(event):
+            return  # zero-RTT: decided locally, backhaul reconciles
+        retry_call(
+            lambda: self._post_batch_once([event], event.entity_id),
+            exceptions=_TRANSPORT_ERRORS,
+            attempts=max(1, self.post_attempts),
+            base=self.backoff_step,
+            cap=self.backoff_max,
+            sleep=self._stop.wait,
+            delay_hint=_retry_after_hint,
+            on_retry=lambda e, n, d: log.debug(
+                "uds post failed (%s); retry %d in %.2fs", e, n, d),
+        )
+
+    def _post_batch_once(self, chunk: List[Event], entity: str) -> None:
+        fault = chaos.decide("wire.uds.drop")
+        if fault is not None:
+            log.debug("chaos: dropped %d event(s) pre-wire (uds)",
+                      len(chunk))
+            return
+        req = {"op": "post_batch", "entity": entity,
+               "events": [ev.to_jsonable() for ev in chunk]}
+        with self._conn_lock:
+            t0 = time.perf_counter()
+            resp = self._post_conn.request(req)
+            obs.transport_rtt("post_batch", time.perf_counter() - t0)
+        _check_resp(resp, "uds post_batch")
+        self._note_posted(chunk)
+        obs.event_batch("flush", len(chunk))
+        self._note_table_version(resp.get("table_version"))
+
+    def _post_many(self, events) -> None:
+        """Batch hook (``send_events``): the central subset rides the
+        wire FIRST (its ``post_batch`` ops can fail, and a replayed
+        burst dedupes server-side), then the edge decides the eligible
+        subset in one vectorized pass — releasing only after the
+        fallible wire work succeeded, so a caller retrying a raised
+        burst can never re-release an already-decided event. Edge
+        rejects (table withdrawn in between) fall back per event."""
+        events = list(events)
+        eligible = []
+        if self._edge is not None:
+            eligible, events = self._edge.partition(events)
+        by_entity: "dict[str, List[Event]]" = {}
+        for event in events:
+            by_entity.setdefault(event.entity_id, []).append(event)
+        for entity, batch in by_entity.items():
+            for i in range(0, len(batch), self.batch_max):
+                chunk = batch[i:i + self.batch_max]
+                retry_call(
+                    lambda c=chunk, e=entity: self._post_batch_once(c, e),
+                    exceptions=_TRANSPORT_ERRORS,
+                    attempts=max(1, self.post_attempts),
+                    base=self.backoff_step,
+                    cap=self.backoff_max,
+                    sleep=self._stop.wait,
+                    delay_hint=_retry_after_hint,
+                )
+        if eligible:
+            for event in self._edge.try_dispatch_batch(eligible):
+                self._post(event)
+
+    # -- zero-RTT edge dispatch ------------------------------------------
+
+    @property
+    def edge_active(self) -> bool:
+        return self._edge is not None and self._edge.active
+
+    def sync_table(self) -> Optional[int]:
+        if self._edge is None:
+            return None
+        return self._edge.sync()
+
+    def _note_table_version(self, version) -> None:
+        if self._edge is not None and version is not None:
+            try:
+                self._edge.note_server_version(int(version))
+            except (TypeError, ValueError):
+                pass
+
+    def _fetch_table_once(self):
+        with self._conn_lock:
+            resp = self._post_conn.request({"op": "table"})
+        if not resp.get("ok"):
+            raise RuntimeError(f"uds table: {resp.get('error', 'failed')}")
+        return int(resp.get("version", 0)), resp.get("table")
+
+    def _post_backhaul_once(self, entity: str,
+                            items: List[dict]) -> Optional[int]:
+        req = {"op": "backhaul", "entity": entity, "items": items}
+        with self._conn_lock:
+            t0 = time.perf_counter()
+            resp = self._post_conn.request(req)
+            obs.transport_rtt("backhaul", time.perf_counter() - t0)
+        _check_resp(resp, "uds backhaul")
+        version = resp.get("table_version")
+        return None if version is None else int(version)
+
+    # -- inbound ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._receive_loop,
+                name=f"uds-recv-{self.entity_id}", daemon=True)
+            self._thread.start()
+
+    def shutdown(self, join_timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._edge is not None:
+            # flush pending backhaul while the post connection is still
+            # usable — edge-decided trace records are never dropped at
+            # shutdown
+            try:
+                self._edge.shutdown()
+            except Exception:
+                log.debug("edge shutdown flush failed", exc_info=True)
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            self._recv_conn.close()
+            t.join(timeout=join_timeout)
+            if t.is_alive():
+                log.warning("uds receive thread still parked after "
+                            "%.1fs; abandoning it (daemon)", join_timeout)
+        with self._conn_lock:
+            self._post_conn.close()
+
+    def _receive_loop(self) -> None:
+        backoff = 0.0
+        while not self._stop.is_set():
+            try:
+                actions = self._poll_once()
+                backoff = 0.0
+            except (*_TRANSPORT_ERRORS, RuntimeError, ValueError,
+                    SignalError) as e:
+                backoff = min(backoff + self.backoff_step,
+                              self.backoff_max)
+                log.debug("uds poll error (%s); backing off %.1fs",
+                          e, backoff)
+                self._replay_armed = True
+                self._stop.wait(backoff)
+                continue
+            if self._replay_armed:
+                self._replay_armed = False
+                self._replay_unacked()
+            for action in actions:
+                self.dispatch_action(action)
+        self._recv_conn.close()
+
+    def _replay_chunk(self, chunk, entity: str) -> None:
+        self._post_batch_once(chunk, entity)
+
+    def _poll_once(self) -> List[Action]:
+        if chaos.decide("wire.uds.sever") is not None:
+            # tear the keep-alive socket under the receive thread: the
+            # loop must back off, reconnect, and replay unacked events
+            self._recv_conn.close()
+            raise OSError("chaos: uds keep-alive severed")
+        t0 = time.perf_counter()
+        resp = self._recv_conn.request({
+            "op": "poll", "entity": self.entity_id,
+            "batch": self.poll_batch,
+            "linger_ms": int(self.poll_linger * 1000),
+            "timeout_s": 25.0,
+        })
+        obs.transport_rtt("poll", time.perf_counter() - t0)
+        if not resp.get("ok"):
+            raise RuntimeError(f"uds poll: {resp.get('error', 'failed')}")
+        self._note_table_version(resp.get("table_version"))
+        actions: List[Action] = []
+        for item in resp.get("actions") or []:
+            action = signal_from_jsonable(item)
+            if not isinstance(action, Action):
+                raise RuntimeError(f"uds poll returned non-action "
+                                   f"{item!r}")
+            actions.append(action)
+        if not actions:
+            return []
+        t0 = time.perf_counter()
+        ack = self._recv_conn.request({
+            "op": "ack", "entity": self.entity_id,
+            "uuids": [a.uuid for a in actions],
+        })
+        obs.transport_rtt("ack", time.perf_counter() - t0)
+        if not ack.get("ok"):
+            raise RuntimeError(f"uds ack: {ack.get('error', 'failed')}")
+        return actions

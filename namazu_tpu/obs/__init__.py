@@ -41,6 +41,7 @@ from namazu_tpu.obs.recorder import (  # noqa: F401
     record_decided,
     record_decision,
     record_dispatched,
+    record_edge,
     record_enqueued,
     record_generation,
     record_install,
@@ -64,6 +65,7 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     action_unroutable,
     carry,
     chaos_fault_injected,
+    edge_decision,
     entity_stalled,
     event_batch,
     event_intercepted,
@@ -93,6 +95,7 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     search_stall,
     sidecar_request,
     span,
+    table_version,
     transport_retry_after,
     transport_rtt,
 )

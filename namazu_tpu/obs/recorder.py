@@ -48,7 +48,7 @@ __all__ = [
     "begin_run", "end_run", "current_run_id", "current_generation_id",
     "record_intercepted", "record_enqueued", "record_decided",
     "record_decision", "record_released", "record_dispatched",
-    "record_acked", "record_generation", "record_install",
+    "record_acked", "record_edge", "record_generation", "record_install",
 ]
 
 #: lifecycle stamp names, in causal order (export sorts tracks by the
@@ -411,6 +411,41 @@ def record_released(event, policy: str,
         return
     run.stamp(event.uuid, "released", now=now,
               entity=event.entity_id, policy=policy)
+
+
+def record_edge(event, endpoint: str, policy: str, action,
+                decision: Dict[str, Any]) -> None:
+    """One edge-decided event's COMPLETE record in a single pass
+    (zero-RTT backhaul reconciliation, doc/performance.md): identity,
+    decision detail (``decision_source="edge"``, ``table_version``,
+    delay), the synthesized action, and every lifecycle stamp from the
+    edge's own clocks — one run-lock acquisition instead of the six a
+    stage-by-stage replay would cost per event."""
+    if not metrics.enabled():
+        return
+    run = _recorder.current()
+    if run is None:
+        return
+    detail = {name: decision[name] for name in
+              ("delay", "source", "decision_source", "table_version")
+              if name in decision}
+    rec = run.record_for(
+        event.uuid, entity=event.entity_id, endpoint=endpoint,
+        event_class=event.class_name(), hint=event.replay_hint(),
+        policy=policy, decision=detail,
+        action_class=action.class_name(), action_kind="edge")
+    if rec is None:
+        return
+    now = time.monotonic()
+    t0 = decision.get("t_intercepted")
+    t1 = decision.get("t_dispatched")
+    t0 = now if t0 is None else float(t0)
+    t1 = now if t1 is None else float(t1)
+    # dict assignment is GIL-atomic and snapshot copies under the run
+    # lock, so stamping outside record_for's lock is race-free enough
+    # (the same contract stamp() relies on)
+    rec.t.update(intercepted=t0, enqueued=t0, decided=t0,
+                 released=t1, dispatched=t1)
 
 
 def record_dispatched(action, kind: str,

@@ -79,6 +79,13 @@ BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
 ACTIONS_UNROUTABLE = "nmz_actions_unroutable_total"
 ENTITY_STALLED = "nmz_entity_stalled_total"
 
+# zero-RTT edge dispatch (doc/performance.md "Zero-RTT dispatch"):
+# events decided at the edge against a published delay table (counted
+# when their backhaul reconciles into the orchestrator), and the
+# monotonic version of the currently published table
+EDGE_DECISIONS = "nmz_edge_decisions_total"
+TABLE_VERSION = "nmz_table_version"
+
 # chaos + survivability plane (doc/robustness.md "Chaos plane"):
 # injected faults by point, ingress backpressure rejections, the
 # server-requested Retry-After delays the transceiver honored, and the
@@ -190,7 +197,7 @@ def carry(dst, src) -> None:
 
 # -- recording helpers (control plane) ----------------------------------
 
-def event_intercepted(endpoint: str, entity: str) -> None:
+def event_intercepted(endpoint: str, entity: str, n: int = 1) -> None:
     if not metrics.enabled():
         return
     reg = metrics.get()
@@ -198,7 +205,7 @@ def event_intercepted(endpoint: str, entity: str) -> None:
         EVENTS_INTERCEPTED,
         "events entering the orchestrator, by transport endpoint",
         ("endpoint", "entity"),
-    ).labels(endpoint=endpoint, entity=_entity_label(reg, entity)).inc()
+    ).labels(endpoint=endpoint, entity=_entity_label(reg, entity)).inc(n)
 
 
 def policy_decision(policy: str, entity: str,
@@ -235,7 +242,8 @@ def queue_dwell(policy: str, entity: str,
              entity=_entity_label(reg, entity)).observe(seconds)
 
 
-def action_dispatched(kind: str, e2e: Optional[float]) -> None:
+def action_dispatched(kind: str, e2e: Optional[float],
+                      n: int = 1) -> None:
     if not metrics.enabled():
         return
     reg = metrics.get()
@@ -243,7 +251,7 @@ def action_dispatched(kind: str, e2e: Optional[float]) -> None:
         ACTIONS_DISPATCHED,
         "actions leaving the orchestrator action loop",
         ("kind",),
-    ).labels(kind=kind).inc()
+    ).labels(kind=kind).inc(n)
     if e2e is not None:
         reg.histogram(
             EVENT_E2E,
@@ -277,6 +285,33 @@ def entity_stalled(entity: str) -> None:
         "released)",
         ("entity",),
     ).labels(entity=_entity_label(reg, entity)).inc()
+
+
+def edge_decision(entity: str, n: int = 1) -> None:
+    """``n`` edge-decided events reconciled into the orchestrator via
+    asynchronous backhaul (the zero-RTT dispatch path) — every one was
+    dispatched at the edge without a central round trip."""
+    if n <= 0 or not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.counter(
+        EDGE_DECISIONS,
+        "events decided and dispatched at the edge against a "
+        "published delay table",
+        ("entity",),
+    ).labels(entity=_entity_label(reg, entity)).inc(n)
+
+
+def table_version(version: int) -> None:
+    """The monotonic version of the currently published delay table
+    (bumped on every search-plane install, withdrawal, or
+    suspend/resume — policy/edge_table.py)."""
+    if not metrics.enabled():
+        return
+    metrics.get().gauge(
+        TABLE_VERSION,
+        "monotonic version of the published hash->delay table",
+    ).set(version)
 
 
 def chaos_fault_injected(point: str) -> None:

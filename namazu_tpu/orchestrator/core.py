@@ -98,6 +98,16 @@ class Orchestrator:
         # when it is seen again (metric + warning fire per transition,
         # not per sweep)
         self._stalled: set = set()
+        # zero-RTT dispatch (doc/performance.md): a policy that
+        # publishes its delay table (policy/edge_table.py) plugs its
+        # publisher into the hub so endpoints can serve/version it;
+        # suspended while orchestration is disabled — edges must not
+        # keep deciding with a table the passthrough policy would not
+        # have applied
+        pub = getattr(policy, "table_publisher", None)
+        self.hub.table_publisher = pub
+        if pub is not None and not self.enabled:
+            pub.suspend()
 
     @staticmethod
     def _default_hub(config: Config) -> EndpointHub:
@@ -112,6 +122,15 @@ class Orchestrator:
             hub.add_endpoint(RestEndpoint(
                 port=rest_port,
                 # bounded ingress (doc/robustness.md): 0 = unbounded
+                ingress_cap=int(config.get("rest_ingress_cap", 0) or 0)))
+        uds_path = str(config.get("uds_path", "") or "")
+        if uds_path:
+            from namazu_tpu.endpoint.uds import UdsEndpoint
+
+            # same hub, same bound: the ingress cap protects the
+            # orchestrator's event queue, whichever wire feeds it
+            hub.add_endpoint(UdsEndpoint(
+                uds_path,
                 ingress_cap=int(config.get("rest_ingress_cap", 0) or 0)))
         agent_port = int(config.get("agent_port", -1))
         if agent_port >= 0:
@@ -241,6 +260,21 @@ class Orchestrator:
             if ep is not None and hasattr(ep, "sever"):
                 ep.sever()
         self.hub.shutdown()
+        # a real SIGKILL takes the policy's delay queue with it: close
+        # + drain WITHOUT releasing, or the still-parked items would be
+        # dispatched by the leaked (daemon) release worker when their
+        # delays expire — a dead orchestrator's policy emitting actions
+        # minutes later, stamping records into whatever flight-recorder
+        # run is current by then. The items die here; only the journal-
+        # recovering successor resurrects them.
+        for pol in (self.policy, self.dumb):
+            q = getattr(pol, "_queue", None)
+            if q is not None:
+                try:
+                    q.close()
+                    q.drain_remaining()
+                except Exception:  # pragma: no cover - best effort
+                    pass
         if self.journal is not None:
             self.journal.close()
         obs.end_run(self.run_id)
@@ -274,6 +308,22 @@ class Orchestrator:
                     stop = True
                     break
                 batch.append(nxt)
+            # edge-decided events (backhaul reconciliation,
+            # doc/performance.md "Zero-RTT dispatch") never reach the
+            # policy OR the journal: the edge already decided and
+            # dispatched them — they only need their trace records and
+            # synthetic actions. Partitioned BEFORE the journal append,
+            # or recovery would re-dispatch an already-answered event.
+            edge_batch = [ev for ev in batch
+                          if getattr(ev, "_edge_decision", None)]
+            if edge_batch:
+                batch = [ev for ev in batch
+                         if getattr(ev, "_edge_decision", None) is None]
+                self._ingest_edge_batch(edge_batch)
+                if not batch:
+                    if stop:
+                        return
+                    continue
             if self.journal is not None:
                 # write-ahead: the batch is durable BEFORE the policy
                 # sees it, so a crash from here on can lose nothing
@@ -324,6 +374,26 @@ class Orchestrator:
                                         obs.latency(ev, "intercepted"))
             if stop:
                 return
+
+    def _ingest_edge_batch(self, events: list) -> None:
+        """Reconcile backhauled edge decisions: one complete flight-
+        recorder record per event with the EDGE's own lifecycle stamps
+        (same host, shared CLOCK_MONOTONIC) and the decision detail
+        (``decision_source="edge"``, ``table_version``, delay), plus
+        the synthesized accepting action appended straight to the
+        collected trace — the edge already delivered the real action,
+        so nothing is forwarded, journaled, or queued through the
+        policy/action loops."""
+        policy_name = (self.policy if self.enabled else self.dumb).name
+        for ev in events:
+            d = ev._edge_decision
+            action = ev.default_action()
+            action.mark_triggered(now=d.get("triggered_wall"))
+            obs.record_edge(ev, getattr(ev, "_edge_endpoint", ""),
+                            policy_name, action, d)
+            if self.collect_trace:
+                self.trace.append(action)
+        obs.action_dispatched("edge", None, n=len(events))
 
     def _forward_loop_factory(self, policy: ExplorePolicy):
         def loop() -> None:
@@ -440,10 +510,17 @@ class Orchestrator:
             ctrl = self.hub.control_queue.get()
             if ctrl is _STOP:
                 return
+            pub = self.hub.table_publisher
             if ctrl.op is ControlOp.ENABLE_ORCHESTRATION:
                 self.enabled = True
+                if pub is not None:
+                    pub.resume()
             elif ctrl.op is ControlOp.DISABLE_ORCHESTRATION:
                 self.enabled = False
+                if pub is not None:
+                    # edges must stop deciding with the table: central
+                    # decisions now come from the passthrough policy
+                    pub.suspend()
             log.info("orchestration enabled=%s", self.enabled)
 
 

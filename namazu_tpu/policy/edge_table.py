@@ -1,0 +1,129 @@
+"""Table publication: the zero-RTT dispatch plane's versioned source.
+
+The TPU policy's steady-state decision is a pure function of the event
+hint — fnv64a bucket -> delay-table lookup (policy/replayable.py,
+policy/tpu.py). That makes the decision *publishable*: the orchestrator
+versions the currently-installed hash->delay table and serves it to
+endpoints and transceivers (``GET /api/v3/policy/table``, the
+``table`` op on the UDS wire, and piggybacked version headers on batch
+responses), so an edge holding a current table computes each event's
+delay locally and releases it without phoning home first
+(doc/performance.md "Zero-RTT dispatch").
+
+:class:`TablePublisher` is that source of truth. The contract:
+
+* ``version`` is **monotonic** and bumps on *every* state change —
+  every search-plane install (eligible or not), every suspend/resume.
+  An edge comparing its held version against any piggybacked version
+  can therefore always detect staleness, and no event is ever decided
+  under an ambiguous version (each decision captures the version of
+  the exact table object it used).
+* ``current()`` returns ``(version, doc_or_None)``. ``None`` means
+  "this version has no publishable table" — the policy installed a
+  fault-bearing or reorder-mode table, orchestration is disabled, or
+  nothing was ever installed. Edges holding no doc fall back
+  transparently to the central (PR 5 batched) wire, so non-table
+  policies and cold-start windows are untouched.
+
+The published doc is plain JSON::
+
+    {"version": V, "mode": "delay", "H": H,
+     "max_interval": S, "delays": [float x H]}
+
+Decision semantics are pinned bit-for-bit: the edge computes
+``delays[fnv64a(hint) % H]`` — exactly the central
+``TPUSearchPolicy._delay_for`` — and JSON round-trips IEEE doubles
+exactly, so an edge-decided run and a central run over the same seed
+produce identical delays (the trace-differ equivalence test).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from namazu_tpu import obs
+
+__all__ = ["TablePublisher", "TABLE_VERSION_HEADER"]
+
+#: the HTTP header piggybacking the current table version on batch
+#: POST / batch poll responses (the UDS wire carries the same value as
+#: a ``table_version`` response field)
+TABLE_VERSION_HEADER = "X-Nmz-Table-Version"
+
+
+class TablePublisher:
+    """Thread-safe versioned holder of the publishable delay table."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._version = 0
+        self._doc: Optional[Dict[str, Any]] = None
+        self._suspended = False
+
+    @property
+    def version(self) -> int:
+        # int read is GIL-atomic: the hot path (per-decision version
+        # tagging) must not pay a lock for it
+        return self._version
+
+    def publish(self, delays, H: int, max_interval: float) -> int:
+        """Install ``delays`` as the new publishable table; returns the
+        new version. ``delays`` is any float sequence of length H."""
+        doc = {
+            "mode": "delay",
+            "H": int(H),
+            "max_interval": float(max_interval),
+            "delays": [float(x) for x in delays],
+        }
+        with self._lock:
+            self._version += 1
+            doc["version"] = self._version
+            self._doc = doc
+            version = self._version
+        obs.table_version(version)
+        return version
+
+    def publish_none(self) -> int:
+        """The current install is NOT edge-eligible (fault-bearing,
+        reorder mode): bump the version and withdraw the doc, so edges
+        holding an older table notice within one batch and fall back to
+        the central wire — loss-free."""
+        with self._lock:
+            self._version += 1
+            self._doc = None
+            version = self._version
+        obs.table_version(version)
+        return version
+
+    def suspend(self) -> None:
+        """Hide the doc (orchestration disabled): edges must stop
+        deciding locally — central decisions now come from the
+        passthrough ``dumb`` policy, not the table."""
+        with self._lock:
+            if self._suspended:
+                return
+            self._suspended = True
+            self._version += 1
+            version = self._version
+        obs.table_version(version)
+
+    def resume(self) -> None:
+        """Re-expose the held doc (orchestration re-enabled)."""
+        with self._lock:
+            if not self._suspended:
+                return
+            self._suspended = False
+            self._version += 1
+            if self._doc is not None:
+                self._doc = dict(self._doc, version=self._version)
+            version = self._version
+        obs.table_version(version)
+
+    def current(self) -> Tuple[int, Optional[Dict[str, Any]]]:
+        """``(version, doc_or_None)`` — the doc always carries its own
+        version (a fetched table can never be mis-attributed)."""
+        with self._lock:
+            if self._suspended:
+                return self._version, None
+            return self._version, self._doc

@@ -29,6 +29,7 @@ from typing import Optional
 
 from namazu_tpu import obs
 from namazu_tpu.policy.base import QueueBackedPolicy, register_policy
+from namazu_tpu.policy.edge_table import TablePublisher
 from namazu_tpu.policy.replayable import (
     fnv64a,
     fnv64a_many,
@@ -167,9 +168,18 @@ class TPUSearchPolicy(QueueBackedPolicy):
 
         self._rng = _random.Random(0)
         self._proc_policy = create_proc_subpolicy("mild", self._rng)
-        # installed schedule tables (numpy arrays; rebinding is atomic)
-        self._delays = None
-        self._faults = None
+        # installed schedule tables: ONE (delays, faults, version)
+        # snapshot, rebound atomically — a decision reading it pairs a
+        # delay with the version of the exact table that produced it,
+        # even mid-install (the _delays/_faults properties are derived
+        # views for the non-decision call sites)
+        self._installed = None
+        # zero-RTT dispatch (doc/performance.md): the versioned
+        # publication of the installed delay table. The orchestrator
+        # plugs this into its hub; endpoints serve it to edges. Every
+        # install (eligible or not) bumps the version, so edges notice
+        # staleness within one batch.
+        self.table_publisher = TablePublisher()
         self._fault_coin = None  # cached per-(seed, H), see _coin_table
         self._search = None
         self._search_thread: Optional[threading.Thread] = None
@@ -289,13 +299,37 @@ class TPUSearchPolicy(QueueBackedPolicy):
     def _bucket(self, hint: str) -> int:
         return fnv64a(hint.encode()) % self.H
 
-    def _delay_for(self, hint: str) -> float:
-        delays = self._delays
-        if delays is None:
-            return hint_delay(str(self.seed), hint, self.max_interval)
-        return float(delays[self._bucket(hint)])
+    @property
+    def _delays(self):
+        installed = self._installed
+        return installed[0] if installed is not None else None
 
-    def _delays_for_many(self, hints):
+    @property
+    def _faults(self):
+        installed = self._installed
+        return installed[1] if installed is not None else None
+
+    def _decision_ctx(self):
+        """ONE atomic read of the installed snapshot, shared by a whole
+        decision (or decision batch): ``(snapshot_or_None, source tag,
+        record extra)``. Deriving the delay AND the recorded
+        ``table_version`` from the same snapshot means a concurrent
+        install can never produce a record whose version belongs to a
+        different table than its delay."""
+        installed = self._installed
+        if installed is None:
+            return None, "hash", {}
+        return installed, "table", {"table_version": installed[2]}
+
+    def _delay_from(self, installed, hint: str) -> float:
+        if installed is None:
+            return hint_delay(str(self.seed), hint, self.max_interval)
+        return float(installed[0][self._bucket(hint)])
+
+    def _delay_for(self, hint: str) -> float:
+        return self._delay_from(self._installed, hint)
+
+    def _delays_from_many(self, installed, hints):
         """Vectorized :meth:`_delay_for` over a batch of hints: one
         fnv64a pass over the whole batch (numpy loop over byte
         positions, policy/replayable.py fnv64a_many) and one fancy-index
@@ -304,12 +338,14 @@ class TPUSearchPolicy(QueueBackedPolicy):
         ndarray of shape ``[len(hints)]``."""
         import numpy as _np
 
-        delays = self._delays
-        if delays is None:
+        if installed is None:
             return hint_delays(str(self.seed), hints, self.max_interval)
         buckets = fnv64a_many([h.encode() for h in hints]) \
             % _np.uint64(self.H)
-        return _np.asarray(delays)[buckets.astype(_np.int64)]
+        return _np.asarray(installed[0])[buckets.astype(_np.int64)]
+
+    def _delays_for_many(self, hints):
+        return self._delays_from_many(self._installed, hints)
 
     def _coin_table(self):
         """Per-bucket fault coin, computed once per (seed, H) — the SAME
@@ -339,6 +375,44 @@ class TPUSearchPolicy(QueueBackedPolicy):
         flight recorder's causal tag for each decision."""
         return "hash" if self._delays is None else "table"
 
+    # -- table install + publication (zero-RTT dispatch) -----------------
+
+    def _install_tables(self, delays, faults, source: str) -> None:
+        """The ONE install seam: publish for the edge plane first (that
+        mints the version), then swap the hot-path snapshot — table and
+        its version rebound together, so decisions racing the install
+        see either the old pair or the new pair, never a mix."""
+        obs.schedule_install(source)
+        obs.record_install(source)
+        version = self._publish_table(delays, faults)
+        self._installed = (delays, faults, version)
+
+    def install_table(self, delays, faults=None,
+                      source: str = "manual") -> None:
+        """Public install (bench/tests/chaos harness): installs
+        ``delays`` exactly like a search-plane install would, including
+        the edge publication."""
+        import numpy as _np
+
+        delays = _np.asarray(delays, dtype=_np.float64)
+        if delays.shape != (self.H,):
+            raise ValueError(
+                f"delays shape {delays.shape} != (H={self.H},)")
+        self._install_tables(delays, faults, source)
+
+    def _publish_table(self, delays, faults) -> int:
+        """Publish ``delays`` when it is edge-eligible — the
+        steady-state decision must be the pure hint->delay function the
+        edge replicates. Fault-bearing or reorder-mode installs publish
+        a *withdrawal* instead (version bump, no doc): edges fall back
+        to the central wire, loss-free. Returns the minted version."""
+        eligible = (delays is not None and self.release_mode == "delay"
+                    and (faults is None or self.max_fault <= 0))
+        if eligible:
+            return self.table_publisher.publish(delays, self.H,
+                                                self.max_interval)
+        return self.table_publisher.publish_none()
+
     def queue_event(self, event: Event) -> None:
         self.start()
         if isinstance(event, ProcSetEvent):
@@ -355,11 +429,13 @@ class TPUSearchPolicy(QueueBackedPolicy):
                 # so no transceiver hangs on a never-emitted action
                 self._emit(self._action_for(event))
                 return
-            prio = self._delay_for(event.replay_hint())
+            installed, source, extra = self._decision_ctx()
+            prio = self._delay_from(installed, event.replay_hint())
             obs.record_decision(
                 event, self.name, mode="reorder", priority=prio,
-                source=self._table_source(),
-                generation=obs.current_generation_id())
+                source=source,
+                generation=obs.current_generation_id(),
+                **extra)
             now = self._now()
             with self._pending_lock:
                 if self._anchor is None:
@@ -372,10 +448,12 @@ class TPUSearchPolicy(QueueBackedPolicy):
                 # drain again (idempotent) so the event is not stranded
                 self._drain_pending(gap=0.0)
             return
-        delay = self._delay_for(event.replay_hint())
+        installed, source, extra = self._decision_ctx()
+        delay = self._delay_from(installed, event.replay_hint())
         obs.record_decision(event, self.name, mode="delay", delay=delay,
-                            source=self._table_source(),
-                            generation=obs.current_generation_id())
+                            source=source,
+                            generation=obs.current_generation_id(),
+                            **extra)
         self._queue.put_at(event, delay)
 
     def _queue_events_batch(self, events) -> list:
@@ -417,15 +495,16 @@ class TPUSearchPolicy(QueueBackedPolicy):
                                   "shutdown flush", event)
                     rejected.append(event)
             return rejected
-        vals = self._delays_for_many([ev.replay_hint() for ev in plain])
-        source = self._table_source()
+        installed, source, extra = self._decision_ctx()
+        vals = self._delays_from_many(
+            installed, [ev.replay_hint() for ev in plain])
         generation = obs.current_generation_id()
         if self.release_mode == "reorder":
             for event, prio in zip(plain, vals):
                 obs.record_decision(
                     event, self.name, mode="reorder",
                     priority=float(prio), source=source,
-                    generation=generation)
+                    generation=generation, **extra)
             now = self._now()
             with self._pending_lock:
                 if self._anchor is None:
@@ -441,7 +520,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
         for event, delay in zip(plain, vals):
             obs.record_decision(event, self.name, mode="delay",
                                 delay=float(delay), source=source,
-                                generation=generation)
+                                generation=generation, **extra)
         self._queue.put_at_many(
             (event, float(delay)) for event, delay in zip(plain, vals))
         return rejected
@@ -688,10 +767,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
         except Exception:
             log.exception("unreadable checkpoint %s", ckpt)
             return False
-        self._delays = delays
-        self._faults = faults
-        obs.schedule_install("checkpoint")
-        obs.record_install("checkpoint")
+        self._install_tables(delays, faults, "checkpoint")
         log.info("installed checkpointed schedule (fitness %.4f) from %s",
                  fit, ckpt)
         return True
@@ -767,10 +843,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
 
                 b = search.best()
                 if _np.isfinite(b.fitness):
-                    self._delays = b.delays
-                    self._faults = b.faults
-                    obs.schedule_install("checkpoint")
-                    obs.record_install("checkpoint")
+                    self._install_tables(b.delays, b.faults, "checkpoint")
                     log.info(
                         "installed checkpointed schedule (fitness %.4f) "
                         "before this run's search", b.fitness)
@@ -781,10 +854,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
                 return
             best = search.run(references, generations=self.generations)
             with obs.search_phase("install"):
-                self._delays = best.delays
-                self._faults = best.faults
-            obs.schedule_install("search")
-            obs.record_install("search")
+                self._install_tables(best.delays, best.faults, "search")
             log.info("installed searched schedule (fitness %.4f, gen %d)",
                      best.fitness, search.generations_run)
             if ckpt:
@@ -857,10 +927,9 @@ class TPUSearchPolicy(QueueBackedPolicy):
             log.info("sidecar: no stored history yet; keeping current "
                      "delays")
             return
-        self._delays = _np.asarray(resp["delays"], _np.float32)
-        self._faults = _np.asarray(resp["faults"], _np.float32)
-        obs.schedule_install("sidecar")
-        obs.record_install("sidecar")
+        self._install_tables(_np.asarray(resp["delays"], _np.float32),
+                             _np.asarray(resp["faults"], _np.float32),
+                             "sidecar")
         log.info("installed sidecar schedule (fitness %.4f, gen %d)",
                  resp["fitness"], resp["generations_run"])
         self._knowledge_push_best(self._delays, float(resp["fitness"]))
@@ -916,9 +985,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
             return False
         if table is None:
             return False
-        self._delays = table["delays"]
-        obs.schedule_install("knowledge")
-        obs.record_install("knowledge")
+        self._install_tables(table["delays"], self._faults, "knowledge")
         obs.knowledge_warmstart("table")
         log.info("installed knowledge warm-start schedule (fitness "
                  "%.4f, scenario %s)", table["fitness"], self.scenario)

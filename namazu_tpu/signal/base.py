@@ -12,10 +12,49 @@ option.
 from __future__ import annotations
 
 import json
+import os
+import random as _random
+import threading
 import time
 import uuid as uuid_mod
 from enum import Enum
 from typing import Any, Dict, Iterable, Optional, Type
+
+
+# -- uuid minting ---------------------------------------------------------
+#
+# Every signal mints a uuid, which makes uuid cost part of the event
+# plane's per-event budget. ``uuid.uuid4()`` draws from os.urandom —
+# one syscall per id, and on some kernels/containers that syscall runs
+# hundreds of µs, at which point it dominates the entire serving path
+# (it was ~90% of the per-event cost on the 2-core loopback rig,
+# bench.py --pipeline). Signal uuids are correlation keys, not security
+# tokens: mint them from a process-local PRNG seeded ONCE from
+# os.urandom + pid (so forked children and parallel processes diverge),
+# formatted as canonical RFC-4122 v4 strings for wire compatibility.
+# 128 random bits keep collisions as improbable as uuid4's.
+
+def _seed_uuid_rng() -> None:
+    global _uuid_bits
+    _uuid_bits = _random.Random(
+        int.from_bytes(os.urandom(16), "big") ^ (os.getpid() << 96)
+        ^ threading.get_ident()).getrandbits
+
+
+_seed_uuid_rng()
+# re-seed after fork (no per-call getpid syscall): two children must
+# not replay one uuid stream
+if hasattr(os, "register_at_fork"):  # pragma: no branch
+    os.register_at_fork(after_in_child=_seed_uuid_rng)
+
+
+def fast_uuid4() -> str:
+    """A random uuid string without the per-call urandom syscall."""
+    h = "%032x" % _uuid_bits(128)
+    # canonical v4 layout (version + variant nibbles), same shape
+    # uuid.uuid4() serializes to
+    return (f"{h[:8]}-{h[8:12]}-4{h[13:16]}-"
+            f"{'89ab'[int(h[16], 16) & 3]}{h[17:20]}-{h[20:32]}")
 
 
 # Version tag of the replay-hint format (the strings replay_hint()
@@ -97,7 +136,7 @@ class Signal:
     ):
         self.entity_id = str(entity_id)
         self.option: Dict[str, Any] = dict(option or {})
-        self.uuid = uuid or str(uuid_mod.uuid4())
+        self.uuid = uuid or fast_uuid4()
         self.arrived: Optional[float] = None
         self._validate_option()
 

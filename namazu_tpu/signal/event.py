@@ -37,6 +37,11 @@ class Event(Signal):
         no semantic identity, e.g. Nop)."""
         return ""
 
+    #: lazily-cached (EventAcceptanceAction, NopAction) — the import
+    #: cannot run at module load (action.py imports this module), and a
+    #: per-call import costs µs on a path the event plane pays per event
+    _DEFAULT_ACTION_CLASSES = None
+
     def default_action(self) -> "Action":
         """The action a policy should emit when it has no opinion.
 
@@ -44,11 +49,18 @@ class Event(Signal):
         (/root/reference/nmz/signal/event.go:40-55): accept if deferred,
         else no-op.
         """
-        from namazu_tpu.signal.action import EventAcceptanceAction, NopAction
+        classes = Event._DEFAULT_ACTION_CLASSES
+        if classes is None:
+            from namazu_tpu.signal.action import (
+                EventAcceptanceAction,
+                NopAction,
+            )
 
+            classes = Event._DEFAULT_ACTION_CLASSES = (
+                EventAcceptanceAction, NopAction)
         if self.deferred:
-            return EventAcceptanceAction.for_event(self)
-        return NopAction.for_event(self)
+            return classes[0].for_event(self)
+        return classes[1].for_event(self)
 
     def default_fault_action(self) -> Optional["Action"]:
         """The fault this event supports, or None."""

@@ -456,7 +456,7 @@ def test_tpu_policy_batch_decisions_match_scalar():
     assert [pol._delay_for(h) for h in hints] == \
         pytest.approx(list(batch))
     # installed-table path
-    pol._delays = np.linspace(0.0, 0.05, pol.H).astype(np.float32)
+    pol.install_table(np.linspace(0.0, 0.05, pol.H))
     batch = pol._delays_for_many(hints)
     assert [pol._delay_for(h) for h in hints] == \
         pytest.approx(list(batch))

@@ -472,7 +472,7 @@ def test_knowledge_chaos_outage_seam_degrades():
 def test_harness_scenarios_green(tmp_path):
     from namazu_tpu.chaos.harness import run_scenario
 
-    for name in ("wire_dup", "storage_torn"):
+    for name in ("wire_dup", "storage_torn", "edge_stale"):
         res = run_scenario(name, 1234, str(tmp_path / name), events=4)
         assert res["ok"], json.dumps(res["invariants"], default=str)
         assert all(v["ok"] for v in res["invariants"].values())
@@ -486,6 +486,47 @@ def test_harness_crash_restart_exactly_once(tmp_path):
     inv = res["invariants"]
     assert inv["journal_recovered_all"]["recovered"] == 8  # 2 entities
     assert inv["exactly_once"]["doubles"] == {}
+
+
+def test_abandon_kills_parked_releases(tmp_path):
+    """An abandoned (simulated kill -9) orchestrator's policy must not
+    dispatch its parked events when their delays later expire — the
+    leaked daemon release worker would otherwise stamp a DEAD run's
+    actions into whatever flight-recorder run is current by then
+    (found as cross-test record contamination ~30s after the crash
+    scenario)."""
+    from namazu_tpu.orchestrator import Orchestrator
+    from namazu_tpu.policy import create_policy
+    from namazu_tpu.utils.config import Config
+
+    cfg = Config({
+        "rest_port": 0,
+        "run_id": "abandon-zombie",
+        "explore_policy": "random",
+        "explore_policy_param": {
+            "seed": 3, "min_interval": "300ms",
+            "max_interval": "300ms",
+            "fault_action_probability": 0.0,
+            "shell_action_interval": 0},
+    })
+    policy = create_policy("random")
+    policy.load_config(cfg)
+    orc = Orchestrator(cfg, policy, collect_trace=True)
+    orc.start()
+    for i in range(4):
+        orc.hub.post_events(
+            [PacketEvent.create("z0", "z0", "peer", hint=f"h{i}")],
+            "rest")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and len(policy._queue) < 4:
+        time.sleep(0.01)
+    assert len(policy._queue) == 4  # parked on their 300ms delays
+    orc.abandon()
+    assert len(policy._queue) == 0  # taken by the "crash", unreleased
+    trace_at_crash = len(orc.trace)
+    time.sleep(0.5)  # past the delays: the zombie would fire here
+    assert policy.action_out.qsize() == 0
+    assert len(orc.trace) == trace_at_crash  # nothing released post-mortem
 
 
 def test_chaos_cli_list_and_smoke(tmp_path, capsys):

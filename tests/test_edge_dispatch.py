@@ -1,0 +1,974 @@
+"""Zero-RTT edge dispatch (doc/performance.md "Zero-RTT dispatch").
+
+Covers the ISSUE-8 acceptance set: table-publication semantics
+(monotonic versions, withdrawal, suspend/resume), bit-exact edge
+decisions, the trace-differ equivalence between an edge-decided run and
+a central run over the same seed (identical dispatch orders AND delays,
+modulo the ``decision_source`` tag), table-version rollover while edges
+are mid-batch (re-sync within one batch, exactly one unambiguous
+``table_version`` per record, loss-free fallback to the central wire),
+the shutdown backhaul-flush guarantee, and the ``uds://`` framed wire.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from namazu_tpu import chaos, obs
+from namazu_tpu.chaos.plan import FaultPlan
+from namazu_tpu.inspector.edge import EdgeDispatcher, EdgeTable
+from namazu_tpu.inspector.rest_transceiver import RestTransceiver
+from namazu_tpu.inspector.uds_transceiver import UdsTransceiver
+from namazu_tpu.obs import export, metrics, recorder
+from namazu_tpu.obs.metrics import MetricsRegistry
+from namazu_tpu.orchestrator import Orchestrator
+from namazu_tpu.policy import create_policy
+from namazu_tpu.policy.edge_table import TablePublisher
+from namazu_tpu.policy.replayable import fnv64a
+from namazu_tpu.signal import EventAcceptanceAction, PacketEvent
+from namazu_tpu.signal.action import Action
+from namazu_tpu.utils.config import Config
+
+
+@pytest.fixture(autouse=True)
+def fresh_obs():
+    old_reg = metrics.set_registry(MetricsRegistry())
+    metrics.configure(True)
+    old_rec = recorder.set_recorder(recorder.FlightRecorder())
+    yield
+    metrics.set_registry(old_reg)
+    metrics.configure(True)
+    recorder.set_recorder(old_rec)
+
+
+@pytest.fixture(autouse=True)
+def no_chaos():
+    chaos.clear()
+    yield
+    chaos.clear()
+
+
+# -- TablePublisher ------------------------------------------------------
+
+
+def test_publisher_versions_are_monotonic_across_all_state_changes():
+    pub = TablePublisher()
+    v0, doc = pub.current()
+    assert v0 == 0 and doc is None
+    v1 = pub.publish([0.0, 0.5], H=2, max_interval=0.5)
+    v2 = pub.publish([0.1, 0.2], H=2, max_interval=0.5)
+    v3 = pub.publish_none()
+    pub.suspend()
+    pub.resume()
+    v4, doc = pub.current()
+    assert [v1, v2, v3] == [1, 2, 3]
+    # suspend and resume each bump too: any edge can detect the change
+    assert v4 > v3 and doc is None  # withdrawn at v3, still no doc
+
+
+def test_publisher_doc_carries_its_own_version():
+    pub = TablePublisher()
+    pub.publish([0.0], H=1, max_interval=0.0)
+    version, doc = pub.current()
+    assert doc["version"] == version
+    # resume re-stamps the held doc so it can never be mis-attributed
+    pub.suspend()
+    assert pub.current()[1] is None  # hidden while suspended
+    pub.resume()
+    version2, doc2 = pub.current()
+    assert doc2["version"] == version2 > version
+    assert doc2["delays"] == doc["delays"]
+
+
+# -- EdgeTable: bit-exact decisions --------------------------------------
+
+
+def test_edge_table_delay_matches_central_formula():
+    H = 64
+    delays = [(i * 7 % 13) / 100.0 for i in range(H)]
+    table = EdgeTable({"version": 3, "mode": "delay", "H": H,
+                       "max_interval": 0.13, "delays": delays})
+    for hint in [f"src->dst:{i}" for i in range(200)]:
+        assert table.delay_for(hint) == delays[fnv64a(hint.encode()) % H]
+    # memoized second pass returns the identical values
+    for hint in [f"src->dst:{i}" for i in range(200)]:
+        assert table.delay_for(hint) == delays[fnv64a(hint.encode()) % H]
+
+
+def test_edge_table_rejects_malformed_docs():
+    with pytest.raises(ValueError):
+        EdgeTable({"version": 1, "mode": "reorder", "H": 1,
+                   "delays": [0.0]})
+    with pytest.raises(ValueError):
+        EdgeTable({"version": 1, "mode": "delay", "H": 2,
+                   "delays": [0.0]})  # length != H
+
+
+def test_fast_mint_equals_for_event_field_for_field():
+    """The edge's ``object.__new__`` action mint must stay
+    indistinguishable from the canonical ``Action.for_event`` path —
+    the contract that lets it skip ``Signal.__init__``."""
+    ev = PacketEvent.create("e0", "e0", "peer", hint="hX")
+    ev.mark_arrived()
+    fast = EdgeDispatcher._accept_action(ev, ev.replay_hint())
+    slow = EventAcceptanceAction.for_event(ev)
+    assert isinstance(fast, EventAcceptanceAction)
+    for attr in ("entity_id", "option", "event_uuid", "event_class",
+                 "event_hint", "event_arrived", "triggered_time"):
+        assert getattr(fast, attr) == getattr(slow, attr), attr
+    assert fast.to_jsonable().keys() == slow.to_jsonable().keys()
+    assert len(fast.uuid) == 36 and fast.uuid != slow.uuid
+
+
+# -- EdgeDispatcher unit behavior ----------------------------------------
+
+
+def _dispatcher(table_docs, delivered, sent, window=10.0):
+    """An EdgeDispatcher over fake callbacks: ``table_docs`` is a
+    mutable [ (version, doc) ] cell the fetch reads."""
+    def fetch():
+        return table_docs[0]
+
+    def backhaul(entity, items):
+        sent.append((entity, items))
+        return table_docs[0][0]
+
+    return EdgeDispatcher("e0", deliver=delivered.append,
+                          fetch_table=fetch, send_backhaul=backhaul,
+                          backhaul_window=window)
+
+
+def _table_doc(version, delays, max_interval=1.0):
+    return {"version": version, "mode": "delay", "H": len(delays),
+            "max_interval": max_interval, "delays": delays}
+
+
+def test_dispatcher_decides_locally_and_backhauls():
+    delivered, sent = [], []
+    docs = [(1, _table_doc(1, [0.0] * 8))]
+    d = _dispatcher(docs, delivered, sent)
+    assert d.sync() == 1 and d.active
+    evs = [PacketEvent.create("e0", "e0", "peer", hint=f"h{i}")
+           for i in range(5)]
+    rejected = d.try_dispatch_batch(evs)
+    assert rejected == [] and len(delivered) == 5
+    for ev, action in zip(evs, delivered):
+        assert isinstance(action, EventAcceptanceAction)
+        assert action.event_uuid == ev.uuid
+    assert d.pending_backhaul() == 5
+    d.shutdown()
+    assert d.pending_backhaul() == 0
+    items = [item for _, chunk in sent for item in chunk]
+    assert len(items) == 5
+    for item in items:
+        dec = item["decision"]
+        assert dec["decision_source"] == "edge"
+        assert dec["table_version"] == 1
+        assert dec["delay"] == 0.0
+
+
+def test_dispatcher_without_table_rejects_everything():
+    delivered, sent = [], []
+    docs = [(0, (0, None))]
+    d = EdgeDispatcher("e0", deliver=delivered.append,
+                       fetch_table=lambda: (0, None),
+                       send_backhaul=lambda e, i: 0)
+    evs = [PacketEvent.create("e0", "e0", "peer", hint="h")]
+    assert d.try_dispatch_batch(evs) == evs
+    assert not d.try_dispatch(evs[0])
+    assert delivered == []
+
+
+def test_rollover_resyncs_within_one_batch_and_versions_stay_unambiguous():
+    """A concurrent publish while the edge is mid-stream: the next
+    piggybacked version triggers a re-sync, every decision carries
+    exactly the version of the table object that made it, and no event
+    is lost."""
+    delivered, sent = [], []
+    docs = [(1, _table_doc(1, [0.0] * 8))]
+    d = _dispatcher(docs, delivered, sent, window=0.0)
+    assert d.sync() == 1
+
+    first = [PacketEvent.create("e0", "e0", "peer", hint=f"a{i}")
+             for i in range(3)]
+    assert d.try_dispatch_batch(first) == []
+
+    # server-side rollover to v2; the edge learns via any piggyback
+    docs[0] = (2, _table_doc(2, [0.0] * 8))
+    d.note_server_version(2)
+    assert d.table_version == 2
+
+    second = [PacketEvent.create("e0", "e0", "peer", hint=f"b{i}")
+              for i in range(3)]
+    assert d.try_dispatch_batch(second) == []
+    d.shutdown()
+
+    versions = {}
+    for _, chunk in sent:
+        for item in chunk:
+            hint = item["event"]["option"]["replay_hint"]
+            versions.setdefault(hint[0], set()).add(
+                item["decision"]["table_version"])
+    assert versions["a"] == {1}
+    assert versions["b"] == {2}
+    assert len(delivered) == 6
+
+
+def test_rollover_to_withdrawal_falls_back_loss_free():
+    """publish_none mid-run: the edge drops its table and everything
+    after rides the central wire — nothing is decided under a stale
+    table, nothing is lost."""
+    delivered, sent = [], []
+    docs = [(1, _table_doc(1, [0.0] * 8))]
+    d = _dispatcher(docs, delivered, sent, window=0.0)
+    assert d.sync() == 1
+    docs[0] = (2, None)  # withdrawn at v2
+    d.note_server_version(2)
+    assert not d.active
+    evs = [PacketEvent.create("e0", "e0", "peer", hint="h")]
+    assert d.try_dispatch_batch(evs) == evs  # central fallback
+    # and a later piggyback of the SAME withdrawn version does not
+    # re-trigger fetch churn
+    d.note_server_version(2)
+    assert not d.active
+    d.shutdown()
+
+
+def test_sync_drops_table_first_on_fetch_failure():
+    """A fetch failure can never leave a known-stale table active."""
+    delivered, sent = [], []
+    docs = [(1, _table_doc(1, [0.0] * 4))]
+    d = _dispatcher(docs, delivered, sent)
+    assert d.sync() == 1
+
+    def boom():
+        raise OSError("wire down")
+
+    d._fetch_table = boom
+    assert d.sync() is None
+    assert not d.active
+
+
+def test_chaos_stale_seam_holds_the_old_table():
+    delivered, sent = [], []
+    docs = [(1, _table_doc(1, [0.0] * 4))]
+    d = _dispatcher(docs, delivered, sent)
+    assert d.sync() == 1
+    docs[0] = (2, _table_doc(2, [0.0] * 4))
+    chaos.install(FaultPlan(7, {"table.publish.stale": {"prob": 1.0}}))
+    d.note_server_version(2)
+    assert d.table_version == 1  # held stale by the seam
+    chaos.clear()
+    d.note_server_version(2)  # seam off: the same piggyback re-syncs
+    assert d.table_version == 2
+    d.shutdown()
+
+
+def test_shutdown_flushes_pending_backhaul_through_transient_failure():
+    """The ISSUE-8 regression guarantee: shutdown with an installed
+    table must flush pending backhaul records before closing — even
+    when the first flush attempt fails transiently."""
+    delivered, sent = [], []
+    fails = {"n": 1}
+
+    def backhaul(entity, items):
+        if fails["n"]:
+            fails["n"] -= 1
+            raise OSError("transient")
+        sent.append((entity, items))
+        return 1
+
+    d = EdgeDispatcher("e0", deliver=delivered.append,
+                       fetch_table=lambda: (1, _table_doc(1, [0.0] * 4)),
+                       send_backhaul=backhaul, backhaul_window=30.0)
+    assert d.sync() == 1
+    evs = [PacketEvent.create("e0", "e0", "peer", hint=f"h{i}")
+           for i in range(4)]
+    assert d.try_dispatch_batch(evs) == []
+    assert d.pending_backhaul() == 4  # window far away: nothing flushed
+    d.shutdown()
+    assert d.pending_backhaul() == 0
+    assert sum(len(c) for _, c in sent) == 4
+
+
+def test_shutdown_delivers_parked_delayed_releases():
+    """Events parked in the delay heap at shutdown are released
+    immediately (the policy-side loss-free flush, mirrored)."""
+    delivered, sent = [], []
+    docs = [(1, _table_doc(1, [5.0] * 4, max_interval=5.0))]
+    d = _dispatcher(docs, delivered, sent)
+    assert d.sync() == 1
+    ev = PacketEvent.create("e0", "e0", "peer", hint="h")
+    assert d.try_dispatch(ev)
+    assert delivered == []  # parked for 5s
+    d.shutdown()
+    assert len(delivered) == 1
+    assert delivered[0].event_uuid == ev.uuid
+
+
+# -- end-to-end over the REST wire ---------------------------------------
+
+
+ENTITIES = ("e0", "e1")
+HINTS = [f"h{i}" for i in range(12)]
+
+
+def _run(run_id, edge, delays=None, uds_path=None, n_rounds=1):
+    """One scripted workload through a real orchestrator; edge=True
+    installs+publishes ``delays`` (default zeros) and syncs the
+    transceivers up front."""
+    cfg_d = {
+        "rest_port": 0,
+        "run_id": run_id,
+        "explore_policy": "tpu_search",
+        "explore_policy_param": {
+            "search_on_start": False,
+            "max_interval": 0,
+            "seed": 7,
+        },
+    }
+    if uds_path:
+        cfg_d["uds_path"] = uds_path
+    cfg = Config(cfg_d)
+    policy = create_policy("tpu_search")
+    policy.load_config(cfg)
+    policy.install_table(
+        delays if delays is not None else [0.0] * policy.H,
+        source="test")
+    orc = Orchestrator(cfg, policy, collect_trace=True)
+    orc.start()
+    port = orc.hub.endpoint("rest").port
+    if uds_path:
+        txs = {e: UdsTransceiver(e, uds_path, edge=edge,
+                                 poll_linger=0.005,
+                                 backhaul_window=0.01)
+               for e in ENTITIES}
+    else:
+        txs = {e: RestTransceiver(e, f"http://127.0.0.1:{port}",
+                                  use_batch=True, flush_window=0.0,
+                                  poll_linger=0.005, edge=edge,
+                                  backhaul_window=0.01)
+               for e in ENTITIES}
+    for t in txs.values():
+        t.start()
+        if edge:
+            assert t.sync_table() is not None, "table sync failed"
+    try:
+        chans = []
+        for _ in range(n_rounds):
+            for hint in HINTS:
+                for e in ENTITIES:
+                    ev = PacketEvent.create(e, e, "peer", hint=hint)
+                    chans.append(txs[e].send_event(ev))
+        for ch in chans:
+            assert ch.get(timeout=15) is not None
+    finally:
+        for t in txs.values():
+            t.shutdown()
+        orc.shutdown()
+    return orc.trace
+
+
+def _records(run_id):
+    run = obs.trace_run(run_id)
+    assert run is not None
+    return [entry["json"] for entry in run.snapshot()["records"]]
+
+
+def test_edge_and_central_runs_are_trace_equivalent():
+    """THE acceptance invariant: same seed, same scripted arrivals —
+    identical dispatch orders and identical per-hint delays, modulo the
+    ``decision_source`` tag."""
+    _run("edge-eq-central", edge=False)
+    _run("edge-eq-edge", edge=True)
+
+    docs_a = _records("edge-eq-central")
+    docs_b = _records("edge-eq-edge")
+    lines_a = export.order_lines_from_docs(docs_a)
+    lines_b = export.order_lines_from_docs(docs_b)
+    assert len(lines_a) == len(HINTS) * len(ENTITIES)
+    diff = export.diff_order(lines_a, lines_b,
+                             "edge-eq-central", "edge-eq-edge")
+    assert diff == "", f"dispatch order diverged:\n{diff}"
+
+    def delays_by_hint(docs):
+        return {(d["entity"], d["hint"]): d["decision"]["delay"]
+                for d in docs if d.get("decision")}
+
+    assert delays_by_hint(docs_a) == delays_by_hint(docs_b)
+
+    # the CLI surface agrees: ``tools trace diff`` exits 0 (same
+    # dispatch order) for the edge vs the central run
+    from namazu_tpu.cli import cli_main
+    assert cli_main(["tools", "trace", "diff",
+                     "edge-eq-central", "edge-eq-edge"]) == 0
+
+    # provenance: central records tag source=table, edge records add
+    # decision_source=edge + the version of the deciding table
+    for d in docs_b:
+        dec = d.get("decision") or {}
+        assert dec.get("decision_source") == "edge"
+        assert isinstance(dec.get("table_version"), int)
+    for d in docs_a:
+        dec = d.get("decision") or {}
+        assert dec.get("decision_source") != "edge"
+
+
+def test_edge_run_produces_complete_flight_records_and_trace():
+    """Backhauled records join every lifecycle stamp and the collected
+    trace matches a central run's shape — analytics and failure ingest
+    see exactly what they see today."""
+    trace = _run("edge-complete", edge=True)
+    docs = _records("edge-complete")
+    assert len(docs) == len(HINTS) * len(ENTITIES)
+    for d in docs:
+        assert d["t"].get("dispatched") is not None
+        assert d["t"].get("intercepted") is not None
+        assert d["hint"]
+    # the collected trace carries one accepting action per event
+    actions = [a for a in trace if isinstance(a, Action)]
+    assert len(actions) == len(HINTS) * len(ENTITIES)
+    # edge decision counter reconciled orchestrator-side
+    reg = metrics.registry()
+    total = sum(
+        reg.value("nmz_edge_decisions_total", entity=e) or 0
+        for e in ENTITIES)
+    assert total == len(HINTS) * len(ENTITIES)
+
+
+def test_edge_run_with_nonzero_delays_matches_central_delays():
+    """Real (non-zero) published delays decide bit-for-bit like the
+    central table over the same hints (JSON round-trips IEEE doubles
+    exactly)."""
+    H = 256
+    delays = [(i % 5) * 0.002 for i in range(H)]
+    _run("edge-dl-central", edge=False, delays=delays)
+    _run("edge-dl-edge", edge=True, delays=delays)
+
+    def delays_by_hint(run_id):
+        return {(d["entity"], d["hint"]): d["decision"]["delay"]
+                for d in _records(run_id) if d.get("decision")}
+
+    a = delays_by_hint("edge-dl-central")
+    b = delays_by_hint("edge-dl-edge")
+    assert a == b and len(a) == len(HINTS) * len(ENTITIES)
+
+
+def test_live_rollover_over_rest_resyncs_and_stays_loss_free():
+    """Concurrent publish while edges are mid-run over the real wire:
+    every record carries exactly one table_version, the edge re-syncs
+    within one batch, and every event is answered."""
+    cfg = Config({
+        "rest_port": 0,
+        "run_id": "edge-rollover",
+        "explore_policy": "tpu_search",
+        "explore_policy_param": {
+            "search_on_start": False, "max_interval": 0, "seed": 7},
+    })
+    policy = create_policy("tpu_search")
+    policy.load_config(cfg)
+    policy.install_table([0.0] * policy.H, source="test")
+    orc = Orchestrator(cfg, policy, collect_trace=False)
+    orc.start()
+    port = orc.hub.endpoint("rest").port
+    tx = RestTransceiver("e0", f"http://127.0.0.1:{port}",
+                         use_batch=True, flush_window=0.0,
+                         poll_linger=0.005, edge=True,
+                         backhaul_window=0.0)
+    tx.start()
+    v1 = tx.sync_table()
+    assert v1 is not None
+    try:
+        chans = [tx.send_event(
+            PacketEvent.create("e0", "e0", "peer", hint=f"r{i}"))
+            for i in range(6)]
+        # rollover mid-run (install → publish bumps the version)
+        policy.install_table([0.0] * policy.H, source="test2")
+        v2 = policy.table_publisher.version
+        assert v2 > v1
+        chans += [tx.send_event(
+            PacketEvent.create("e0", "e0", "peer", hint=f"s{i}"))
+            for i in range(6)]
+        for ch in chans:
+            assert ch.get(timeout=15) is not None
+        deadline = time.monotonic() + 5.0
+        while (tx._edge.table_version not in (None, v2)
+               and time.monotonic() < deadline):
+            time.sleep(0.02)  # backhaul piggyback drives the re-sync
+        assert tx._edge.table_version in (None, v2)
+    finally:
+        tx.shutdown()
+        orc.shutdown()
+    docs = _records("edge-rollover")
+    assert len(docs) == 12  # loss-free across the rollover
+    for d in docs:
+        dec = d.get("decision") or {}
+        assert dec.get("table_version") in (v1, v2)
+
+
+def test_withdrawn_table_falls_back_to_central_loss_free():
+    """An ineligible install (fault-bearing) publishes a withdrawal:
+    edges stop deciding locally and the central wire answers — no
+    event lost, no decision under a stale table."""
+    import numpy as np
+
+    cfg = Config({
+        "rest_port": 0,
+        "run_id": "edge-withdraw",
+        "explore_policy": "tpu_search",
+        "explore_policy_param": {
+            "search_on_start": False, "max_interval": 0, "seed": 7,
+            "max_fault": 0.5},
+    })
+    policy = create_policy("tpu_search")
+    policy.load_config(cfg)
+    policy.install_table([0.0] * policy.H, source="test")
+    orc = Orchestrator(cfg, policy, collect_trace=False)
+    orc.start()
+    port = orc.hub.endpoint("rest").port
+    tx = RestTransceiver("e0", f"http://127.0.0.1:{port}",
+                         use_batch=True, flush_window=0.0,
+                         poll_linger=0.005, edge=True,
+                         backhaul_window=0.0)
+    tx.start()
+    assert tx.sync_table() is not None
+    try:
+        # a fault-bearing install is NOT edge-eligible → withdrawal
+        policy.install_table([0.0] * policy.H,
+                             faults=np.full(policy.H, 0.9),
+                             source="test")
+        assert policy.table_publisher.current()[1] is None
+        tx.sync_table()
+        assert not tx.edge_active
+        chans = [tx.send_event(
+            PacketEvent.create("e0", "e0", "peer", hint=f"w{i}"))
+            for i in range(4)]
+        for ch in chans:
+            assert ch.get(timeout=15) is not None  # central answered
+    finally:
+        tx.shutdown()
+        orc.shutdown()
+
+
+def test_disable_orchestration_suspends_the_published_table():
+    pub = TablePublisher()
+    pub.publish([0.0], H=1, max_interval=0.0)
+    v, doc = pub.current()
+    assert doc is not None
+    pub.suspend()
+    v2, doc2 = pub.current()
+    assert v2 > v and doc2 is None
+    pub.resume()
+    v3, doc3 = pub.current()
+    assert v3 > v2 and doc3 is not None and doc3["version"] == v3
+
+
+def test_rest_transceiver_shutdown_flushes_backhaul_before_closing():
+    """ISSUE-8 regression: a RestTransceiver shut down while an edge
+    table is installed must flush pending backhaul records before
+    closing its connections — the window here is far beyond the test
+    length, so the shutdown flush is the ONLY way these trace records
+    can reach the flight recorder."""
+    cfg = Config({
+        "rest_port": 0,
+        "run_id": "edge-shutdown-flush",
+        "explore_policy": "tpu_search",
+        "explore_policy_param": {
+            "search_on_start": False, "max_interval": 0, "seed": 7},
+    })
+    policy = create_policy("tpu_search")
+    policy.load_config(cfg)
+    policy.install_table([0.0] * policy.H, source="test")
+    orc = Orchestrator(cfg, policy, collect_trace=True)
+    orc.start()
+    port = orc.hub.endpoint("rest").port
+    tx = RestTransceiver("e0", f"http://127.0.0.1:{port}",
+                         use_batch=True, flush_window=0.0,
+                         poll_linger=0.005, edge=True,
+                         backhaul_window=300.0)
+    tx.start()
+    assert tx.sync_table() is not None
+    try:
+        chans = [tx.send_event(
+            PacketEvent.create("e0", "e0", "peer", hint=f"f{i}"))
+            for i in range(8)]
+        for ch in chans:
+            assert ch.get(timeout=15) is not None
+        assert tx._edge.pending_backhaul() == 8  # nothing flushed yet
+    finally:
+        tx.shutdown()
+        # backhaul is in the hub queue; let the event loop reconcile
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            run = obs.trace_run("edge-shutdown-flush")
+            if run is not None and len(
+                    run.snapshot()["records"]) >= 8:
+                break
+            time.sleep(0.02)
+        trace = orc.shutdown()
+    assert tx._edge.pending_backhaul() == 0
+    docs = _records("edge-shutdown-flush")
+    assert len(docs) == 8
+    for d in docs:
+        assert (d.get("decision") or {}).get("decision_source") == "edge"
+    assert len(trace) == 8
+
+
+# -- the uds:// wire -----------------------------------------------------
+
+
+def test_uds_wire_end_to_end_central(tmp_path):
+    """post/poll/ack over the framed AF_UNIX wire, centrally decided."""
+    _run("uds-central", edge=False,
+         uds_path=str(tmp_path / "nmz.sock"))
+    docs = _records("uds-central")
+    assert len(docs) == len(HINTS) * len(ENTITIES)
+
+
+def test_uds_wire_end_to_end_edge_equivalent(tmp_path):
+    """The zero-RTT path over uds://: same dispatch order and delays
+    as the central REST run over the same seed."""
+    _run("uds-eq-central", edge=False)
+    _run("uds-eq-edge", edge=True,
+         uds_path=str(tmp_path / "nmz-edge.sock"))
+    docs_a = _records("uds-eq-central")
+    docs_b = _records("uds-eq-edge")
+    diff = export.diff_order(
+        export.order_lines_from_docs(docs_a),
+        export.order_lines_from_docs(docs_b),
+        "uds-eq-central", "uds-eq-edge")
+    assert diff == "", f"dispatch order diverged:\n{diff}"
+    for d in docs_b:
+        assert (d.get("decision") or {}).get("decision_source") == "edge"
+
+
+def test_uds_transceiver_survives_severed_connection(tmp_path):
+    """wire.uds.sever tears the socket mid-poll; the receive loop
+    reconnects and the plane keeps answering."""
+    from namazu_tpu.endpoint.hub import EndpointHub
+    from namazu_tpu.endpoint.uds import UdsEndpoint
+    from namazu_tpu.utils.mock_orchestrator import MockOrchestrator
+
+    path = str(tmp_path / "sever.sock")
+    hub = EndpointHub()
+    uds = UdsEndpoint(path, poll_timeout=1.0)
+    hub.add_endpoint(uds)
+    mock = MockOrchestrator(hub)
+    mock.start()
+    tx = UdsTransceiver("e0", path, poll_linger=0.005,
+                        backoff_step=0.05)
+    tx.start()
+    try:
+        ch = tx.send_event(
+            PacketEvent.create("e0", "e0", "peer", hint="h0"))
+        assert ch.get(timeout=10) is not None
+        chaos.install(FaultPlan(3, {"wire.uds.sever":
+                                    {"prob": 1.0, "max_fires": 1}}))
+        time.sleep(0.3)  # let the seam fire on the receive loop
+        chaos.clear()
+        ch = tx.send_event(
+            PacketEvent.create("e0", "e0", "peer", hint="h1"))
+        assert ch.get(timeout=10) is not None
+    finally:
+        tx.shutdown()
+        mock.shutdown()
+        hub.shutdown()
+
+
+def test_uds_non_object_frame_gets_error_reply_not_desync(tmp_path):
+    """A valid-JSON frame that is not an op object (a list, a bare
+    string) must be ANSWERED with ok:false — not crash the handler
+    thread — and the connection must keep serving ops afterwards."""
+    import socket as socket_mod
+
+    from namazu_tpu.endpoint.agent import read_frame, write_frame
+    from namazu_tpu.endpoint.hub import EndpointHub
+    from namazu_tpu.endpoint.uds import UdsEndpoint
+
+    path = str(tmp_path / "frame.sock")
+    hub = EndpointHub()
+    uds = UdsEndpoint(path, poll_timeout=1.0)
+    hub.add_endpoint(uds)
+    hub.start()
+    try:
+        conn = socket_mod.socket(socket_mod.AF_UNIX,
+                                 socket_mod.SOCK_STREAM)
+        conn.connect(path)
+        conn.settimeout(5.0)
+        try:
+            for bad in ([1, 2], "post_batch"):
+                write_frame(conn, bad)
+                resp = read_frame(conn)
+                assert resp is not None and resp["ok"] is False
+                assert "JSON object" in resp["error"]
+            # the framed stream is still in sync: a real op answers
+            write_frame(conn, {"op": "table"})
+            resp = read_frame(conn)
+            assert resp is not None and "version" in resp
+        finally:
+            conn.close()
+    finally:
+        hub.shutdown()
+
+
+def test_uds_ingress_cap_refuses_with_retry_after(tmp_path):
+    """The uds wire carries the same bounded-ingress contract as REST
+    (doc/robustness.md): over-cap post_batch/backhaul ops are refused
+    with a transient retry_after the client's bounded retry honors —
+    the hub queue can never grow unboundedly through the framed wire."""
+    from namazu_tpu.endpoint.hub import EndpointHub
+    from namazu_tpu.endpoint.uds import UdsEndpoint
+
+    hub = EndpointHub()
+    uds = UdsEndpoint(str(tmp_path / "cap.sock"), poll_timeout=1.0,
+                      ingress_cap=1, retry_after_s=0.25)
+    uds.hub = hub
+    # nothing drains the hub queue and it is already at the cap
+    hub.event_queue.put(object())
+    ev = PacketEvent.create("e0", "e0", "peer", hint="h")
+    resp = uds._op_post_batch(
+        {"op": "post_batch", "entity": "e0",
+         "events": [ev.to_jsonable()]})
+    assert resp["ok"] is False and resp["transient"] is True
+    assert resp["retry_after"] == 0.25
+    assert hub.event_queue.qsize() == 1  # nothing admitted
+    resp = uds._op_backhaul(
+        {"op": "backhaul", "entity": "e0",
+         "items": [{"event": ev.to_jsonable(),
+                    "decision": {"table_version": 1}}]})
+    assert resp["ok"] is False and resp["transient"] is True
+    # under cap again: both ops admit
+    hub.event_queue.get()
+    resp = uds._op_post_batch(
+        {"op": "post_batch", "entity": "e0",
+         "events": [ev.to_jsonable()]})
+    assert resp["ok"] is True and resp["accepted"] == 1
+
+
+def test_uds_client_treats_refusal_as_transient(tmp_path):
+    from namazu_tpu.inspector.uds_transceiver import (
+        TransientHTTPStatus,
+        _check_resp,
+    )
+
+    with pytest.raises(TransientHTTPStatus) as ei:
+        _check_resp({"ok": False, "transient": True,
+                     "retry_after": 0.5, "error": "x"}, "op")
+    assert ei.value.retry_after == 0.5
+    with pytest.raises(RuntimeError):
+        _check_resp({"ok": False, "error": "hard"}, "op")
+    _check_resp({"ok": True}, "op")  # no raise
+
+
+def test_backhaul_dedupe_ring_is_separate_from_central_ring(tmp_path):
+    """High-rate backhaul must not evict a central retry's uuid before
+    its backoff replays it — the two populations ride separate rings."""
+    from namazu_tpu.endpoint.rest import QueuedEndpoint
+
+    ep = QueuedEndpoint()
+    assert not ep.note_event_uuid("central-1")
+    # flood the backhaul ring well past the central cap
+    for i in range(QueuedEndpoint._SEEN_EVENT_CAP + 100):
+        assert not ep.note_backhaul_uuid(f"bh-{i}")
+    # the central uuid is still remembered: its replay dedupes
+    assert ep.note_event_uuid("central-1")
+    # and the backhaul ring dedupes its own replays
+    assert ep.note_backhaul_uuid("bh-50")
+
+
+def test_uds_table_op_serves_the_published_doc(tmp_path):
+    """The ``table`` op mirrors GET /policy/table: version + doc, and
+    the post_batch response piggybacks the version."""
+    from namazu_tpu.endpoint.hub import EndpointHub
+    from namazu_tpu.endpoint.uds import UdsEndpoint
+    from namazu_tpu.utils.mock_orchestrator import MockOrchestrator
+
+    path = str(tmp_path / "table.sock")
+    hub = EndpointHub()
+    pub = TablePublisher()
+    pub.publish([0.0, 0.25], H=2, max_interval=0.25)
+    hub.table_publisher = pub
+    uds = UdsEndpoint(path, poll_timeout=1.0)
+    hub.add_endpoint(uds)
+    mock = MockOrchestrator(hub)
+    mock.start()
+    tx = UdsTransceiver("e0", path, edge=True, poll_linger=0.005)
+    tx.start()
+    try:
+        assert tx.sync_table() == 1
+        assert tx.edge_active
+        version, doc = tx._fetch_table_once()
+        assert version == 1 and doc["delays"] == [0.0, 0.25]
+    finally:
+        tx.shutdown()
+        mock.shutdown()
+        hub.shutdown()
+
+
+# -- review-hardening regressions ----------------------------------------
+
+
+def test_partition_splits_by_eligibility_with_no_side_effects():
+    """``partition`` is the retry-safety seam: it must decide the split
+    without releasing anything, so the transceiver can run the fallible
+    central wire work first."""
+    delivered, sent = [], []
+    docs = [(1, _table_doc(1, [0.0] * 4))]
+    d = _dispatcher(docs, delivered, sent)
+    assert d.sync() == 1
+    from namazu_tpu.signal.event import LogEvent
+    deferred = [PacketEvent.create("e0", "e0", "peer", hint=f"p{i}")
+                for i in range(3)]
+    plain = LogEvent.create("e0", "a log line")
+    eligible, central = d.partition(deferred + [plain])
+    assert eligible == deferred and central == [plain]
+    assert delivered == [] and d.pending_backhaul() == 0
+    # inactive edge: everything is central
+    d.shutdown()
+    eligible, central = d.partition(deferred)
+    assert eligible == [] and central == deferred
+
+
+def test_burst_central_failure_does_not_release_edge_events():
+    """ISSUE-8 retry safety: a mixed ``send_events`` burst whose
+    central subset fails must raise WITHOUT having released the edge
+    subset — the caller's retry would otherwise re-release
+    already-decided events."""
+    cfg = Config({
+        "rest_port": 0,
+        "run_id": "edge-burst-fail",
+        "explore_policy": "tpu_search",
+        "explore_policy_param": {
+            "search_on_start": False, "max_interval": 0, "seed": 7},
+    })
+    policy = create_policy("tpu_search")
+    policy.load_config(cfg)
+    policy.install_table([0.0] * policy.H, source="test")
+    orc = Orchestrator(cfg, policy, collect_trace=False)
+    orc.start()
+    port = orc.hub.endpoint("rest").port
+    tx = RestTransceiver("e0", f"http://127.0.0.1:{port}",
+                         use_batch=True, flush_window=0.0,
+                         poll_linger=0.005, edge=True,
+                         backhaul_window=300.0, post_attempts=1)
+    tx.start()
+    try:
+        assert tx.sync_table() is not None
+        from namazu_tpu.signal.event import LogEvent
+        deferred = [PacketEvent.create("e0", "e0", "peer", hint=f"m{i}")
+                    for i in range(4)]
+        poison = LogEvent.create("e0", "x")  # rides the central wire
+        # kill the central wire: no listener AND no live keep-alive
+        # connection left to ride
+        ep = orc.hub.endpoint("rest")
+        ep.sever()  # cut live keep-alive conns BEFORE closing the
+        ep.shutdown()  # listener (shutdown drops the sever handle)
+        time.sleep(0.3)  # let in-flight keep-alive exchanges die
+        with pytest.raises(Exception):
+            tx.send_events(deferred + [poison])
+        # nothing was decided at the edge before the failure surfaced
+        assert tx._edge.decisions == 0
+        assert tx._edge.pending_backhaul() == 0
+    finally:
+        tx.shutdown()
+        orc.shutdown()
+
+
+def test_drain_if_stopped_releases_stragglers_loss_free():
+    """A dispatch racing shutdown republishes into a drained heap; the
+    post-publish drain delivers the release and flushes its backhaul
+    record instead of stranding both."""
+    delivered, sent = [], []
+    docs = [(1, _table_doc(1, [5.0] * 4, max_interval=5.0))]
+    d = _dispatcher(docs, delivered, sent, window=300.0)
+    assert d.sync() == 1
+    ev = PacketEvent.create("e0", "e0", "peer", hint="h")
+    # simulate the lost race: shutdown completed between this thread's
+    # stop check and its heap push — the push lands post-drain
+    table = d._table
+    import heapq as _heapq
+    with d._heap_cond:
+        _heapq.heappush(
+            d._heap,
+            (time.monotonic() + 5.0, d._heap_seq, ev,
+             ("h", table.version, 5.0, time.monotonic(), time.time())))
+        d._heap_seq += 1
+    d._stop.set()
+    d._drain_if_stopped()
+    assert len(delivered) == 1 and delivered[0].event_uuid == ev.uuid
+    assert d.pending_backhaul() == 0  # flushed, not stranded
+    assert sum(len(items) for _, items in sent) == 1
+
+
+def test_uds_endpoint_refuses_to_steal_a_live_socket(tmp_path):
+    """Two orchestrators misconfigured onto one uds_path: the second
+    must fail loudly instead of silently splitting the entity's event
+    stream across two servers; a genuinely stale socket (dead
+    predecessor) is still reclaimed."""
+    from namazu_tpu.endpoint.uds import UdsEndpoint
+
+    path = str(tmp_path / "shared.sock")
+    first = UdsEndpoint(path, poll_timeout=1.0)
+    first.start()
+    second = UdsEndpoint(path, poll_timeout=1.0)
+    try:
+        with pytest.raises(RuntimeError, match="live listener"):
+            second.start()
+    finally:
+        first.shutdown()
+    # dead predecessor left the inode behind: reclaimable
+    import socket as _socket
+    stale = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+    stale.bind(path)
+    stale.close()  # bound but never listening -> connect refused
+    third = UdsEndpoint(path, poll_timeout=1.0)
+    third.start()
+    third.shutdown()
+    # a non-socket at the path is never clobbered
+    blocker = tmp_path / "blocker.sock"
+    blocker.write_text("precious")
+    fourth = UdsEndpoint(str(blocker), poll_timeout=1.0)
+    with pytest.raises(OSError):
+        fourth.start()
+    assert blocker.read_text() == "precious"
+
+
+def test_uds_endpoint_survives_malformed_json_frame(tmp_path):
+    """A desynced client sending a valid length prefix over garbage
+    bytes must cost only its own connection — the handler drops it
+    cleanly and the endpoint keeps serving new connections."""
+    import socket as _socket
+    import struct
+
+    from namazu_tpu.endpoint.hub import EndpointHub
+    from namazu_tpu.endpoint.uds import UdsEndpoint
+    from namazu_tpu.utils.mock_orchestrator import MockOrchestrator
+
+    path = str(tmp_path / "garbage.sock")
+    hub = EndpointHub()
+    uds = UdsEndpoint(path, poll_timeout=1.0)
+    hub.add_endpoint(uds)
+    mock = MockOrchestrator(hub)
+    mock.start()
+    try:
+        bad = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+        bad.connect(path)
+        payload = b"not json at all"
+        bad.sendall(struct.pack("<I", len(payload)) + payload)
+        bad.settimeout(5.0)
+        assert bad.recv(1) == b""  # server dropped the connection
+        bad.close()
+        # the endpoint still serves a well-behaved client
+        tx = UdsTransceiver("e0", path, poll_linger=0.005)
+        tx.start()
+        try:
+            ch = tx.send_event(
+                PacketEvent.create("e0", "e0", "peer", hint="ok"))
+            assert ch.get(timeout=10) is not None
+        finally:
+            tx.shutdown()
+    finally:
+        mock.shutdown()
+        hub.shutdown()

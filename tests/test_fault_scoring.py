@@ -66,7 +66,7 @@ def test_fault_coin_deterministic_and_matches_policy():
     faults = np.zeros(H, np.float32)
     bucket = te.hint_bucket("hint3", H)
     faults[bucket] = min(1.0, coin[bucket] + 0.05)  # just above the coin
-    pol._faults = faults
+    pol.install_table(np.zeros(H), faults=faults)
     assert pol._fault_for("hint3") == (coin[bucket] < faults[bucket])
     assert pol._fault_for("hint3")  # and it does fire
 
@@ -223,12 +223,12 @@ def test_policy_replays_fault_table():
     coin = te.fault_coin(0, H)
     faults = np.zeros(H, np.float32)
     faults[bucket] = min(1.0, float(coin[bucket]) + 0.05)
-    pol._faults = faults
+    pol.install_table(np.zeros(H), faults=faults)
     action = pol._action_for(ev)
     assert isinstance(action, PacketFaultAction)
     # below the coin: the event is released normally
     faults[bucket] = max(0.0, float(coin[bucket]) - 0.05)
-    pol._faults = faults
+    pol.install_table(np.zeros(H), faults=faults)
     action = pol._action_for(ev)
     assert not isinstance(action, PacketFaultAction)
 

@@ -167,7 +167,7 @@ def test_policy_reorder_release_realizes_priority_order():
     table = np.ones((H,), np.float32)
     table[fnv64a(b"a->b:late") % H] = 0.0
     table[fnv64a(b"a->b:early") % H] = 1.0
-    pol._delays = table
+    pol.install_table(table)
 
     orc = Orchestrator(cfg, pol, collect_trace=True)
     orc.start()
@@ -264,7 +264,7 @@ def test_policy_realized_order_equals_scored_order():
     table = np.full((H,), 10.0, np.float32)
     for h, p in prios.items():
         table[fnv64a(h.encode()) % H] = p
-    pol._delays = table
+    pol.install_table(table)
 
     orc = Orchestrator(cfg, pol, collect_trace=True)
     orc.start()

@@ -32,7 +32,7 @@ def make_policy(table):
             "search_on_start": False, "hint_buckets": H,
         },
     }))
-    pol._delays = table
+    pol.install_table(table)
     pol.start = lambda: None  # no threads: drains are driven explicitly
     released = []
     pol._emit = released.append
