@@ -218,7 +218,8 @@ def gate_record(current: dict, history: list,
     # unchanged. ``transport_mode`` is the canonical mode key; records
     # that predate it fall back to ``mode``.
     CONFIG_KEYS = ("n_events", "n_entities", "batch_max",
-                   "flush_window", "poll_linger", "gc_disabled")
+                   "flush_window", "poll_linger", "gc_disabled",
+                   "telemetry")
 
     def _mode(rec):
         return rec.get("transport_mode") or rec.get("mode")
@@ -411,6 +412,16 @@ def pipeline_main(args: argparse.Namespace) -> None:
     smoke workload is sized for CI liveness, not for measurement)."""
     n_events = 64 if args.smoke else args.pipeline_events
     n_entities = 2 if args.smoke else args.pipeline_entities
+    # fleet telemetry rides the bench like production (the orchestrator
+    # starts the process relay; the edge dispatchers register their
+    # gauge collectors): the enabled relay's overhead budget is <2% on
+    # the edge figure (doc/observability.md "Fleet telemetry").
+    # --no-telemetry measures the disabled plane — one global read on
+    # the relay seams, the obs_enabled cost contract.
+    telemetry_on = not getattr(args, "no_telemetry", False)
+    from namazu_tpu.obs import federation
+
+    federation.configure(telemetry_on)
     out = {
         "metric": PIPELINE_METRIC,
         "unit": "events/s",
@@ -423,6 +434,7 @@ def pipeline_main(args: argparse.Namespace) -> None:
         "batch_max": args.batch_max,
         "flush_window": args.flush_window,
         "poll_linger": args.poll_linger,
+        "telemetry": telemetry_on,
     }
     if args.smoke:
         out["smoke"] = True
@@ -480,6 +492,10 @@ def pipeline_main(args: argparse.Namespace) -> None:
         # runs with GC paused (see run_pipeline) — the gate must never
         # baseline across that change
         "gc_disabled": True,
+        # likewise a measurement condition: whether the fleet-telemetry
+        # relay ran during the timed window (the gate must not compare
+        # relay-on vs relay-off records, however small the budgeted gap)
+        "telemetry": telemetry_on,
         "batch_max": args.batch_max,
         "flush_window": args.flush_window,
         "poll_linger": args.poll_linger,
@@ -561,6 +577,12 @@ def parse_args(argv=None) -> argparse.Namespace:
                          "local decisions, async backhaul — "
                          "doc/performance.md); the edge figure becomes "
                          "the primary gated value")
+    ap.add_argument("--no-telemetry", action="store_true",
+                    help="with --pipeline: disable the fleet-telemetry "
+                         "relay for the timed window (the no-op-plane "
+                         "cost check, doc/observability.md); records "
+                         "carry `telemetry` so the gate never compares "
+                         "across this switch")
     ap.add_argument("--batch-max", type=int, default=128, metavar="N",
                     help="transceiver coalescing size cap (default 128)")
     ap.add_argument("--flush-window", type=float, default=0.05,

@@ -45,6 +45,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from namazu_tpu.cli.run_cmd import EXIT_TIMEOUT
+from namazu_tpu.obs import spans as obs_spans
 from namazu_tpu.utils.atomic import atomic_write_json
 from namazu_tpu.utils.cmd import (
     CmdFactory,
@@ -93,6 +94,14 @@ class CampaignSpec:
     python: str = sys.executable
     seed: Optional[int] = None    # jitter RNG seed (tests)
     extra_run_args: List[str] = field(default_factory=list)
+    # fleet telemetry collector (doc/observability.md "Fleet
+    # telemetry"): "auto" = <storage>/telemetry.sock (with a /tmp
+    # fallback past the AF_UNIX path limit), "" = off, else an explicit
+    # socket path. The supervisor hosts the fleet aggregator on it and
+    # exports NMZ_TELEMETRY_URL so every run child (and, through the
+    # children's federation hop, their inspectors) pushes here —
+    # ``tools top --url uds://<path>`` shows the whole campaign.
+    telemetry_collector: str = "auto"
 
 
 class Campaign:
@@ -106,6 +115,8 @@ class Campaign:
         self._abort = threading.Event()
         self._child: Optional[subprocess.Popen] = None
         self._child_lock = threading.Lock()
+        self._telemetry_server = None
+        self._telemetry_path = ""
 
     # -- checkpoint ------------------------------------------------------
 
@@ -206,11 +217,81 @@ class Campaign:
         argv += spec.extra_run_args
         return argv
 
-    @staticmethod
-    def _child_env() -> Dict[str, str]:
+    def _child_env(self) -> Dict[str, str]:
         # the child must be able to import the framework even when it is
         # not installed site-wide; CmdFactory.env() owns that logic
-        return CmdFactory().env()
+        env = CmdFactory().env()
+        if self._telemetry_path:
+            # run children push their metrics (and forward their
+            # inspectors') to the supervisor's collector — the one
+            # campaign-wide fleet view (doc/observability.md)
+            env["NMZ_TELEMETRY_URL"] = f"uds://{self._telemetry_path}"
+        return env
+
+    # -- fleet telemetry --------------------------------------------------
+
+    def _collector_path(self) -> str:
+        raw = self.spec.telemetry_collector
+        if not raw:
+            return ""
+        if raw != "auto":
+            return os.path.abspath(raw)
+        path = os.path.abspath(os.path.join(self.spec.storage_dir,
+                                            "telemetry.sock"))
+        if len(path) >= 100:
+            # sun_path caps AF_UNIX socket paths (~108 bytes); a deep
+            # storage dir falls back to a pid-scoped /tmp name
+            path = os.path.join("/tmp", f"nmz-telemetry-{os.getpid()}.sock")
+        return path
+
+    def _start_telemetry(self) -> None:
+        from namazu_tpu import obs
+        from namazu_tpu.obs import federation
+        from namazu_tpu.utils.config import Config
+
+        # honor the storage config's kill switch and SLO declarations
+        # BEFORE deciding to host a collector: `telemetry_enabled =
+        # false` must disable the whole plane for the supervisor too,
+        # and declared [[slo]] objectives must reach the aggregator
+        # this process is about to host (same config.toml-over-
+        # config.json precedence as `run`)
+        cfg_path = os.path.join(self.spec.storage_dir, "config.toml")
+        if not os.path.exists(cfg_path):
+            cfg_path = os.path.join(self.spec.storage_dir, "config.json")
+        if os.path.exists(cfg_path):
+            try:
+                obs.configure_from_config(Config.from_file(cfg_path))
+            except Exception:
+                log.warning("could not apply the storage config's "
+                            "telemetry keys; using process defaults",
+                            exc_info=True)
+        path = self._collector_path()
+        if not path or not federation.enabled():
+            return
+        server = federation.TelemetryServer(path)
+        try:
+            server.start()
+        except (OSError, RuntimeError) as e:
+            # a dead collector must never gate the campaign itself —
+            # the children simply stay local-only (the relay's own
+            # degradation contract)
+            log.warning("fleet telemetry collector on %s unavailable "
+                        "(%s); campaign runs without the fleet view",
+                        path, e)
+            return
+        self._telemetry_server = server
+        self._telemetry_path = path
+        # the supervisor is a producer too (campaign slot counters,
+        # collector occupancy): its registry merges straight into the
+        # local aggregator it hosts
+        federation.ensure_self_relay("campaign")
+        log.info("fleet view: nmz-tpu tools top --url uds://%s", path)
+
+    def _stop_telemetry(self) -> None:
+        server, self._telemetry_server = self._telemetry_server, None
+        self._telemetry_path = ""
+        if server is not None:
+            server.shutdown()
 
     def _one_attempt(self) -> Dict[str, Any]:
         """Spawn one ``nmz-tpu run`` child in its own session, enforce
@@ -274,9 +355,11 @@ class Campaign:
                 "(no config.json; run `init` first)")
         self._load_or_init_state(resume)
         previous_handlers = self._install_signal_handlers()
+        self._start_telemetry()
         try:
             return self._loop()
         finally:
+            self._stop_telemetry()
             self._restore_signal_handlers(previous_handlers)
             self._checkpoint()
 
@@ -310,6 +393,7 @@ class Campaign:
             slot_index = len(state["slots"])
             slot = self._run_slot(slot_index)
             state["slots"].append(slot)
+            obs_spans.campaign_slot(slot["class"])
             if slot["class"] == CLASS_EXPERIMENT:
                 state["consecutive_infra"] = 0
             elif slot["class"] == CLASS_INTERRUPTED:

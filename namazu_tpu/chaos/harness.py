@@ -686,12 +686,101 @@ def _scenario_edge(name: str, spec: dict, seed: int, workdir: str,
     return {"invariants": invariants, "fault_report": plan.report()}
 
 
+def _scenario_telemetry(name: str, spec: dict, seed: int, workdir: str,
+                        events: int,
+                        base_policy_param: Optional[dict] = None
+                        ) -> Dict[str, Any]:
+    """Fleet-telemetry relay outage (doc/observability.md "Fleet
+    telemetry"): ``telemetry.push.drop`` kills the producer's pushes to
+    its collector. Invariants: the relay NEVER raises into host code
+    and warns exactly once (the knowledge-client cooldown contract);
+    metrics stay fully served locally throughout; the collector's
+    ``/fleet`` marks the silent instance STALE instead of serving its
+    frozen numbers; and once the fault window closes the next push
+    reconverges the fleet view to the producer's exact cumulative state
+    — an outage costs freshness, never correctness."""
+    import logging
+
+    from namazu_tpu.obs import federation
+
+    upstream = federation.FleetAggregator(stale_after_s=0.5)
+    local = federation.FleetAggregator(stale_after_s=0.5)
+    relay = federation.TelemetryRelay(
+        "run", instance="producer-1", push=upstream.note_push,
+        local=local, interval_s=0.05, target_desc="harness-collector")
+
+    warnings: List[str] = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            if record.levelno >= logging.WARNING:
+                warnings.append(record.getMessage())
+
+    capture = _Capture()
+    federation.log.addHandler(capture)
+    plan = chaos.install(FaultPlan(seed, spec["faults"]))
+    raised = None
+    try:
+        for i in range(max(6, events)):
+            obs.event_intercepted("harness", "tele")
+            try:
+                relay.flush()
+            except Exception as e:  # the contract under test
+                raised = repr(e)
+    finally:
+        chaos.clear()
+        federation.log.removeHandler(capture)
+    dropped = plan.fired("telemetry.push.drop")
+    # mid-outage: the local surface must have kept serving (bounded,
+    # fresh), and the upstream view must call the producer stale rather
+    # than repeat its frozen numbers
+    local_doc = local.payload()
+    future = time.monotonic() + 10.0
+    stale_doc = upstream.payload(now=future)
+    stale_marked = (not stale_doc["instances"]
+                    or all(r["stale"] for r in stale_doc["instances"]))
+    # post-outage reconvergence: one clean flush must land the full
+    # cumulative state upstream, bit-identical to the local registry
+    relay.flush()
+    reg_total = 0.0
+    child = metrics.registry().sample(
+        "nmz_events_intercepted_total", endpoint="harness",
+        entity="tele")
+    if child is not None:
+        reg_total = child.value
+    up_doc = upstream.payload()
+    up_row = next((r for r in up_doc["instances"]
+                   if r["instance"] == "producer-1"), None)
+    invariants = {
+        "never_raises": _inv(raised is None, raised=raised),
+        "one_warning": _inv(
+            sum("telemetry push" in w for w in warnings) <= 1
+            and (dropped == 0 or any("telemetry push" in w
+                                     for w in warnings)),
+            warnings=warnings[:4], dropped=dropped),
+        "local_metrics_survive": _inv(
+            local_doc["instance_count"] == 1
+            and not local_doc["instances"][0]["stale"],
+            local=local_doc["instance_count"]),
+        "fleet_marks_stale": _inv(stale_marked,
+                                  stale=stale_doc["stale_instances"],
+                                  instances=stale_doc["instance_count"]),
+        "reconverges_bit_exact": _inv(
+            up_row is not None and reg_total > 0
+            and up_row["events_total"] == reg_total,
+            upstream=(up_row or {}).get("events_total"),
+            local=reg_total),
+    }
+    return {"invariants": invariants, "fault_report": plan.report()}
+
+
 _KINDS = {
     "pipeline": _scenario_pipeline,
     "storage": _scenario_storage,
     "knowledge": _scenario_knowledge,
     "crash": _scenario_crash,
     "edge": _scenario_edge,
+    "telemetry": _scenario_telemetry,
 }
 
 

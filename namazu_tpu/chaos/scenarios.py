@@ -26,6 +26,12 @@ it. Kinds:
   rollover; invariant: exactly-once dispatch, one unambiguous
   ``table_version`` per record, and a complete backhaul-reconciled
   trace.
+* ``telemetry`` — fleet-telemetry relay outage
+  (doc/observability.md "Fleet telemetry"): ``telemetry.push.drop``
+  kills the producer's pushes; invariant: never an exception into
+  host code, one warning, local metrics keep serving, ``/fleet``
+  marks the instance stale, and the first clean push reconverges the
+  fleet view bit-exactly.
 
 The specs keep each scenario to ONE fault family so the invariant
 arithmetic (e.g. ``lost == fired("wire.post.drop")``) stays exact.
@@ -113,6 +119,15 @@ SCENARIOS: Dict[str, dict] = {
                 "backhaul must reconcile a complete trace",
         "faults": {"table.publish.stale": {"prob": 1.0, "max_fires": 3}},
     },
+    "relay_outage": {
+        "kind": "telemetry",
+        "desc": "the fleet-telemetry collector goes dark; the relay "
+                "must degrade to local-only metrics with ONE warning "
+                "and bounded buffering, /fleet must mark the instance "
+                "stale, and the first clean push must reconverge the "
+                "fleet view bit-exactly",
+        "faults": {"telemetry.push.drop": {"prob": 1.0, "max_fires": 4}},
+    },
 }
 
 #: the CI smoke matrix — wire, endpoint, storage, knowledge, crash,
@@ -121,7 +136,7 @@ SCENARIOS: Dict[str, dict] = {
 DEFAULT_MATRIX: List[str] = [
     "wire_drop", "wire_dup", "wire_lost_reply", "wire_sever",
     "ingress_429", "storage_torn", "knowledge_outage", "crash_restart",
-    "edge_stale",
+    "edge_stale", "relay_outage",
 ]
 
 

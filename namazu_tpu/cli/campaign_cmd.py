@@ -56,6 +56,15 @@ def register(sub) -> None:
     p.add_argument("--knowledge", default="", metavar="HOST:PORT",
                    help="global failure-knowledge service address, "
                         "forwarded to every run child (doc/knowledge.md)")
+    p.add_argument("--telemetry-collector", default="auto",
+                   metavar="PATH",
+                   help="fleet telemetry collector socket "
+                        "(doc/observability.md \"Fleet telemetry\"): "
+                        "the supervisor aggregates every child "
+                        "process's metrics here and `tools top --url "
+                        "uds://PATH` shows the whole campaign. "
+                        "Default: auto (<storage>/telemetry.sock); "
+                        "'' disables")
     p.add_argument("--no-resume", action="store_true",
                    help="ignore an existing campaign.json and start a "
                         "fresh campaign")
@@ -78,6 +87,7 @@ def run(args) -> int:
         max_consecutive_infra=args.max_consecutive_infra,
         extra_run_args=(["--knowledge", args.knowledge]
                         if args.knowledge else []),
+        telemetry_collector=args.telemetry_collector,
     )
     campaign = Campaign(spec)
     try:

@@ -104,6 +104,22 @@ def _make_transceiver(args, default_entity: str):
         orc.start()
         trans = new_transceiver(url, entity, orc.local_endpoint)
         return trans, orc
+    # fleet telemetry (doc/observability.md "Fleet telemetry"): an
+    # inspector process is a producer — it pushes its registry (edge
+    # gauges, interception counters) to the orchestrator it already
+    # talks to (REST or uds both answer the telemetry push), which
+    # merges it into /fleet and forwards it up any federation hop.
+    # $NMZ_TELEMETRY_URL overrides the target (e.g. straight to a
+    # campaign supervisor's collector).
+    from namazu_tpu.obs import federation
+
+    push_url = os.environ.get("NMZ_TELEMETRY_URL", "") or url
+    if not push_url.startswith(("http://", "https://", "uds://",
+                                "tcp://")):
+        push_url = ""  # e.g. agent:// — no telemetry wire; stay local
+    federation.ensure_self_relay(
+        "inspector", push_url=push_url,
+        instance=federation.default_instance(entity))
     return new_transceiver(url, entity,
                            edge=bool(getattr(args, "edge", False))), None
 

@@ -53,6 +53,14 @@ def register(sub) -> None:
              "warm-start from the fleet's pooled failures, failures "
              "stream back; an outage degrades to local-only search. "
              "Overrides the config's explore_policy_param.knowledge")
+    p.add_argument(
+        "--telemetry-url", default="", metavar="URL",
+        help="push this process's metrics to a fleet aggregator "
+             "(doc/observability.md \"Fleet telemetry\"): an "
+             "orchestrator's REST endpoint (http://...) or a campaign "
+             "supervisor's collector (uds:///path). Defaults to "
+             "$NMZ_TELEMETRY_URL (a campaign supervisor exports it to "
+             "its run children); overrides the config's telemetry_url")
     p.set_defaults(func=run)
 
 
@@ -126,6 +134,19 @@ def run(args) -> int:
     if args.knowledge:
         # fold the fleet's pool/tenant stats into GET /analytics
         obs.set_knowledge_address(args.knowledge)
+    # fleet telemetry: claim this process's producer identity as a
+    # campaign `run` child BEFORE the orchestrator's own idempotent
+    # ensure_self_relay can name it "orchestrator"; precedence CLI >
+    # $NMZ_TELEMETRY_URL (the campaign supervisor's export) > config
+    if args.telemetry_url:
+        cfg.set("telemetry_url", args.telemetry_url)
+    obs.configure_from_config(cfg)  # honor telemetry_enabled = false
+    obs.federation.ensure_self_relay(
+        "run",
+        push_url=(args.telemetry_url
+                  or os.environ.get("NMZ_TELEMETRY_URL", "")
+                  or str(cfg.get("telemetry_url", "") or "")),
+        interval_s=float(cfg.get("telemetry_interval_s", 2.0) or 2.0))
 
     run_deadline = _deadline(args.run_deadline, cfg, "run_deadline_s")
     validate_deadline = _deadline(args.validate_deadline, cfg,

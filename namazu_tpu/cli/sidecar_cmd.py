@@ -27,6 +27,12 @@ def register(sub) -> None:
                    help="knowledge-service state directory (scenario "
                         "tables, surrogate examples); default: the "
                         "pool dir")
+    p.add_argument("--telemetry-url", default="",
+                   help="push this process's metrics to a fleet "
+                        "aggregator (doc/observability.md \"Fleet "
+                        "telemetry\"): http://host:port (orchestrator "
+                        "REST) or uds:///path (campaign collector). "
+                        "Defaults to $NMZ_TELEMETRY_URL")
     p.set_defaults(func=run_sidecar)
 
 
@@ -50,4 +56,5 @@ def run_sidecar(args) -> int:
 
     host, _, port = args.listen.rpartition(":")
     return serve_sidecar(host or "127.0.0.1", int(port),
-                         pool_dir=args.pool_dir, state_dir=args.state_dir)
+                         pool_dir=args.pool_dir, state_dir=args.state_dir,
+                         telemetry_url=args.telemetry_url)

@@ -119,11 +119,36 @@ def register(sub) -> None:
              "registry (embedded orchestrators, tests)",
     )
     pm.add_argument("--url", default="",
-                    help="scrape a running orchestrator's REST endpoint "
-                         "(e.g. http://127.0.0.1:10080); omit to dump "
-                         "this process's in-memory registry, which for "
-                         "a plain CLI invocation is empty")
+                    help="scrape a running orchestrator "
+                         "(http://127.0.0.1:10080, or uds:///path for "
+                         "a same-host fleet without a TCP port); omit "
+                         "to dump this process's in-memory registry, "
+                         "which for a plain CLI invocation is empty")
     pm.set_defaults(func=metrics_dump)
+
+    ptp = tsub.add_parser(
+        "top",
+        help="fleet status snapshot (doc/observability.md \"Fleet "
+             "telemetry\"): one row per producer process that pushed "
+             "telemetry — events/s, queue dwell p99, table-version "
+             "skew, backhaul lag, last-seen age — plus the SLO burn "
+             "table; --watch refreshes in place",
+    )
+    ptp.add_argument("--url", default="http://127.0.0.1:10080",
+                     help="a fleet aggregator's surface: an "
+                          "orchestrator's REST endpoint "
+                          "(http://127.0.0.1:10080) or a framed "
+                          "collector (uds:///path — a campaign "
+                          "supervisor's --telemetry-collector, or an "
+                          "orchestrator's uds_path)")
+    ptp.add_argument("--watch", action="store_true",
+                     help="refresh every INTERVAL seconds until ^C")
+    ptp.add_argument("--interval", type=float, default=2.0,
+                     help="refresh period with --watch (default 2s)")
+    ptp.add_argument("--json", action="store_true",
+                     help="print the raw /fleet JSON payload instead "
+                          "of the table")
+    ptp.set_defaults(func=top)
 
     pt = tsub.add_parser(
         "trace",
@@ -238,18 +263,106 @@ def register(sub) -> None:
 
 def metrics_dump(args) -> int:
     """One JSON document: the process-local registry, or a live
-    orchestrator's via its REST ``/metrics.json`` route."""
+    orchestrator's via its REST ``/metrics.json`` route / the framed
+    ``metrics`` op on a ``uds://`` surface."""
     if args.url:
-        import urllib.request
+        from namazu_tpu.obs import federation
 
-        url = args.url.rstrip("/") + "/metrics.json"
-        with urllib.request.urlopen(url, timeout=10) as r:
-            print(json.dumps(json.loads(r.read()), sort_keys=True))
+        doc = federation.fetch(args.url, "metrics")
+        print(json.dumps(doc, sort_keys=True))
         return 0
     from namazu_tpu import obs
 
     print(json.dumps(obs.registry_jsonable(), sort_keys=True))
     return 0
+
+
+def _fmt_cell(value, unit: str = "") -> str:
+    if value is None:
+        return "-"
+    if isinstance(value, bool):
+        return "yes" if value else "no"
+    if isinstance(value, float):
+        text = f"{value:.3f}".rstrip("0").rstrip(".")
+        return (text or "0") + unit
+    return f"{value}{unit}"
+
+
+def render_top(payload: dict) -> str:
+    """The ``tools top`` table for one /fleet payload."""
+    cols = (
+        ("job", "JOB", ""), ("instance", "INSTANCE", ""),
+        ("events_per_sec", "EV/S", ""), ("events_total", "EVENTS", ""),
+        ("queue_dwell_p99_s", "DWELL99", "s"),
+        ("dispatch_p99_s", "E2E99", "s"),
+        ("backhaul_lag_p99_s", "BACKHL99", "s"),
+        ("table_version", "TBLV", ""), ("table_skew", "SKEW", ""),
+        ("edge_parked", "PARKED", ""),
+        ("last_seen_age_s", "AGE", "s"), ("stale", "STALE", ""),
+    )
+    rows = [[header for _, header, _ in cols]]
+    for inst in payload.get("instances", []):
+        rows.append([_fmt_cell(inst.get(key), unit)
+                     for key, _, unit in cols])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(cols))]
+    lines = ["  ".join(cell.ljust(w) for cell, w in zip(row, widths))
+             .rstrip() for row in rows]
+    lines.append("")
+    lines.append(
+        f"{payload.get('instance_count', 0)} instance(s), "
+        f"{payload.get('stale_instances', 0)} stale; fleet table "
+        f"version {_fmt_cell(payload.get('fleet_table_version'))}")
+    objectives = (payload.get("slo") or {}).get("objectives") or []
+    if objectives:
+        lines.append("")
+        lines.append("SLO" + " " * 17 + "BURN    BREACHED  BREACHES")
+        for row in objectives:
+            lines.append(f"{str(row.get('name', '')):<20}"
+                         f"{_fmt_cell(row.get('burn')):<8}"
+                         f"{_fmt_cell(row.get('breached', False)):<10}"
+                         f"{_fmt_cell(row.get('breaches', 0))}")
+    return "\n".join(lines) + "\n"
+
+
+def top(args) -> int:
+    """Fleet snapshot table over a live aggregator's /fleet payload
+    (REST or uds, obs/federation.py); --watch redraws until ^C."""
+    import time as _time
+
+    from namazu_tpu.obs import federation
+
+    while True:
+        try:
+            try:
+                payload = federation.fetch(args.url, "fleet")
+            except (OSError, RuntimeError, ValueError):
+                if not args.watch:
+                    raise
+                # a watch session must survive transient unreachability
+                # (a run child cycling, the collector restarting):
+                # show the gap, keep polling
+                sys.stdout.write(
+                    f"\x1b[2J\x1b[H{args.url}: fleet unreachable, "
+                    "retrying...\n")
+                sys.stdout.flush()
+                _time.sleep(max(0.2, args.interval))
+                continue
+            if args.json:
+                text = json.dumps(payload, sort_keys=True) + "\n"
+            else:
+                text = render_top(payload)
+            if not args.watch:
+                sys.stdout.write(text)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + text)
+            sys.stdout.flush()
+            _time.sleep(max(0.2, args.interval))
+        except KeyboardInterrupt:
+            # ^C mid-fetch (slow collector) must exit as cleanly as
+            # ^C mid-sleep
+            if args.watch:
+                return 0
+            raise
 
 
 def _http_get(url: str, timeout: float = 10.0) -> bytes:

@@ -84,6 +84,7 @@ _EVENTS_BACKHAUL_RE = re.compile(rf"^{API_ROOT}/events/([^/]+)/backhaul$")
 _ACTIONS_RE = re.compile(rf"^{API_ROOT}/actions/([^/]+)(?:/([^/]+))?$")
 _CONTROL_RE = re.compile(rf"^{API_ROOT}/control$")
 _POLICY_TABLE_RE = re.compile(rf"^{API_ROOT}/policy/table$")
+_TELEMETRY_RE = re.compile(rf"^{API_ROOT}/telemetry$")
 _TRACES_RE = re.compile(r"^/traces(?:/([^/]+))?$")
 
 
@@ -499,9 +500,32 @@ class RestEndpoint(QueuedEndpoint):
                 m = _EVENTS_RE.match(url.path)
                 if m:
                     return self._post_event(m.group(1), m.group(2))
+                if _TELEMETRY_RE.match(url.path):
+                    return self._post_telemetry()
                 if _CONTROL_RE.match(url.path):
                     return self._post_control(parse_qs(url.query))
                 self._reply(404, {"error": f"no route {url.path}"})
+
+            def _post_telemetry(self) -> None:
+                """Fleet telemetry push wire (doc/observability.md
+                "Fleet telemetry"): one delta-snapshot doc into this
+                process's aggregator. Not gated by the event-ingress
+                cap — telemetry about an overloaded fleet is exactly
+                what must still get through; the doc's seq watermark
+                makes a retried push whose 200 was lost idempotent."""
+                try:
+                    raw = self._read_body()  # always drain (keep-alive)
+                except ValueError as e:
+                    return self._reply(400, {"error": str(e)})
+                try:
+                    doc = json.loads(raw)
+                except ValueError as e:
+                    return self._reply(400, {"error": str(e)})
+                try:
+                    ack = obs.note_telemetry_push(doc)
+                except ValueError as e:
+                    return self._reply(400, {"error": str(e)})
+                self._reply(200, ack)
 
             def _post_event(self, entity: str, uuid: str) -> None:
                 # the body must be READ even when refusing — an unread
@@ -636,6 +660,8 @@ class RestEndpoint(QueuedEndpoint):
                     })
                 if url.path == "/analytics":
                     return self._get_analytics(parse_qs(url.query))
+                if url.path == "/fleet":
+                    return self._get_fleet(parse_qs(url.query))
                 if _POLICY_TABLE_RE.match(url.path):
                     return self._get_policy_table()
                 m = _TRACES_RE.match(url.path)
@@ -736,6 +762,30 @@ class RestEndpoint(QueuedEndpoint):
                     return self._reply_raw(
                         200, obs.report.render_ndjson(payload).encode(),
                         "application/x-ndjson")
+                self._reply(200, payload)
+
+            def _get_fleet(self, query) -> None:
+                """Fleet status surface (obs/federation.py): every
+                producer process that pushed telemetry here, merged
+                under (job, instance) with staleness marking, plus the
+                SLO objective table. ``?format=prom`` renders the whole
+                fleet as ONE Prometheus exposition so a single scrape
+                covers every process."""
+                fmt = (query.get("format") or ["json"])[0]
+                if fmt not in ("json", "prom"):
+                    return self._reply(
+                        400, {"error": f"unknown format {fmt!r}; known: "
+                              "json, prom"})
+                try:
+                    if fmt == "prom":
+                        return self._reply_raw(
+                            200, obs.fleet_prometheus().encode(),
+                            "text/plain; version=0.0.4; charset=utf-8")
+                    payload = obs.fleet_payload()
+                except Exception as e:  # never let a stats bug kill ops
+                    log.exception("fleet payload failed")
+                    return self._reply(
+                        500, {"error": f"fleet failed: {e}"})
                 self._reply(200, payload)
 
             def _get_traces(self, run_id, query) -> None:

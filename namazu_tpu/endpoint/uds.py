@@ -26,6 +26,12 @@ a table plane, the piggyback an edge needs to notice a rollover):
   -> ``{"ok": true, "accepted": N, "duplicates": M}``
 * ``{"op": "table"}`` -> ``{"ok": true, "version": V,
   "table": doc_or_null}``
+* observability ops (obs/federation.py): ``{"op": "telemetry",
+  "doc": ...}`` pushes one fleet-telemetry delta snapshot,
+  ``{"op": "fleet"[, "format": "prom"]}`` serves the merged fleet
+  view, ``{"op": "metrics"}`` dumps this process's local registry —
+  the uds face of ``POST /api/v3/telemetry`` / ``GET /fleet`` /
+  ``GET /metrics.json``.
 
 Connection model mirrors the REST transceiver's: the client holds one
 connection for the outbound ops and one owned by its receive thread
@@ -235,6 +241,15 @@ class UdsEndpoint(QueuedEndpoint):
             return self._op_backhaul(req)
         if op == "table":
             return self._op_table()
+        # observability ops (telemetry push / fleet view / local
+        # metrics dump — obs/federation.py): the uds wire serves the
+        # same fleet surface as the REST routes, so a same-host fleet
+        # is fully inspectable without a TCP port
+        from namazu_tpu.obs import federation
+
+        resp = federation.handle_obs_op(req)
+        if resp is not None:
+            return resp
         return {"ok": False, "error": f"unknown op {op!r}"}
 
     def _ingress_refusal(self) -> Optional[dict]:

@@ -145,6 +145,18 @@ class EdgeDispatcher:
         #: nmz_edge_decisions_total counts orchestrator-side, where the
         #: backhaul reconciles)
         self.decisions = 0
+        #: monotonic stamp of the last server contact that confirmed
+        #: our table state (a sync round trip, or a piggybacked version
+        #: matching the held one) — the edge-staleness gauge's anchor
+        self._confirmed_mono: Optional[float] = None
+        # the sampled fleet gauges (staleness age, parked depth, held
+        # version) ride the telemetry relay like any other producer:
+        # refreshed right before each push, zero cost on the decision
+        # hot path (doc/observability.md "Fleet telemetry")
+        from namazu_tpu.obs import federation as _federation
+
+        self._federation = _federation
+        _federation.register_collector(self.update_gauges)
 
     # -- table state -----------------------------------------------------
 
@@ -167,6 +179,10 @@ class EdgeDispatcher:
         held = table.version if table is not None \
             else self._no_doc_version
         if version == held:
+            if table is not None:
+                # the server just vouched for the exact version we
+                # decide with: the staleness clock restarts
+                self._confirmed_mono = time.monotonic()
             return
         if table is not None \
                 and chaos.decide("table.publish.stale") is not None:
@@ -210,6 +226,7 @@ class EdgeDispatcher:
                 return None
             log.debug("edge table v%d installed (%d buckets)",
                       self._table.version, self._table.H)
+            self._confirmed_mono = time.monotonic()
             return self._table.version
 
     # -- the decision hot path -------------------------------------------
@@ -513,6 +530,26 @@ class EdgeDispatcher:
         with self._bh_cond:
             return len(self._backhaul)
 
+    # -- fleet gauges ------------------------------------------------------
+
+    def update_gauges(self) -> None:
+        """Refresh this edge's sampled fleet gauges (the telemetry
+        relay's pre-push collector): how long since the server last
+        confirmed the held table (0 on the central wire — central
+        dispatch cannot be stale), the parked-heap depth, and the table
+        version decisions currently carry (0 = central fallback)."""
+        table = self._table
+        confirmed = self._confirmed_mono
+        staleness = 0.0
+        if table is not None and confirmed is not None:
+            staleness = max(0.0, time.monotonic() - confirmed)
+        _spans.edge_table_staleness(self.entity_id, staleness)
+        with self._heap_cond:
+            parked = len(self._heap)
+        _spans.edge_parked(self.entity_id, parked)
+        _spans.edge_table_version_held(
+            self.entity_id, table.version if table is not None else 0)
+
     # -- shutdown ---------------------------------------------------------
 
     def shutdown(self, flush_attempts: int = 3) -> None:
@@ -520,6 +557,7 @@ class EdgeDispatcher:
         immediately (mirroring the policy-side loss-free shutdown
         flush), then the backhaul buffer gets a final bounded-retry
         synchronous flush — no trace record is silently dropped."""
+        self._federation.unregister_collector(self.update_gauges)
         self._stop.set()
         with self._heap_cond:
             self._heap_cond.notify_all()

@@ -157,6 +157,25 @@ class KnowledgeService:
         self._pulls = 0
         self._dedupe_hits = 0
         self._load_state()
+        # fleet telemetry (doc/observability.md "Fleet telemetry"): the
+        # tenant/pool gauges normally refresh per request — a relay
+        # collector keeps them fresh across idle stretches too, so the
+        # sidecar's fleet row never pushes week-old occupancy
+        from namazu_tpu.obs import federation
+
+        federation.register_collector(self._refresh_gauges)
+
+    def _refresh_gauges(self) -> None:
+        obs.knowledge_service_stats(len(self._tenants),
+                                    pool_size(self.pool_dir))
+
+    def close(self) -> None:
+        """Detach from the telemetry relay (a dead service must not
+        keep scanning its pool dir on every push cycle, nor shadow a
+        replacement's gauges)."""
+        from namazu_tpu.obs import federation
+
+        federation.unregister_collector(self._refresh_gauges)
 
     # -- persistence (crash-safe; a restarted service resumes) -----------
 

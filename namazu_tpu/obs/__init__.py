@@ -30,7 +30,15 @@ in doc/observability.md.
 
 from __future__ import annotations
 
-from namazu_tpu.obs import analytics, export, metrics, recorder, report  # noqa: F401
+from namazu_tpu.obs import (  # noqa: F401
+    analytics,
+    export,
+    federation,
+    metrics,
+    recorder,
+    report,
+    slo,
+)
 from namazu_tpu.obs.recorder import (  # noqa: F401
     FlightRecorder,
     begin_run,
@@ -38,6 +46,7 @@ from namazu_tpu.obs.recorder import (  # noqa: F401
     current_run_id,
     end_run,
     record_acked,
+    record_annotation,
     record_decided,
     record_decision,
     record_dispatched,
@@ -63,13 +72,19 @@ from namazu_tpu.obs.metrics import (  # noqa: F401
 from namazu_tpu.obs.spans import (  # noqa: F401
     action_dispatched,
     action_unroutable,
+    campaign_slot,
     carry,
     chaos_fault_injected,
+    edge_backhaul_lag,
     edge_decision,
+    edge_parked,
+    edge_table_staleness,
+    edge_table_version_held,
     entity_stalled,
     event_batch,
     event_intercepted,
     experiment_stats,
+    fleet_occupancy,
     ingress_rejected,
     journal_events,
     journal_recovered,
@@ -94,8 +109,12 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     search_round,
     search_stall,
     sidecar_request,
+    slo_breach,
+    slo_burn,
     span,
     table_version,
+    telemetry_forward_dropped,
+    telemetry_push,
     transport_retry_after,
     transport_rtt,
 )
@@ -112,16 +131,25 @@ def configure_from_config(config) -> None:
     the counters a live ``/metrics`` is serving)."""
     if config.is_set("obs_enabled"):
         metrics.configure(bool(config.get("obs_enabled")))
+    # fleet telemetry federation keys (telemetry_enabled, SLO specs,
+    # staleness/eviction windows) — same explicit-keys-only rule
+    federation.configure_from_config(config)
 
 
 def render_prometheus() -> str:
-    """Prometheus text of the default registry (the /metrics body)."""
+    """Prometheus text of the default registry (the /metrics body).
+    Sampled gauges (edge staleness/parked depth, knowledge occupancy)
+    are refreshed first — a direct read must not serve values up to a
+    relay push interval old."""
+    federation.run_collectors()
     return metrics.registry().render_prometheus()
 
 
 def registry_jsonable() -> dict:
     """JSON form of the default registry (the /metrics.json body and
-    the ``nmz-tpu tools metrics`` dump)."""
+    the ``nmz-tpu tools metrics`` dump); sampled gauges refreshed
+    first, same as :func:`render_prometheus`."""
+    federation.run_collectors()
     return metrics.registry().to_jsonable()
 
 
@@ -155,3 +183,25 @@ def analytics_payload(top: int = analytics.DEFAULT_TOP,
     """The experiment-analytics document (the ``GET /analytics`` body):
     the registered storage joined with this process's recorded runs."""
     return analytics.payload(top=top, window=window)
+
+
+def note_telemetry_push(doc) -> dict:
+    """Merge one pushed telemetry doc into this process's fleet
+    aggregator (the ``POST /api/v3/telemetry`` body; raises ValueError
+    on a malformed doc). A disabled plane acks-and-discards — the
+    ``telemetry_enabled = false`` kill switch holds on the serving
+    side too."""
+    if not federation.enabled():
+        return {"ok": True, "disabled": True}
+    return federation.aggregator().note_push(doc)
+
+
+def fleet_payload() -> dict:
+    """The fleet status document (the ``GET /fleet`` body)."""
+    return federation.aggregator().payload()
+
+
+def fleet_prometheus() -> str:
+    """The whole fleet as one Prometheus text exposition (the
+    ``GET /fleet?format=prom`` body)."""
+    return federation.aggregator().prometheus()

@@ -516,6 +516,19 @@ def payload(top: int = DEFAULT_TOP,
     know = _knowledge_section()
     if know is not None:
         doc["knowledge"] = know
+    # SLO compliance (obs/slo.py): folded in only when objectives were
+    # DECLARED in config — like the knowledge section, purely additive,
+    # so the compute_payload parity (REST vs CLI on an slo-less fleet)
+    # is untouched
+    try:
+        from namazu_tpu.obs import federation
+
+        slo_doc = federation.slo_summary()
+        if slo_doc is not None:
+            doc["slo"] = slo_doc
+    except Exception:
+        log.warning("slo summary failed; payload served without it",
+                    exc_info=True)
     return doc
 
 

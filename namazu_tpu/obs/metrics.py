@@ -167,6 +167,14 @@ class Histogram:
         with self._lock:
             return self._sum
 
+    def raw_state(self):
+        """``(uppers, per-bucket raw counts incl. the +Inf overflow,
+        sum, count)`` — the exact internal state, for the telemetry
+        federation plane (obs/federation.py), whose merge must be
+        bit-identical to this histogram's own snapshot."""
+        with self._lock:
+            return self._uppers, list(self._counts), self._sum, self._count
+
     def _samples(self, name: str, labelstr: str) -> Iterable[str]:
         snap = self.snapshot()
         base = labelstr[1:-1] if labelstr else ""  # strip { }
@@ -242,6 +250,11 @@ class _Family:
     def _items(self) -> List[Tuple[Tuple[str, ...], Any]]:
         with self._lock:
             return sorted(self._children.items())
+
+    def items(self) -> List[Tuple[Tuple[str, ...], Any]]:
+        """Public (label values) -> child listing (sorted) — the read
+        side the telemetry relay walks per push (obs/federation.py)."""
+        return self._items()
 
     def render(self) -> Iterable[str]:
         if self.help:
@@ -322,6 +335,12 @@ class MetricsRegistry:
 
     # -- read side -------------------------------------------------------
 
+    def families(self) -> List[_Family]:
+        """Sorted live families (the telemetry relay's walk; children
+        are fetched per family via :meth:`_Family.items`)."""
+        with self._lock:
+            return sorted(self._families.values(), key=lambda f: f.name)
+
     def sample(self, name: str, **labels):
         """The live child instrument for one (name, label values), or
         None when it does not exist (read-only: never creates)."""
@@ -390,6 +409,9 @@ class NullRegistry:
 
     gauge = counter
     histogram = counter
+
+    def families(self) -> list:
+        return []
 
     def sample(self, name: str, **labels) -> None:
         return None

@@ -49,6 +49,7 @@ __all__ = [
     "record_intercepted", "record_enqueued", "record_decided",
     "record_decision", "record_released", "record_dispatched",
     "record_acked", "record_edge", "record_generation", "record_install",
+    "record_annotation",
 ]
 
 #: lifecycle stamp names, in causal order (export sorts tracks by the
@@ -530,3 +531,21 @@ def record_install(source: str, generation: Optional[int] = None,
                        if generation is None else generation),
         "t": time.monotonic() if now is None else now,
     })
+
+
+def record_annotation(kind: str, now: Optional[float] = None,
+                      **fields: Any) -> None:
+    """Stamp an out-of-band annotation onto the current run's search
+    track (e.g. an SLO breach transition, obs/slo.py). Annotations ride
+    the same bounded ``generations`` list the exporters already carry;
+    consumers dispatch on ``kind`` and ignore unknown kinds, so new
+    annotation kinds never break existing traces."""
+    if not metrics.enabled():
+        return
+    run = _recorder.current()
+    if run is None:
+        return
+    entry = {"kind": str(kind),
+             "t": time.monotonic() if now is None else now}
+    entry.update(fields)
+    run.add_generation(entry)

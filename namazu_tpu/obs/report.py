@@ -154,6 +154,29 @@ def render_markdown(payload: Dict[str, Any]) -> str:
             "orchestrator)")
     out("")
 
+    slo = payload.get("slo")
+    if slo is not None:
+        # present only when the config declared objectives
+        # (obs/slo.py); omitted entirely otherwise so slo-less payloads
+        # render byte-identically to pre-SLO reports
+        out("## SLO compliance")
+        out("")
+        objectives = slo.get("objectives", [])
+        if objectives:
+            out("| slo | kind | metric | threshold | burn | breached "
+                "| breaches |")
+            out("|---|---|---|---:|---:|---|---:|")
+            for row in objectives:
+                out(f"| {row.get('name')} | {row.get('kind')} "
+                    f"| {row.get('metric')} "
+                    f"| {_num(row.get('threshold_s'))}s "
+                    f"| {_num(row.get('burn'))} "
+                    f"| {_num(row.get('breached', False))} "
+                    f"| {_num(row.get('breaches'))} |")
+        else:
+            out("- no objectives declared")
+        out("")
+
     out("## Suspicious branches")
     out("")
     if suspicious:

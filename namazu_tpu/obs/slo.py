@@ -1,0 +1,274 @@
+"""Declarative SLOs over the federated metric stream (obs/federation.py).
+
+The fleet aggregator merges per-process metric pushes; this module sits
+on top of that stream and answers the operator question the raw
+registry cannot: *is the fleet keeping its latency promises right
+now?*  Objectives are declared in the experiment config::
+
+    [[slo]]
+    name = "dispatch_p99"
+    kind = "latency"                      # histogram-fraction objective
+    metric = "nmz_event_e2e_seconds"
+    threshold_s = 1.0                     # "good" = observation <= this
+    target = 0.99                         # fraction that must be good
+    window_s = 60
+
+    [[slo]]
+    name = "edge_staleness"
+    kind = "staleness"                    # fleet-max-gauge objective
+    metric = "nmz_edge_table_staleness_seconds"
+    threshold_s = 30
+
+and default to :data:`DEFAULT_SLOS` (dispatch p99, edge backhaul
+reconcile lag p99, edge table staleness) when the config declares none.
+
+**Burn rate** is the standard error-budget burn: for a latency
+objective, ``bad_fraction / (1 - target)`` over the sliding window —
+burn 1.0 means the budget is being consumed exactly as fast as it
+accrues, anything above is a breach; for a staleness objective,
+``fleet_max(gauge) / threshold``. Burn is published as
+``nmz_slo_burn{slo}`` on every evaluation, breach TRANSITIONS count in
+``nmz_slo_breaches_total{slo}``, land as one flight-recorder annotation
+record (``kind="slo"``, obs/recorder.py) and one run-tagged warning,
+and the full objective table rides the ``/fleet`` payload (and, when
+objectives were declared explicitly, the ``/analytics`` payload so
+``tools report`` shows compliance per run).
+
+The window is fed with histogram *bucket deltas* the aggregator
+computes while merging pushes — no second pass over the fleet state,
+and a replayed push (deduped by seq upstream) can never double-feed a
+window.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from namazu_tpu.obs import recorder, spans
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("obs.slo")
+
+__all__ = ["SLOSpec", "SLOEvaluator", "DEFAULT_SLOS", "specs_from_config"]
+
+KIND_LATENCY = "latency"
+KIND_STALENESS = "staleness"
+
+
+class SLOSpec:
+    """One declared objective (immutable)."""
+
+    __slots__ = ("name", "kind", "metric", "threshold_s", "target",
+                 "window_s")
+
+    def __init__(self, name: str, metric: str, threshold_s: float,
+                 kind: str = KIND_LATENCY, target: float = 0.99,
+                 window_s: float = 60.0) -> None:
+        if kind not in (KIND_LATENCY, KIND_STALENESS):
+            raise ValueError(f"slo {name!r}: unknown kind {kind!r} "
+                             f"(known: {KIND_LATENCY}, {KIND_STALENESS})")
+        if not name or not metric:
+            raise ValueError("slo needs a name and a metric")
+        self.name = str(name)
+        self.kind = kind
+        self.metric = str(metric)
+        self.threshold_s = float(threshold_s)
+        self.target = min(max(float(target), 0.0), 0.999999)
+        self.window_s = max(1.0, float(window_s))
+
+    def to_jsonable(self) -> Dict[str, Any]:
+        return {"name": self.name, "kind": self.kind,
+                "metric": self.metric, "threshold_s": self.threshold_s,
+                "target": self.target, "window_s": self.window_s}
+
+
+#: the objectives every fleet gets unless the config declares its own:
+#: generous thresholds — they exist to catch a *degrading* fleet, not to
+#: turn healthy CI runs red
+DEFAULT_SLOS: List[SLOSpec] = [
+    # latency thresholds sit ON metrics.DEFAULT_BUCKETS bounds: "good"
+    # is counted at bucket granularity, so a threshold inside a bucket
+    # (e.g. 2.0 in the (1.0, 2.5] bucket) would count legitimately-
+    # good observations as bad and breach a healthy fleet
+    SLOSpec("dispatch_p99", spans.EVENT_E2E, threshold_s=1.0,
+            target=0.99, window_s=60.0),
+    SLOSpec("backhaul_lag_p99", spans.EDGE_BACKHAUL_LAG, threshold_s=2.5,
+            target=0.99, window_s=60.0),
+    SLOSpec("edge_staleness", spans.EDGE_TABLE_STALENESS,
+            kind=KIND_STALENESS, threshold_s=30.0),
+]
+
+
+def specs_from_config(raw: Sequence[Dict[str, Any]]) -> List[SLOSpec]:
+    """Parse the config's ``slo`` table list; raises ValueError on a
+    malformed entry (a silently-ignored objective would report a
+    meaningless green)."""
+    specs = []
+    for i, entry in enumerate(raw or []):
+        if not isinstance(entry, dict):
+            raise ValueError(f"slo entry {i} is not a table")
+        try:
+            specs.append(SLOSpec(
+                name=entry["name"], metric=entry["metric"],
+                threshold_s=entry["threshold_s"],
+                kind=str(entry.get("kind", KIND_LATENCY)),
+                target=float(entry.get("target", 0.99)),
+                window_s=float(entry.get("window_s", 60.0))))
+        except KeyError as e:
+            raise ValueError(f"slo entry {i} is missing {e}") from None
+    return specs
+
+
+class _Window:
+    """Sliding (t, good, total) window for one latency objective."""
+
+    __slots__ = ("window_s", "entries", "good", "total")
+
+    def __init__(self, window_s: float) -> None:
+        self.window_s = window_s
+        self.entries: deque = deque()
+        self.good = 0
+        self.total = 0
+
+    def add(self, t: float, good: int, total: int) -> None:
+        if total <= 0:
+            return
+        self.entries.append((t, good, total))
+        self.good += good
+        self.total += total
+
+    def prune(self, now: float) -> None:
+        cutoff = now - self.window_s
+        entries = self.entries
+        while entries and entries[0][0] < cutoff:
+            _, g, n = entries.popleft()
+            self.good -= g
+            self.total -= n
+
+
+class SLOEvaluator:
+    """Burn-rate computation over the aggregator's merge stream.
+
+    ``explicit`` records whether the specs came from config (vs the
+    built-in defaults): only explicitly-declared objectives fold into
+    the ``/analytics`` payload, so the golden REST-vs-CLI parity of the
+    analytics document survives in fleets that never declared any."""
+
+    def __init__(self, specs: Sequence[SLOSpec],
+                 explicit: bool = False) -> None:
+        self.specs = list(specs)
+        names = [s.name for s in self.specs]
+        dupes = sorted({n for n in names if names.count(n) > 1})
+        if dupes:
+            # two same-named objectives would share one window and
+            # blend their good-counts — both rows would report a
+            # fabricated burn (a copy-pasted [[slo]] block that only
+            # changed threshold_s must fail loudly, not read green)
+            raise ValueError(f"duplicate slo name(s): {', '.join(dupes)}")
+        self.explicit = explicit
+        self._lock = threading.Lock()
+        self._windows: Dict[str, _Window] = {
+            s.name: _Window(s.window_s) for s in self.specs
+            if s.kind == KIND_LATENCY}
+        self._by_metric: Dict[str, List[SLOSpec]] = {}
+        for s in self.specs:
+            if s.kind == KIND_LATENCY:
+                self._by_metric.setdefault(s.metric, []).append(s)
+        self._breached: Dict[str, bool] = {}
+        self._breaches: Dict[str, int] = {}
+
+    def watches(self, metric: str) -> bool:
+        """Whether any latency objective consumes this histogram (the
+        aggregator only computes bucket deltas for watched metrics)."""
+        return metric in self._by_metric
+
+    def note_hist_delta(self, metric: str, uppers: Sequence[float],
+                        bucket_deltas: Sequence[int],
+                        now: Optional[float] = None) -> None:
+        """Feed one merged push's raw bucket deltas (len(uppers)+1,
+        last = the +Inf overflow) into every objective watching
+        ``metric``."""
+        specs = self._by_metric.get(metric)
+        if not specs:
+            return
+        now = time.monotonic() if now is None else now
+        total = int(sum(bucket_deltas))
+        if total <= 0:
+            return
+        with self._lock:
+            for spec in specs:
+                # "good" = observations in buckets whose upper bound is
+                # <= the threshold (bucket granularity is the histogram
+                # contract; pick thresholds on bucket bounds for exact
+                # accounting)
+                cut = bisect.bisect_right(list(uppers), spec.threshold_s)
+                good = int(sum(bucket_deltas[:cut]))
+                win = self._windows[spec.name]
+                win.add(now, good, total)
+                # prune on ingest too: an evaluator nobody reads
+                # (evaluate() only runs on /fleet or analytics reads)
+                # must not grow its window deque without bound
+                win.prune(now)
+
+    def evaluate(self, max_gauge: Callable[[str], Optional[float]],
+                 now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """The objective table (one row per SLO): burn, breach flag,
+        window occupancy. Publishes ``nmz_slo_burn``; breach
+        transitions count, warn, and stamp a flight-recorder
+        annotation. ``max_gauge(name)`` resolves a staleness
+        objective's fleet-max gauge value (None = no producer reports
+        it — burn 0, not a breach)."""
+        now = time.monotonic() if now is None else now
+        rows: List[Dict[str, Any]] = []
+        transitions: List[Dict[str, Any]] = []
+        with self._lock:
+            for spec in self.specs:
+                row = spec.to_jsonable()
+                if spec.kind == KIND_LATENCY:
+                    win = self._windows[spec.name]
+                    win.prune(now)
+                    good, total = win.good, win.total
+                    bad_frac = ((total - good) / total) if total else 0.0
+                    burn = bad_frac / (1.0 - spec.target)
+                    row.update(good=good, total=total,
+                               bad_fraction=round(bad_frac, 6))
+                else:
+                    value = max_gauge(spec.metric)
+                    burn = ((float(value) / spec.threshold_s)
+                            if value is not None and spec.threshold_s > 0
+                            else 0.0)
+                    row.update(value=value)
+                breached = burn >= 1.0
+                row.update(burn=round(burn, 4), breached=breached)
+                was = self._breached.get(spec.name, False)
+                self._breached[spec.name] = breached
+                if breached and not was:
+                    self._breaches[spec.name] = \
+                        self._breaches.get(spec.name, 0) + 1
+                    transitions.append(dict(row))
+                elif was and not breached:
+                    transitions.append(dict(row, recovered=True))
+                row["breaches"] = self._breaches.get(spec.name, 0)
+                rows.append(row)
+        # metrics/recorder/log OUTSIDE the lock: none of them may ever
+        # block a concurrent merge
+        for row in rows:
+            spans.slo_burn(row["name"], row["burn"])
+        for row in transitions:
+            if row.get("recovered"):
+                log.info("SLO %s recovered (burn %.2f)", row["name"],
+                         row["burn"])
+                continue
+            spans.slo_breach(row["name"])
+            recorder.record_annotation(
+                "slo", slo=row["name"], burn=row["burn"], breached=True,
+                threshold_s=row["threshold_s"])
+            log.warning(
+                "SLO %s BREACHED: burn %.2f over %gs window (metric %s, "
+                "threshold %gs)", row["name"], row["burn"],
+                row["window_s"], row["metric"], row["threshold_s"])
+        return rows

@@ -86,6 +86,28 @@ ENTITY_STALLED = "nmz_entity_stalled_total"
 EDGE_DECISIONS = "nmz_edge_decisions_total"
 TABLE_VERSION = "nmz_table_version"
 
+# edge observability (doc/observability.md "Fleet telemetry"): how far
+# behind the async backhaul runs (edge decision stamp -> orchestrator
+# reconcile stamp, same-host CLOCK_MONOTONIC), how stale the edge's
+# held table is vs its last server confirmation, the edge's parked-heap
+# depth, and the table version the edge currently decides with
+EDGE_BACKHAUL_LAG = "nmz_edge_backhaul_lag_seconds"
+EDGE_TABLE_STALENESS = "nmz_edge_table_staleness_seconds"
+EDGE_PARKED = "nmz_edge_parked_events"
+EDGE_TABLE_VERSION_HELD = "nmz_edge_table_version"
+
+# fleet telemetry federation (doc/observability.md "Fleet telemetry"):
+# relay push outcomes (producer side), fleet occupancy (aggregator
+# side), SLO burn rates + breach transitions, and campaign slot
+# outcomes (the supervisor's own producer metrics)
+TELEMETRY_PUSHES = "nmz_telemetry_pushes_total"
+TELEMETRY_FORWARD_DROPPED = "nmz_telemetry_forward_dropped_total"
+FLEET_INSTANCES = "nmz_fleet_instances"
+FLEET_STALE_INSTANCES = "nmz_fleet_stale_instances"
+SLO_BURN = "nmz_slo_burn"
+SLO_BREACHES = "nmz_slo_breaches_total"
+CAMPAIGN_SLOTS = "nmz_campaign_slots_total"
+
 # chaos + survivability plane (doc/robustness.md "Chaos plane"):
 # injected faults by point, ingress backpressure rejections, the
 # server-requested Retry-After delays the transceiver honored, and the
@@ -312,6 +334,128 @@ def table_version(version: int) -> None:
         TABLE_VERSION,
         "monotonic version of the published hash->delay table",
     ).set(version)
+
+
+def edge_backhaul_lag(entity: str, seconds: float) -> None:
+    """One edge-decided event's decision->reconcile lag, observed at
+    ``Orchestrator._ingest_edge_batch`` (the edge stamps and the
+    orchestrator clock share CLOCK_MONOTONIC on one host)."""
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.histogram(
+        EDGE_BACKHAUL_LAG,
+        "edge decision stamp -> orchestrator backhaul reconcile",
+        ("entity",),
+    ).labels(entity=_entity_label(reg, entity)).observe(max(0.0, seconds))
+
+
+def edge_table_staleness(entity: str, seconds: float) -> None:
+    """Seconds since this edge last confirmed its held table version
+    against the server (0 while on the central wire — central dispatch
+    cannot be stale)."""
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.gauge(
+        EDGE_TABLE_STALENESS,
+        "seconds since the edge's held table was last confirmed "
+        "against the server",
+        ("entity",),
+    ).labels(entity=_entity_label(reg, entity)).set(max(0.0, seconds))
+
+
+def edge_parked(entity: str, depth: int) -> None:
+    """Events parked in the edge dispatcher's delayed-release heap."""
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.gauge(
+        EDGE_PARKED,
+        "events parked in the edge dispatcher's delayed-release heap",
+        ("entity",),
+    ).labels(entity=_entity_label(reg, entity)).set(depth)
+
+
+def edge_table_version_held(entity: str, version: int) -> None:
+    """The table version this edge currently decides with (0 = central
+    fallback); the fleet view diffs it against ``nmz_table_version`` to
+    surface table-version skew."""
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.gauge(
+        EDGE_TABLE_VERSION_HELD,
+        "table version the edge currently decides with (0 = central)",
+        ("entity",),
+    ).labels(entity=_entity_label(reg, entity)).set(version)
+
+
+# -- fleet telemetry federation (doc/observability.md) --------------------
+
+def telemetry_push(ok: bool) -> None:
+    """One relay push cycle's outcome (producer side)."""
+    if not metrics.enabled():
+        return
+    metrics.get().counter(
+        TELEMETRY_PUSHES, "telemetry relay push cycles", ("ok",),
+    ).labels(ok=str(bool(ok)).lower()).inc()
+
+
+def telemetry_forward_dropped(n: int = 1) -> None:
+    """Foreign telemetry docs dropped from a full forward buffer (the
+    federation hop stayed bounded through an upstream outage)."""
+    if n <= 0 or not metrics.enabled():
+        return
+    metrics.get().counter(
+        TELEMETRY_FORWARD_DROPPED,
+        "forwarded telemetry docs dropped by the bounded buffer",
+    ).inc(n)
+
+
+def fleet_occupancy(instances: int, stale: int) -> None:
+    """Aggregator-side view: producers currently merged, and how many
+    have gone silent past their staleness window."""
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.gauge(FLEET_INSTANCES,
+              "producer instances in the fleet aggregator").set(instances)
+    reg.gauge(FLEET_STALE_INSTANCES,
+              "fleet producers silent past their staleness window",
+              ).set(stale)
+
+
+def slo_burn(name: str, burn: float) -> None:
+    """Current burn rate of one declared SLO (>= 1 = the objective is
+    being violated over its window; obs/slo.py)."""
+    if not metrics.enabled():
+        return
+    metrics.get().gauge(
+        SLO_BURN,
+        "SLO burn rate (>= 1 = objective violated over its window)",
+        ("slo",),
+    ).labels(slo=name).set(burn)
+
+
+def slo_breach(name: str) -> None:
+    """One breach TRANSITION (burn crossed 1.0 upward) of an SLO."""
+    if not metrics.enabled():
+        return
+    metrics.get().counter(
+        SLO_BREACHES, "SLO breach transitions", ("slo",),
+    ).labels(slo=name).inc()
+
+
+def campaign_slot(cls: str) -> None:
+    """One finished campaign run slot, by outcome class (the supervisor
+    process's own producer metrics for the fleet view)."""
+    if not metrics.enabled():
+        return
+    metrics.get().counter(
+        CAMPAIGN_SLOTS, "campaign run slots finished, by class",
+        ("slot_class",),
+    ).labels(slot_class=cls).inc()
 
 
 def chaos_fault_injected(point: str) -> None:

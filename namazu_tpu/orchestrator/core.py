@@ -24,6 +24,7 @@ import os
 import queue
 import signal as _signal
 import threading
+import time
 import uuid as _uuid
 from typing import Optional
 
@@ -170,6 +171,20 @@ class Orchestrator:
         self._add_thread(self._forward_loop_factory(self.dumb), "fwd-dumb")
         if self.liveness_timeout_s > 0:
             self._add_thread(self._watchdog_loop, "watchdog")
+        # fleet telemetry (doc/observability.md "Fleet telemetry"): this
+        # process is a producer — its registry rides the process relay
+        # into the local aggregator (serving GET /fleet here) and, when
+        # an upstream collector is named (config telemetry_url, or the
+        # NMZ_TELEMETRY_URL a campaign supervisor exports to its run
+        # children), pushed + forwarded upstream too. ensure_self_relay
+        # is idempotent: a CLI layer that already named this process's
+        # job (e.g. `run`) wins.
+        push_url = str(self.config.get("telemetry_url", "") or "") \
+            or os.environ.get("NMZ_TELEMETRY_URL", "")
+        obs.federation.ensure_self_relay(
+            "orchestrator", push_url=push_url,
+            interval_s=float(
+                self.config.get("telemetry_interval_s", 2.0) or 2.0))
         log.debug("orchestrator started (enabled=%s)", self.enabled)
 
     def _recover_journal(self) -> None:
@@ -385,12 +400,20 @@ class Orchestrator:
         so nothing is forwarded, journaled, or queued through the
         policy/action loops."""
         policy_name = (self.policy if self.enabled else self.dumb).name
+        now_mono = time.monotonic()
         for ev in events:
             d = ev._edge_decision
             action = ev.default_action()
             action.mark_triggered(now=d.get("triggered_wall"))
             obs.record_edge(ev, getattr(ev, "_edge_endpoint", ""),
                             policy_name, action, d)
+            # backhaul reconciliation lag: the edge's dispatch stamp ->
+            # this reconcile, both CLOCK_MONOTONIC on one host — the
+            # fleet-level answer to "is the 151k/s edge plane keeping
+            # its async-backhaul promise" (doc/observability.md)
+            stamp = d.get("t_dispatched")
+            if isinstance(stamp, (int, float)):
+                obs.edge_backhaul_lag(ev.entity_id, now_mono - stamp)
             if self.collect_trace:
                 self.trace.append(action)
         obs.action_dispatched("edge", None, n=len(events))

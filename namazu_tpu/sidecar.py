@@ -288,6 +288,8 @@ class SidecarServer:
                 conn.close()
             except OSError:
                 pass
+        if self.knowledge is not None:
+            self.knowledge.close()
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -305,7 +307,14 @@ class SidecarServer:
         everything else to the search service."""
         op = req.get("op")
         from namazu_tpu.knowledge import KNOWLEDGE_OPS
+        from namazu_tpu.obs import federation
 
+        # observability ops (obs/federation.py): the sidecar's framed
+        # wire doubles as a telemetry push target / fleet surface, so
+        # knowledge-plane processes can aggregate without an HTTP stack
+        obs_resp = federation.handle_obs_op(req)
+        if obs_resp is not None:
+            return obs_resp
         if op in KNOWLEDGE_OPS:
             if self.knowledge is None:
                 resp = {"ok": False,
@@ -369,10 +378,12 @@ def request(addr: str, req: dict, timeout: float = 300.0) -> dict:
 
 
 def serve_sidecar(host: str, port: int, pool_dir: str = "",
-                  state_dir: str = "") -> int:
+                  state_dir: str = "", telemetry_url: str = "") -> int:
     """CLI entry: serve until interrupted. ``pool_dir`` enables the
     multi-tenant knowledge service (doc/knowledge.md) on the same
-    wire."""
+    wire; ``telemetry_url`` pushes this process's metrics to a fleet
+    aggregator so the sidecar shows up in the campaign's ``/fleet``
+    view (doc/observability.md "Fleet telemetry")."""
     knowledge = None
     if pool_dir:
         from namazu_tpu.knowledge import KnowledgeService
@@ -382,6 +393,12 @@ def serve_sidecar(host: str, port: int, pool_dir: str = "",
                  knowledge.pool_dir)
     server = SidecarServer(host, port, knowledge=knowledge)
     server.start()
+    from namazu_tpu.obs import federation
+
+    federation.ensure_self_relay(
+        "sidecar",
+        push_url=(telemetry_url
+                  or os.environ.get("NMZ_TELEMETRY_URL", "")))
     try:
         threading.Event().wait()
     except KeyboardInterrupt:

@@ -234,3 +234,45 @@ def test_campaign_cli(tmp_path, capsys):
     assert summary["experiment"] == 2
     assert summary["stopped_reason"] == "done"
     assert summary["unclassified"] == 0
+
+
+def test_campaign_fleet_telemetry_federates_children(tmp_path):
+    """Fleet telemetry e2e (doc/observability.md "Fleet telemetry"):
+    the supervisor hosts a uds collector, exports NMZ_TELEMETRY_URL,
+    and every `run` child pushes its registry there — so after a 2-run
+    campaign the ONE aggregator holds the supervisor plus both child
+    processes, each under its own (job, instance), with the
+    supervisor's slot counters riding its own relay like any other
+    producer's."""
+    from namazu_tpu.obs import federation, metrics
+    from namazu_tpu.obs.metrics import MetricsRegistry
+
+    storage = _init_storage(tmp_path)
+    old_reg = metrics.set_registry(MetricsRegistry())
+    metrics.configure(True)
+    federation.reset()
+    try:
+        campaign = Campaign(_spec(storage, runs=2))
+        assert campaign.run() == EXIT_OK
+        assert campaign._telemetry_server is None  # shut down cleanly
+        relay = federation.self_relay()
+        assert relay is not None
+        relay.flush()  # land the final slot counters deterministically
+        payload = federation.aggregator().payload()
+        by_job = {}
+        for row in payload["instances"]:
+            by_job.setdefault(row["job"], []).append(row)
+        assert "campaign" in by_job
+        assert len(by_job.get("run", [])) == 2  # one per child process
+        # the supervisor's own producer metrics made it into the merge
+        sup = campaign._collector_path()
+        st = federation.aggregator()._instances[
+            ("campaign", federation.self_relay().instance)]
+        slots = st.families.get("nmz_campaign_slots_total")
+        assert slots is not None
+        assert sum(slots.samples.values()) == 2.0
+        assert sup.endswith("telemetry.sock")
+    finally:
+        federation.reset()
+        metrics.set_registry(old_reg)
+        metrics.configure(True)

@@ -1,0 +1,872 @@
+"""Fleet telemetry federation (doc/observability.md "Fleet telemetry").
+
+The merge-semantics contracts the plane stands on:
+
+* **idempotence** — pushes carry absolute cumulatives under a per-
+  instance seq watermark, so a replayed push whose ack was lost can
+  never double-count, and a push cycle that failed mid-outage re-sends
+  fresh absolutes that land exactly once;
+* **bit-exactness** — a single-process run's federated counters and
+  histogram buckets are bit-identical to the local registry;
+* **bounded cardinality** — the post-merge per-family series cap holds
+  and folds are counted, never silently summed;
+* **staleness over staleness-lies** — /fleet marks a silent producer
+  stale (then evicts it) instead of serving its frozen numbers.
+
+Plus the SLO layer (obs/slo.py): burn-rate from federated bucket
+deltas, breach transitions -> gauge + counter + flight-recorder
+annotation, config parsing, and the explicit-only analytics fold.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from namazu_tpu import chaos, obs
+from namazu_tpu.chaos.plan import FaultPlan
+from namazu_tpu.obs import federation, metrics, recorder, slo, spans
+from namazu_tpu.obs.metrics import MetricsRegistry
+from namazu_tpu.obs.recorder import FlightRecorder
+
+
+@pytest.fixture(autouse=True)
+def fresh_obs():
+    """Isolated registry + recorder + federation wiring per test."""
+    old_reg = metrics.set_registry(MetricsRegistry())
+    metrics.configure(True)
+    old_rec = recorder.set_recorder(
+        FlightRecorder(max_runs=4, max_records=1 << 10))
+    federation.reset()
+    yield
+    federation.reset()
+    recorder.set_recorder(old_rec)
+    metrics.set_registry(old_reg)
+    metrics.configure(True)
+
+
+def _populate(reg):
+    """A representative workload: labeled counter, gauge, histogram."""
+    reg.counter("nmz_events_intercepted_total", "events",
+                ("endpoint", "entity")) \
+        .labels(endpoint="rest", entity="e0").inc(7)
+    reg.counter("nmz_events_intercepted_total", "events",
+                ("endpoint", "entity")) \
+        .labels(endpoint="rest", entity="e1").inc(3)
+    reg.gauge("nmz_table_version", "version").set(5)
+    h = reg.histogram("nmz_event_e2e_seconds", "e2e", ("entity",),
+                      buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 2.0, 0.05):
+        h.labels(entity="e0").observe(v)
+
+
+def _relay_into(agg, reg=None, **kw):
+    return federation.TelemetryRelay(
+        "test", instance="i1", push=agg.note_push, registry=reg, **kw)
+
+
+# -- bit-exactness -------------------------------------------------------
+
+
+def test_single_process_federation_bit_identical():
+    """Every sample the local registry holds must appear upstream with
+    the exact same value after one push — counters, gauges, and raw
+    histogram buckets/sum/count alike."""
+    reg = metrics.registry()
+    _populate(reg)
+    # snapshot the expectation BEFORE the push: the push itself mints
+    # bookkeeping series (nmz_telemetry_pushes_total, fleet occupancy)
+    # that belong to the NEXT delta cycle
+    expected = {}
+    for fam in reg.families():
+        for key, child in fam.items():
+            if isinstance(child, metrics.Histogram):
+                uppers, counts, hsum, hcount = child.raw_state()
+                expected[(fam.name, key)] = (list(uppers),
+                                             (counts, hsum, hcount))
+            else:
+                expected[(fam.name, key)] = (None, child.value)
+    agg = federation.FleetAggregator()
+    _relay_into(agg).flush()
+
+    st = agg._instances[("test", "i1")]
+    for (name, key), (uppers, value) in expected.items():
+        fs = st.families[name]
+        if uppers is not None:
+            assert fs.uppers == uppers
+        assert fs.samples[key] == value
+
+
+def test_prometheus_exposition_carries_job_instance():
+    reg = metrics.registry()
+    _populate(reg)
+    agg = federation.FleetAggregator()
+    _relay_into(agg).flush()
+    text = agg.prometheus()
+    assert ('nmz_events_intercepted_total{job="test",instance="i1",'
+            'endpoint="rest",entity="e0"} 7' in text)
+    assert 'le="+Inf"} 5' in text
+    assert "# TYPE nmz_event_e2e_seconds histogram" in text
+
+
+def test_histogram_merge_bit_exact_vs_single_registry():
+    """Two producers' bucket merges must equal one registry that saw
+    every observation (the fleet p99 is computed over the sum)."""
+    obs_a = (0.005, 0.05, 0.5)
+    obs_b = (0.05, 2.0, 0.009, 0.2)
+    buckets = (0.01, 0.1, 1.0)
+    agg = federation.FleetAggregator()
+    for inst, values in (("a", obs_a), ("b", obs_b)):
+        reg = MetricsRegistry()
+        h = reg.histogram("nmz_event_e2e_seconds", "", buckets=buckets)
+        for v in values:
+            h.observe(v)
+        federation.TelemetryRelay(
+            "job", instance=inst, push=agg.note_push,
+            registry=reg).flush()
+    single = metrics.Histogram(buckets=buckets)
+    for v in obs_a + obs_b:
+        single.observe(v)
+    uppers, counts, hsum, hcount = single.raw_state()
+    merged = [0] * (len(buckets) + 1)
+    msum = 0.0
+    mcount = 0
+    for key, st in agg._instances.items():
+        c, s, n = st.families["nmz_event_e2e_seconds"].samples[()]
+        merged = [m + x for m, x in zip(merged, c)]
+        msum += s
+        mcount += n
+    assert merged == counts
+    assert msum == hsum
+    assert mcount == hcount
+
+
+# -- idempotence ---------------------------------------------------------
+
+
+def test_replayed_push_acked_but_not_merged():
+    """A retried push whose 200 was lost must not double-count."""
+    agg = federation.FleetAggregator()
+    doc = {"schema": federation.SCHEMA, "job": "j", "instance": "i",
+           "seq": 1, "families": [
+               {"name": "nmz_x_total", "type": "counter",
+                "labelnames": [], "samples": [{"labels": {},
+                                               "value": 5.0}]}]}
+    ack1 = agg.note_push(json.loads(json.dumps(doc)))
+    ack2 = agg.note_push(json.loads(json.dumps(doc)))  # the replay
+    assert ack1["ok"] and ack2["ok"]
+    assert ack2.get("duplicate") is True
+    st = agg._instances[("j", "i")]
+    assert st.families["nmz_x_total"].samples[()] == 5.0
+    assert st.duplicates == 1
+    # an out-of-order stale seq is also ack-only
+    stale = dict(doc, seq=0)
+    assert agg.note_push(stale).get("duplicate") is True
+
+
+def test_lost_ack_cycle_never_double_counts():
+    """Relay-level contract: a push that reached the aggregator but
+    whose ack was lost in flight re-sends ABSOLUTES next cycle — the
+    merged total equals the registry, not registry + replayed delta."""
+    reg = metrics.registry()
+    c = reg.counter("nmz_x_total", "")
+    agg = federation.FleetAggregator()
+    calls = {"n": 0}
+
+    def flaky_push(doc):
+        ack = agg.note_push(doc)
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("ack lost in flight")  # server DID merge
+        return ack
+
+    relay = federation.TelemetryRelay("j", instance="i",
+                                      push=flaky_push)
+    c.inc(5)
+    relay.flush()   # merged upstream, ack lost
+    c.inc(3)
+    relay.flush()   # clean
+    st = agg._instances[("j", "i")]
+    assert st.families["nmz_x_total"].samples[()] == 8.0
+
+
+def test_delta_encoder_sends_only_changes_after_ack():
+    reg = metrics.registry()
+    c = reg.counter("nmz_x_total", "")
+    g = reg.gauge("nmz_g", "")
+    c.inc(2)
+    g.set(1)
+    enc = federation.DeltaEncoder(reg)
+    fams, fps = enc.encode()
+    assert {f["name"] for f in fams} == {"nmz_g", "nmz_x_total"}
+    enc.mark_acked(fps)
+    fams, fps = enc.encode()
+    assert fams == []  # nothing changed since the ack
+    c.inc(1)
+    fams, _ = enc.encode()
+    assert [f["name"] for f in fams] == ["nmz_x_total"]
+    assert fams[0]["samples"][0]["value"] == 3.0  # absolute, not delta
+
+
+# -- bounded cardinality -------------------------------------------------
+
+
+def test_label_cardinality_cap_post_merge():
+    agg = federation.FleetAggregator()
+    cap = federation.FleetAggregator.MAX_SAMPLES_PER_FAMILY
+    samples = [{"labels": {"entity": f"e{i}"}, "value": 1.0}
+               for i in range(cap + 10)]
+    doc = {"schema": federation.SCHEMA, "job": "j", "instance": "i",
+           "seq": 1, "families": [
+               {"name": "nmz_x_total", "type": "counter",
+                "labelnames": ["entity"], "samples": samples}]}
+    agg.note_push(doc)
+    st = agg._instances[("j", "i")]
+    assert len(st.families["nmz_x_total"].samples) == cap
+    assert agg.payload()["series_folded"] == 10
+    # an EXISTING series keeps updating even at the cap
+    upd = {"schema": federation.SCHEMA, "job": "j", "instance": "i",
+           "seq": 2, "families": [
+               {"name": "nmz_x_total", "type": "counter",
+                "labelnames": ["entity"],
+                "samples": [{"labels": {"entity": "e0"},
+                             "value": 9.0}]}]}
+    agg.note_push(upd)
+    assert st.families["nmz_x_total"].samples[("e0",)] == 9.0
+
+
+def test_malformed_docs_rejected():
+    agg = federation.FleetAggregator()
+    for bad in (None, {}, {"schema": "nope"},
+                {"schema": federation.SCHEMA, "job": "", "instance": "i",
+                 "seq": 1},
+                {"schema": federation.SCHEMA, "job": "j", "instance": "i",
+                 "seq": "x"}):
+        with pytest.raises(ValueError):
+            agg.note_push(bad)
+
+
+# -- staleness + eviction ------------------------------------------------
+
+
+def test_fleet_marks_stale_then_evicts():
+    agg = federation.FleetAggregator(stale_after_s=1.0,
+                                     evict_after_s=50.0)
+    t0 = 1000.0
+    doc = {"schema": federation.SCHEMA, "job": "j", "instance": "i",
+           "seq": 1, "families": []}
+    agg.note_push(doc, now=t0)
+    fresh = agg.payload(now=t0 + 0.5)
+    assert fresh["instances"][0]["stale"] is False
+    stale = agg.payload(now=t0 + 5.0)
+    assert stale["instances"][0]["stale"] is True
+    assert stale["stale_instances"] == 1
+    gone = agg.payload(now=t0 + 100.0)
+    assert gone["instance_count"] == 0  # evicted, not frozen
+
+
+def test_stale_window_defaults_to_push_interval():
+    agg = federation.FleetAggregator()  # stale_after 0 = auto
+    t0 = 50.0
+    doc = {"schema": federation.SCHEMA, "job": "j", "instance": "i",
+           "seq": 1, "interval_s": 10.0, "families": []}
+    agg.note_push(doc, now=t0)
+    assert agg.payload(now=t0 + 20.0)["instances"][0]["stale"] is False
+    assert agg.payload(now=t0 + 31.0)["instances"][0]["stale"] is True
+
+
+# -- events/s + fleet summary --------------------------------------------
+
+
+def test_events_per_sec_rate_derived_across_pushes():
+    agg = federation.FleetAggregator()
+
+    def doc(seq, total, t):
+        return ({"schema": federation.SCHEMA, "job": "j",
+                 "instance": "i", "seq": seq, "families": [
+                     {"name": spans.EVENTS_INTERCEPTED,
+                      "type": "counter", "labelnames": ["endpoint"],
+                      "samples": [{"labels": {"endpoint": "rest"},
+                                   "value": float(total)}]}]}, t)
+
+    d1, t1 = doc(1, 100, 10.0)
+    d2, t2 = doc(2, 300, 20.0)
+    agg.note_push(d1, now=t1)
+    agg.note_push(d2, now=t2)
+    row = agg.payload(now=t2)["instances"][0]
+    assert row["events_per_sec"] == pytest.approx(20.0)
+    assert row["events_total"] == 300.0
+
+
+# -- the relay outage contract -------------------------------------------
+
+
+def test_relay_outage_one_warning_then_recovers(caplog):
+    reg = metrics.registry()
+    c = reg.counter("nmz_x_total", "")
+    agg = federation.FleetAggregator()
+    down = {"on": True}
+
+    def push(doc):
+        if down["on"]:
+            raise OSError("collector down")
+        return agg.note_push(doc)
+
+    relay = federation.TelemetryRelay("j", instance="i", push=push)
+    c.inc(1)
+    import logging
+    with caplog.at_level(logging.WARNING, logger=federation.log.name):
+        relay.flush()
+        c.inc(1)
+        relay.flush()  # still down: must NOT warn again
+    warn = [r for r in caplog.records
+            if "telemetry push" in r.getMessage()
+            and r.levelno >= logging.WARNING]
+    assert len(warn) == 1
+    down["on"] = False
+    relay.flush()
+    st = agg._instances[("j", "i")]
+    assert st.families["nmz_x_total"].samples[()] == 2.0  # nothing lost
+    # a NEW outage after recovery warns once again
+    down["on"] = True
+    with caplog.at_level(logging.WARNING, logger=federation.log.name):
+        relay.flush()
+    warn = [r for r in caplog.records
+            if "telemetry push" in r.getMessage()
+            and r.levelno >= logging.WARNING]
+    assert len(warn) == 2
+
+
+def test_chaos_drop_seam_degrades_like_outage():
+    reg = metrics.registry()
+    reg.counter("nmz_x_total", "").inc(4)
+    agg = federation.FleetAggregator()
+    relay = federation.TelemetryRelay("j", instance="i",
+                                      push=agg.note_push)
+    chaos.install(FaultPlan(3, {"telemetry.push.drop": {"prob": 1.0,
+                                                        "max_fires": 2}}))
+    try:
+        relay.flush()
+        relay.flush()
+        assert ("j", "i") not in agg._instances  # both dropped
+        relay.flush()  # plan exhausted: full absolutes land now
+    finally:
+        chaos.clear()
+    assert agg._instances[("j", "i")] \
+        .families["nmz_x_total"].samples[()] == 4.0
+
+
+def test_flush_never_raises_into_host_code():
+    relay = federation.TelemetryRelay(
+        "j", instance="i",
+        push=lambda doc: (_ for _ in ()).throw(RuntimeError("boom")))
+    relay.flush()  # must not raise
+
+
+# -- federation hop ------------------------------------------------------
+
+
+def test_forward_hop_preserves_identity_and_bounds():
+    top = federation.FleetAggregator()
+    mid = federation.FleetAggregator()
+    mid.enable_forwarding()
+    # a foreign producer pushes into the mid-tier aggregator
+    foreign = {"schema": federation.SCHEMA, "job": "inspector",
+               "instance": "edge-1", "seq": 1, "families": []}
+    mid.note_push(foreign)
+    relay = federation.TelemetryRelay("run", instance="child-1",
+                                      push=top.note_push, local=None,
+                                      forward_source=mid)
+    relay.flush()
+    assert ("run", "child-1") in top._instances  # own doc
+    assert ("inspector", "edge-1") in top._instances  # forwarded doc
+    # the forward buffer is bounded; overflow is counted not grown
+    for i in range(federation.FleetAggregator.FORWARD_CAP + 5):
+        mid.note_push({"schema": federation.SCHEMA, "job": "inspector",
+                       "instance": f"e{i}", "seq": 1, "families": []})
+    assert len(mid._forward) <= federation.FleetAggregator.FORWARD_CAP
+    assert mid._forward_dropped >= 5
+
+
+def test_forward_failure_requeues_all_undelivered_docs():
+    """A failed hop must requeue EVERY undelivered doc, not just the
+    one that failed — the rest of the drained buffer would otherwise
+    vanish silently (the producers already got their acks from the
+    mid-tier, so quiescent samples would never ride again)."""
+    mid = federation.FleetAggregator()
+    mid.enable_forwarding()
+    for i in range(3):
+        mid.note_push({"schema": federation.SCHEMA, "job": "inspector",
+                       "instance": f"edge-{i}", "seq": 1,
+                       "families": []})
+    assert len(mid._forward) == 3
+
+    seen = []
+
+    def push(doc):
+        # own doc + first forwarded doc succeed, then the wire dies
+        if len(seen) >= 2:
+            raise OSError("wire down")
+        seen.append(doc)
+        return {"ok": True}
+
+    relay = federation.TelemetryRelay("run", instance="child-1",
+                                      push=push, forward_source=mid)
+    relay.flush()
+    # 1 own + 1 forwarded delivered; the 2 undelivered docs are BOTH
+    # back in the buffer, in their original order, none counted lost
+    assert len(seen) == 2
+    requeued = [d["instance"] for d in mid._forward]
+    assert requeued == ["edge-1", "edge-2"]
+    assert mid._forward_dropped == 0
+
+
+# -- SLO layer -----------------------------------------------------------
+
+
+def test_slo_specs_from_config_validation():
+    specs = slo.specs_from_config([
+        {"name": "p99", "metric": "nmz_event_e2e_seconds",
+         "threshold_s": 0.1, "target": 0.9, "window_s": 30},
+    ])
+    assert specs[0].name == "p99" and specs[0].window_s == 30.0
+    with pytest.raises(ValueError):
+        slo.specs_from_config([{"name": "x"}])  # missing keys
+    with pytest.raises(ValueError):
+        slo.specs_from_config([{"name": "x", "metric": "m",
+                                "threshold_s": 1, "kind": "nope"}])
+    with pytest.raises(ValueError):
+        slo.specs_from_config(["not-a-table"])
+
+
+def test_latency_burn_breach_and_recovery():
+    spec = slo.SLOSpec("p99", "nmz_event_e2e_seconds", threshold_s=0.1,
+                       target=0.9, window_s=60.0)
+    ev = slo.SLOEvaluator([spec], explicit=True)
+    run_id = obs.begin_run("slo-test")
+    uppers = [0.01, 0.1, 1.0]
+    t = 100.0
+    # 10 observations, 5 bad (> 0.1s): bad_frac 0.5, budget 0.1 -> burn 5
+    ev.note_hist_delta("nmz_event_e2e_seconds", uppers,
+                       [3, 2, 4, 1], now=t)
+    rows = ev.evaluate(lambda name: None, now=t)
+    assert rows[0]["burn"] == pytest.approx(5.0)
+    assert rows[0]["breached"] is True
+    assert rows[0]["breaches"] == 1
+    # burn gauge published
+    assert metrics.registry().sample(
+        spans.SLO_BURN, slo="p99").value == pytest.approx(5.0)
+    # breach transition counted once, not per evaluation
+    ev.evaluate(lambda name: None, now=t + 1)
+    assert metrics.registry().sample(
+        spans.SLO_BREACHES, slo="p99").value == 1.0
+    # flight-recorder annotation stamped at the transition
+    run = obs.trace_run(run_id)
+    annotations = [g for g in run.generations if g.get("kind") == "slo"]
+    assert len(annotations) == 1
+    assert annotations[0]["slo"] == "p99"
+    # the window slides: after it empties, burn 0 and a recovery
+    rows = ev.evaluate(lambda name: None, now=t + 120.0)
+    assert rows[0]["burn"] == 0.0
+    assert rows[0]["breached"] is False
+    assert rows[0]["breaches"] == 1
+
+
+def test_staleness_objective_uses_fleet_max_gauge():
+    spec = slo.SLOSpec("edge_staleness",
+                       "nmz_edge_table_staleness_seconds",
+                       kind=slo.KIND_STALENESS, threshold_s=10.0)
+    ev = slo.SLOEvaluator([spec])
+    rows = ev.evaluate(lambda name: 25.0, now=1.0)
+    assert rows[0]["burn"] == pytest.approx(2.5)
+    assert rows[0]["breached"] is True
+    rows = ev.evaluate(lambda name: None, now=2.0)  # nobody reports it
+    assert rows[0]["burn"] == 0.0 and rows[0]["breached"] is False
+
+
+def test_aggregator_feeds_watched_histograms_into_slo():
+    agg = federation.FleetAggregator()
+    agg.set_slos([slo.SLOSpec("p99", "nmz_event_e2e_seconds",
+                              threshold_s=0.1, target=0.9)],
+                 explicit=True)
+
+    def doc(seq, counts):
+        return {"schema": federation.SCHEMA, "job": "j", "instance": "i",
+                "seq": seq, "families": [
+                    {"name": "nmz_event_e2e_seconds",
+                     "type": "histogram", "labelnames": [],
+                     "uppers": [0.01, 0.1, 1.0],
+                     "samples": [{"labels": {}, "counts": counts,
+                                  "sum": 1.0,
+                                  "count": sum(counts)}]}]}
+
+    t = 10.0
+    agg.note_push(doc(1, [1, 1, 0, 0]), now=t)
+    agg.note_push(doc(2, [1, 1, 4, 4]), now=t + 1)  # delta: 8 bad
+    payload = agg.payload(now=t + 2)
+    row = next(r for r in payload["slo"]["objectives"]
+               if r["name"] == "p99")
+    assert row["total"] == 10
+    assert row["good"] == 2
+    assert row["breached"] is True
+    assert payload["slo"]["explicit"] is True
+    # a replayed push must not double-feed the window
+    agg.note_push(doc(2, [1, 1, 4, 4]), now=t + 3)
+    row = next(r for r in agg.payload(now=t + 3)["slo"]["objectives"]
+               if r["name"] == "p99")
+    assert row["total"] == 10
+
+
+def test_slo_summary_only_when_explicit():
+    agg = federation.FleetAggregator()
+    federation.set_aggregator(agg)
+    assert federation.slo_summary() is None  # defaults are implicit
+    agg.set_slos(slo.DEFAULT_SLOS, explicit=True)
+    assert federation.slo_summary() is not None
+
+
+# -- wiring + config -----------------------------------------------------
+
+
+def test_configure_from_config_slo_and_windows():
+    from namazu_tpu.utils.config import Config
+
+    cfg = Config()
+    cfg.set("slo", [{"name": "p99", "metric": "nmz_event_e2e_seconds",
+                     "threshold_s": 0.5}])
+    cfg.set("fleet_stale_after_s", 7.0)
+    federation.configure_from_config(cfg)
+    agg = federation.aggregator()
+    assert agg.stale_after_s == 7.0
+    assert agg.slo_evaluator.explicit is True
+    assert agg.slo_evaluator.specs[0].name == "p99"
+
+
+def test_disabled_plane_spawns_nothing():
+    federation.configure(False)
+    assert federation.ensure_self_relay("job") is None
+    relay = federation.TelemetryRelay("j")
+    relay.start()
+    assert relay._thread is None
+
+
+def test_ensure_self_relay_idempotent_with_late_upstream():
+    agg = federation.FleetAggregator()
+    r1 = federation.ensure_self_relay("run")
+    r2 = federation.ensure_self_relay("run")
+    assert r1 is r2
+    assert r1._push is None
+    # a sample acked during the push-less era (local-only merges mark
+    # acked too) ...
+    metrics.registry().counter("nmz_late_total", "").inc(5)
+    r1.flush()
+    calls = []
+    r1.set_upstream(lambda doc: calls.append(doc) or {"ok": True})
+    r1.flush()
+    assert calls  # the upgraded upstream received the push
+    # ... must STILL reach the late-bound upstream: set_upstream resets
+    # the encoder, so quiescent series are re-sent as full state
+    names = {f["name"] for doc in calls
+             for f in doc.get("families") or []}
+    assert "nmz_late_total" in names
+    r1.shutdown()
+
+
+# -- framed wire (collector + uds scheme) --------------------------------
+
+
+def test_telemetry_server_roundtrip_uds(tmp_path):
+    path = str(tmp_path / "collector.sock")
+    agg = federation.FleetAggregator()
+    server = federation.TelemetryServer(path, agg=agg)
+    server.start()
+    try:
+        push = federation.pusher_for(f"uds://{path}")
+        metrics.registry().counter("nmz_x_total", "").inc(2)
+        relay = federation.TelemetryRelay("run", instance="c1",
+                                          push=push)
+        relay.flush()
+        fleet = federation.fetch(f"uds://{path}", "fleet")
+        assert fleet["schema"] == federation.FLEET_SCHEMA
+        assert fleet["instance_count"] == 1
+        assert fleet["instances"][0]["instance"] == "c1"
+        prom = federation.fetch(f"uds://{path}", "fleet", fmt="prom")
+        assert 'nmz_x_total{job="run",instance="c1"} 2' in prom
+        # the metrics op dumps the SERVER process's local registry
+        local = federation.fetch(f"uds://{path}", "metrics")
+        assert isinstance(local, dict)
+    finally:
+        server.shutdown()
+    assert not os.path.exists(path)
+
+
+def test_telemetry_server_refuses_live_listener(tmp_path):
+    path = str(tmp_path / "collector.sock")
+    server = federation.TelemetryServer(path)
+    server.start()
+    try:
+        with pytest.raises(RuntimeError):
+            federation.TelemetryServer(path).start()
+    finally:
+        server.shutdown()
+
+
+def test_pusher_for_rejects_unknown_scheme():
+    with pytest.raises(ValueError):
+        federation.pusher_for("ftp://nope")
+    with pytest.raises(ValueError):
+        federation.fetch("ftp://nope", "fleet")
+    with pytest.raises(ValueError):
+        federation.fetch("http://x", "nope")
+
+
+# -- collectors (sampled gauges) -----------------------------------------
+
+
+def test_collectors_run_before_encode_and_unregister():
+    seen = []
+
+    def collect():
+        seen.append(1)
+        metrics.registry().gauge("nmz_edge_parked_events", "",
+                                 ("entity",)).labels(entity="e").set(3)
+
+    federation.register_collector(collect)
+    try:
+        agg = federation.FleetAggregator()
+        _relay_into(agg).flush()
+        assert seen
+        st = agg._instances[("test", "i1")]
+        assert st.families["nmz_edge_parked_events"].samples[("e",)] == 3.0
+    finally:
+        federation.unregister_collector(collect)
+    n = len(seen)
+    _relay_into(federation.FleetAggregator()).flush()
+    assert len(seen) == n  # unregistered: not called again
+
+
+def test_broken_collector_never_kills_a_push():
+    def broken():
+        raise RuntimeError("gauge refresh bug")
+
+    federation.register_collector(broken)
+    try:
+        agg = federation.FleetAggregator()
+        metrics.registry().counter("nmz_x_total", "").inc(1)
+        _relay_into(agg).flush()
+        assert ("test", "i1") in agg._instances
+    finally:
+        federation.unregister_collector(broken)
+
+
+# -- the REST wire -------------------------------------------------------
+
+
+@pytest.fixture
+def rest_hub():
+    from namazu_tpu.endpoint.hub import EndpointHub
+    from namazu_tpu.endpoint.local import LocalEndpoint
+    from namazu_tpu.endpoint.rest import RestEndpoint
+    from namazu_tpu.utils.mock_orchestrator import MockOrchestrator
+
+    hub = EndpointHub()
+    hub.add_endpoint(LocalEndpoint())
+    rest = RestEndpoint(port=0, poll_timeout=2.0)
+    hub.add_endpoint(rest)
+    mock = MockOrchestrator(hub)
+    mock.start()
+    yield hub, rest
+    mock.shutdown()
+
+
+def _base(rest):
+    return f"http://127.0.0.1:{rest.port}"
+
+
+def test_rest_telemetry_push_and_fleet(rest_hub):
+    import urllib.request
+
+    hub, rest = rest_hub
+    push = federation.pusher_for(_base(rest))
+    metrics.registry().counter("nmz_x_total", "").inc(6)
+    federation.TelemetryRelay("run", instance="child",
+                              push=push).flush()
+    with urllib.request.urlopen(_base(rest) + "/fleet", timeout=10) as r:
+        fleet = json.loads(r.read())
+    assert fleet["schema"] == federation.FLEET_SCHEMA
+    rows = {i["instance"]: i for i in fleet["instances"]}
+    assert "child" in rows
+    assert "slo" in fleet
+    with urllib.request.urlopen(_base(rest) + "/fleet?format=prom",
+                                timeout=10) as r:
+        prom = r.read().decode()
+    assert 'nmz_x_total{job="run",instance="child"} 6' in prom
+    # the CLI read side resolves the same surfaces
+    assert federation.fetch(_base(rest), "fleet")["schema"] \
+        == federation.FLEET_SCHEMA
+
+
+def test_rest_telemetry_replay_acks_duplicate(rest_hub):
+    import urllib.request
+
+    hub, rest = rest_hub
+    doc = json.dumps({"schema": federation.SCHEMA, "job": "j",
+                      "instance": "i", "seq": 1, "families": []}).encode()
+
+    def post():
+        req = urllib.request.Request(
+            _base(rest) + "/api/v3/telemetry", data=doc,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    assert post()["ok"] is True
+    replay = post()
+    assert replay["ok"] is True and replay["duplicate"] is True
+    st = federation.aggregator()._instances[("j", "i")]
+    assert st.pushes == 1 and st.duplicates == 1
+
+
+def test_rest_telemetry_malformed_400(rest_hub):
+    import urllib.error
+    import urllib.request
+
+    hub, rest = rest_hub
+    req = urllib.request.Request(
+        _base(rest) + "/api/v3/telemetry", data=b'{"schema": "nope"}',
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
+    # the connection stays usable (body drained): a follow-up succeeds
+    ok = json.dumps({"schema": federation.SCHEMA, "job": "j",
+                     "instance": "i", "seq": 1,
+                     "families": []}).encode()
+    req = urllib.request.Request(
+        _base(rest) + "/api/v3/telemetry", data=ok,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert json.loads(r.read())["ok"] is True
+
+
+def test_uds_endpoint_serves_obs_ops(tmp_path):
+    from namazu_tpu.endpoint.hub import EndpointHub
+    from namazu_tpu.endpoint.local import LocalEndpoint
+    from namazu_tpu.endpoint.uds import UdsEndpoint
+    from namazu_tpu.utils.mock_orchestrator import MockOrchestrator
+
+    path = str(tmp_path / "ep.sock")
+    hub = EndpointHub()
+    hub.add_endpoint(LocalEndpoint())
+    hub.add_endpoint(UdsEndpoint(path, poll_timeout=2.0))
+    mock = MockOrchestrator(hub)
+    mock.start()
+    try:
+        push = federation.pusher_for(f"uds://{path}")
+        metrics.registry().counter("nmz_x_total", "").inc(3)
+        federation.TelemetryRelay("inspector", instance="e1",
+                                  push=push).flush()
+        fleet = federation.fetch(f"uds://{path}", "fleet")
+        assert fleet["instance_count"] == 1
+        assert fleet["instances"][0]["job"] == "inspector"
+        local = federation.fetch(f"uds://{path}", "metrics")
+        assert "nmz_x_total" in json.dumps(local)
+    finally:
+        mock.shutdown()
+
+
+def test_tools_metrics_and_top_speak_uds(tmp_path, capsys):
+    import argparse
+
+    from namazu_tpu.cli.tools_cmd import metrics_dump, top
+
+    path = str(tmp_path / "collector.sock")
+    server = federation.TelemetryServer(path)
+    server.start()
+    try:
+        metrics.registry().counter("nmz_x_total", "").inc(1)
+        federation.TelemetryRelay(
+            "run", instance="c1",
+            push=federation.pusher_for(f"uds://{path}")).flush()
+        assert metrics_dump(argparse.Namespace(
+            url=f"uds://{path}")) == 0
+        assert top(argparse.Namespace(
+            url=f"uds://{path}", watch=False, interval=2.0,
+            json=False)) == 0
+        out = capsys.readouterr().out
+        assert "JOB" in out and "c1" in out
+    finally:
+        server.shutdown()
+
+
+# -- edge gauges + backhaul lag ------------------------------------------
+
+
+def test_edge_dispatcher_gauges_ride_the_collector():
+    from namazu_tpu.inspector.edge import EdgeDispatcher
+
+    doc = {"version": 3, "mode": "delay", "H": 2, "max_interval": 0.1,
+           "delays": [0.0, 0.05]}
+    dispatcher = EdgeDispatcher(
+        "e0", deliver=lambda a: None,
+        fetch_table=lambda: (3, doc),
+        send_backhaul=lambda entity, items: 3)
+    try:
+        dispatcher.note_server_version(3)  # triggers sync + install
+        assert dispatcher.active
+        federation.run_collectors()
+        reg = metrics.registry()
+        assert reg.sample(spans.EDGE_TABLE_VERSION_HELD,
+                          entity="e0").value == 3.0
+        assert reg.sample(spans.EDGE_PARKED, entity="e0").value == 0.0
+        staleness = reg.sample(spans.EDGE_TABLE_STALENESS, entity="e0")
+        assert staleness is not None and staleness.value >= 0.0
+    finally:
+        dispatcher.shutdown()
+    # unregistered at shutdown: a later collector pass touches nothing
+    federation.run_collectors()
+
+
+def test_edge_backhaul_lag_histogram():
+    spans.edge_backhaul_lag("e0", 0.02)
+    spans.edge_backhaul_lag("e0", -1.0)  # clock skew clamps to 0
+    child = metrics.registry().sample(spans.EDGE_BACKHAUL_LAG,
+                                      entity="e0")
+    assert child.count == 2
+    assert child.sum == pytest.approx(0.02)
+
+
+# -- tools top render ----------------------------------------------------
+
+
+def test_render_top_table():
+    from namazu_tpu.cli.tools_cmd import render_top
+
+    payload = {
+        "schema": federation.FLEET_SCHEMA,
+        "instance_count": 2, "stale_instances": 1,
+        "fleet_table_version": 4.0,
+        "instances": [
+            {"job": "run", "instance": "1@host", "events_per_sec": 120.5,
+             "events_total": 900.0, "queue_dwell_p99_s": 0.05,
+             "dispatch_p99_s": 0.2, "backhaul_lag_p99_s": 0.01,
+             "table_version": 4.0, "table_skew": 0, "edge_parked": 2,
+             "last_seen_age_s": 1.2, "stale": False},
+            {"job": "inspector", "instance": "2@host",
+             "events_per_sec": None, "events_total": None,
+             "queue_dwell_p99_s": None, "dispatch_p99_s": None,
+             "backhaul_lag_p99_s": None, "table_version": None,
+             "table_skew": None, "edge_parked": None,
+             "last_seen_age_s": 60.0, "stale": True},
+        ],
+        "slo": {"explicit": True, "objectives": [
+            {"name": "dispatch_p99", "burn": 0.2, "breached": False,
+             "breaches": 0}]},
+    }
+    text = render_top(payload)
+    assert "JOB" in text and "EV/S" in text and "STALE" in text
+    assert "120.5" in text
+    assert "2 instance(s), 1 stale" in text
+    assert "dispatch_p99" in text
