@@ -39,7 +39,10 @@ def main() -> int:
     return 0
 
 
-GRACE_S = 0.025
+# Overridable so a loaded CI host can widen the grace to its measured
+# scheduler jitter (tests/test_examples.py measured_grace); the default
+# is the calibrated value the random-policy regime assumes.
+GRACE_S = float(os.environ.get("WAL_GRACE_S", "0.025"))
 
 
 def _payload_ok(data: str) -> bool:

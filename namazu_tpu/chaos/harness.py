@@ -154,23 +154,29 @@ class _Pipeline:
             tx.start()
             self.txs[entity] = tx
 
-    def post_all(self) -> None:
-        """Round-robin, strictly sequential posting (one synchronous
-        flush per event) — the determinism the replay-equivalence
-        invariant rests on."""
-        for i in range(self.events):
-            for entity in self.entities:
-                ev = PacketEvent.create(entity, entity, "peer",
-                                        hint=f"h{i % 4}")
-                try:
-                    self.waiters[ev.uuid] = \
-                        self.txs[entity].send_event(ev)
-                    self.posted.append((ev.uuid, entity))
-                except Exception as e:
-                    # the transport RAISED into "inspector" code: a
-                    # defined outcome (the caller knows), recorded
-                    # separately from silent loss
-                    self.post_errors.append(f"{ev.uuid}: {e}")
+    def post_schedule(self):
+        """The default posting order: round-robin over the entities."""
+        return [(entity, f"h{i % 4}")
+                for i in range(self.events) for entity in self.entities]
+
+    def post_all(self, schedule=None) -> None:
+        """Strictly sequential posting (one synchronous flush per
+        event) — the determinism the replay-equivalence invariant
+        rests on. ``schedule`` overrides the round-robin ``(entity,
+        hint)`` order (the causality pair recorder posts a seeded
+        permutation to inject a known ordering flip)."""
+        for entity, hint in (self.post_schedule()
+                             if schedule is None else schedule):
+            ev = PacketEvent.create(entity, entity, "peer", hint=hint)
+            try:
+                self.waiters[ev.uuid] = \
+                    self.txs[entity].send_event(ev)
+                self.posted.append((ev.uuid, entity))
+            except Exception as e:
+                # the transport RAISED into "inspector" code: a
+                # defined outcome (the caller knows), recorded
+                # separately from silent loss
+                self.post_errors.append(f"{ev.uuid}: {e}")
 
     def collect(self, expected_missing: int = 0) -> None:
         """Wait for the answering actions (client side of the join)."""
@@ -813,6 +819,49 @@ def run_scenario(name: str, seed: int, workdir: str,
         "invariants": res["invariants"],
         "fault_report": res["fault_report"],
     }
+
+
+def record_divergent_pair(workdir: str, seed: int = 1,
+                          events: int = 6,
+                          entities: int = 2) -> List[str]:
+    """Record a seeded-divergent run pair for the causality plane
+    (doc/observability.md "Causality"): two loopback pipeline runs
+    under the harness's pinned determinism knobs (exact equal delays,
+    strictly sequential posts — dispatch order IS posting order), the
+    second posting a seed-derived adjacent swap of the first's
+    schedule. The injected ordering flip is therefore exactly one
+    known relation, which ``nmz-tpu tools why`` must report — the CI
+    smoke and the acceptance test both pin that. Returns the two runs'
+    NDJSON trace dumps ``[text_a, text_b]``."""
+    import random as _random
+
+    texts = []
+    for idx in (0, 1):
+        with _FreshObs():
+            pipe = _Pipeline(
+                os.path.join(workdir, f"pair{idx}"),
+                f"pair{seed}-{idx}", seed, entities=entities,
+                events=events, journal=False)
+            pipe.start_orchestrator()
+            pipe.start_transceivers()
+            schedule = pipe.post_schedule()
+            if idx == 1 and len(schedule) >= 2:
+                k = _random.Random(seed).randrange(len(schedule) - 1)
+                # make sure the swap actually flips an order relation:
+                # two identical (entity, hint) slots swapped are a
+                # no-op identity-wise
+                while schedule[k] == schedule[k + 1]:
+                    k = (k + 1) % (len(schedule) - 1)
+                schedule[k], schedule[k + 1] = \
+                    schedule[k + 1], schedule[k]
+            pipe.post_all(schedule)
+            pipe.collect()
+            pipe.await_quiescent()
+            pipe.shutdown(record=False)
+            run = obs.trace_run(pipe.run_id)
+            assert run is not None, "pipeline recorded no run"
+            texts.append(export.to_ndjson(run))
+    return texts
 
 
 def run_matrix(names: List[str], seed: int, workdir: str,

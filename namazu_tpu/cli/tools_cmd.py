@@ -12,6 +12,7 @@ import json
 import os
 import shutil
 import sys
+from typing import Optional
 
 from namazu_tpu.storage import load_storage
 from namazu_tpu.utils.config import Config
@@ -195,6 +196,32 @@ def register(sub) -> None:
     _url_arg(ptf)
     ptf.set_defaults(func=trace_diff)
 
+    pw = tsub.add_parser(
+        "why",
+        help="causality divergence explanation (doc/observability.md "
+             "\"Causality\"): the minimal set of ordering-relation "
+             "flips between two recorded runs' dispatch orders, ranked "
+             "by fault-localization suspicion, plus each run's "
+             "happens-before summary and critical-path attribution — "
+             "the answer to \"why does run A reproduce and run B "
+             "doesn't\"",
+    )
+    pw.add_argument("run_a",
+                    help="first run: a recorded run id, or a path to "
+                         "an NDJSON trace dump (tools trace dump / "
+                         "GET /traces/<id>?format=ndjson)")
+    pw.add_argument("run_b", help="second run: run id or NDJSON path")
+    pw.add_argument("--url", default="",
+                    help="a running orchestrator's REST endpoint: ask "
+                         "its /causality/<a>/<b> route instead of this "
+                         "process's recorder (ignored for file inputs)")
+    pw.add_argument("--format", choices=("md", "json"), default="md")
+    pw.add_argument("--top", type=int, default=20,
+                    help="flips kept in the report (default 20)")
+    pw.add_argument("--out", default="",
+                    help="write to this file instead of stdout")
+    pw.set_defaults(func=why)
+
     pr = tsub.add_parser(
         "report",
         help="experiment analytics report (doc/observability.md): "
@@ -288,6 +315,16 @@ def _fmt_cell(value, unit: str = "") -> str:
     return f"{value}{unit}"
 
 
+def _fmt_hot_stage(stage_p99: dict) -> Optional[str]:
+    """The dominant lifecycle segment of one instance — the stage with
+    the largest federated p99 from ``nmz_event_stage_seconds``
+    (obs/causality.py), rendered ``stage:p99``."""
+    if not isinstance(stage_p99, dict) or not stage_p99:
+        return None
+    stage, p99 = max(stage_p99.items(), key=lambda kv: kv[1])
+    return f"{stage}:{_fmt_cell(float(p99), 's')}"
+
+
 def render_top(payload: dict) -> str:
     """The ``tools top`` table for one /fleet payload."""
     cols = (
@@ -295,6 +332,7 @@ def render_top(payload: dict) -> str:
         ("events_per_sec", "EV/S", ""), ("events_total", "EVENTS", ""),
         ("queue_dwell_p99_s", "DWELL99", "s"),
         ("dispatch_p99_s", "E2E99", "s"),
+        ("hot_stage", "HOTSTAGE", ""),
         ("backhaul_lag_p99_s", "BACKHL99", "s"),
         ("table_version", "TBLV", ""), ("table_skew", "SKEW", ""),
         ("edge_parked", "PARKED", ""),
@@ -302,6 +340,8 @@ def render_top(payload: dict) -> str:
     )
     rows = [[header for _, header, _ in cols]]
     for inst in payload.get("instances", []):
+        inst = dict(inst,
+                    hot_stage=_fmt_hot_stage(inst.get("stage_p99_s")))
         rows.append([_fmt_cell(inst.get(key), unit)
                      for key, _, unit in cols])
     widths = [max(len(r[i]) for r in rows) for i in range(len(cols))]
@@ -461,6 +501,58 @@ def trace_diff(args) -> int:
         print(diff)
         return 1  # like diff(1): nonzero when the orders differ
     print("runs executed the same dispatch order")
+    return 0
+
+
+def _why_docs(spec: str, url: str):
+    """Resolve one ``tools why`` input to ``(record_docs, label)``:
+    an NDJSON dump file on disk, a run id on a live orchestrator
+    (--url), or a run id in this process's recorder."""
+    from namazu_tpu.obs import causality
+
+    if os.path.exists(spec):
+        with open(spec) as f:
+            records, _, run_id = causality.split_ndjson(f.read())
+        return records, run_id or os.path.basename(spec)
+    if url:
+        text = _http_get(
+            url.rstrip("/") + f"/traces/{spec}?format=ndjson").decode()
+        records, _, run_id = causality.split_ndjson(text)
+        return records, run_id or spec
+    records, _, run_id = causality.docs_of_run(_local_run_or_die(spec))
+    return records, run_id
+
+
+def why(args) -> int:
+    """Causality divergence explanation between two runs
+    (obs/causality.py): ordering-relation flips + per-run
+    happens-before and critical-path summaries."""
+    from namazu_tpu.obs import causality
+
+    both_ids = not (os.path.exists(args.run_a)
+                    or os.path.exists(args.run_b))
+    if args.url and both_ids:
+        # the server computes (and folds in its registered storage's
+        # fault-localization ranking, which this process can't see)
+        payload = json.loads(_http_get(
+            args.url.rstrip("/")
+            + f"/causality/{args.run_a}/{args.run_b}?top={args.top}"))
+    else:
+        docs_a, label_a = _why_docs(args.run_a, args.url)
+        docs_b, label_b = _why_docs(args.run_b, args.url)
+        payload = causality.why_payload(docs_a, docs_b,
+                                        label_a, label_b,
+                                        top=args.top)
+    if args.format == "json":
+        text = json.dumps(payload, sort_keys=True) + "\n"
+    else:
+        text = causality.render_why_md(payload)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out}")
+    else:
+        sys.stdout.write(text)
     return 0
 
 

@@ -1,0 +1,260 @@
+"""FramedServer: the ONE keep-alive framed-JSON serve loop.
+
+Three servers grew the same loop independently — the ``uds://`` event
+endpoint (endpoint/uds.py), the search/knowledge sidecar (sidecar.py),
+and the campaign supervisor's telemetry collector
+(obs/federation.TelemetryServer). PR 9 noted the consolidation and
+deferred it; the causality plane forces the issue — span context must
+be observed and echoed uniformly on every framed wire, and three copies
+of the loop is three places to get that wrong.
+
+The contract every framed wire now shares (one frame each way,
+``uint32-LE length + UTF-8 JSON`` — endpoint/agent.py's codec, any
+number of request/response pairs per connection):
+
+* EOF or a codec/socket error drops the connection cleanly;
+* a valid-JSON **non-object** frame is ANSWERED
+  (``{"ok": false, ...}``) so the client's keep-alive stream stays in
+  sync, never severed;
+* a handler exception is answered (``{"ok": false, "error": ...}``),
+  logged, and never desyncs the wire;
+* **span context** (obs/context.py): a request frame carrying ``ctx``
+  has its Lamport clock merged into this process's before the handler
+  runs, and the response echoes a fresh ``ctx`` stamp — so causal
+  order is joinable across every framed hop (knowledge push/pull,
+  telemetry forward, uds event ops) without the handlers knowing.
+  Context-less requests get byte-identical responses to the
+  pre-context wire;
+* shutdown severs live connections (a parked long-poll must error and
+  reconnect, not keep talking to a dead server), and ``sever()`` alone
+  simulates crash death for the chaos harness.
+
+Binding: :meth:`bind_unix` reclaims a listener-less stale socket inode
+(probe-connect first; a live listener raises — stealing a served path
+would silently split an event stream across two servers; a non-socket
+file is never clobbered) and unlinks the path at shutdown;
+:meth:`bind_tcp` sets ``SO_REUSEADDR`` so a hard-stopped server can
+rebind its port immediately.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import stat
+import threading
+from typing import Callable, Dict, Optional
+
+from namazu_tpu.endpoint.agent import read_frame, write_frame
+from namazu_tpu.obs import context as _context
+from namazu_tpu.obs import metrics as _metrics
+from namazu_tpu.signal.base import SignalError
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("endpoint.framed")
+
+#: handler(req dict) -> resp dict
+Handler = Callable[[dict], dict]
+#: decorate(req dict, resp dict) -> None — per-wire piggybacks (the
+#: uds endpoint's table_version) applied after the handler, before send
+Decorator = Callable[[dict, dict], None]
+
+
+def reclaim_stale_unix_socket(path: str, what: str = "server") -> None:
+    """Unlink a socket inode left by a dead predecessor, IF no live
+    listener answers a probe connect. A live listener raises (the path
+    is being served); a non-socket path is left alone so the caller's
+    bind fails loudly instead of clobbering someone's file."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return  # nothing there
+    if not stat.S_ISSOCK(st.st_mode):
+        return
+    probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        probe.settimeout(0.2)
+        try:
+            probe.connect(path)
+        except OSError:
+            # no listener: stale — reclaim the path
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return
+    finally:
+        try:
+            probe.close()
+        except OSError:
+            pass
+    raise RuntimeError(
+        f"{what} path {path!r} already has a live listener "
+        "(another process?); refusing to take it over")
+
+
+class FramedServer:
+    def __init__(self, handler: Handler, name: str = "framed",
+                 decorate: Optional[Decorator] = None) -> None:
+        self._handler = handler
+        self._name = name
+        self._decorate = decorate
+        self._server: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        #: AF_UNIX path when bound to one (unlinked at shutdown)
+        self.path: Optional[str] = None
+
+    # -- binding -----------------------------------------------------------
+
+    def bind_unix(self, path: str, backlog: int = 64) -> None:
+        reclaim_stale_unix_socket(path, what=self._name)
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(path)
+        srv.listen(backlog)
+        self._server = srv
+        self.path = path
+
+    def bind_tcp(self, host: str, port: int, backlog: int = 8) -> int:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(backlog)
+        self._server = srv
+        return srv.getsockname()[1]
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.getsockname()[1]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        assert self._server is not None, "bind before start"
+        if self._accept_thread is not None:
+            return
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"{self._name}-accept",
+            daemon=True)
+        self._accept_thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        srv, self._server = self._server, None
+        if srv is not None:
+            try:
+                srv.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns, self._conns = set(self._conns), set()
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self.path is not None:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+    def sever(self) -> int:
+        """Cut every live connection WITHOUT stopping the server — the
+        chaos harness's in-process stand-in for kill -9: a parked
+        client poll must error and reconnect, not keep talking to a
+        dead process's handler thread."""
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        return len(conns)
+
+    # -- the loop ----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            srv = self._server
+            if srv is None:
+                return
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return  # closed by shutdown
+            with self._conns_lock:
+                self._conns.add(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name=f"{self._name}-conn",
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    req = read_frame(conn)
+                except (SignalError, ValueError, OSError):
+                    # oversized frame, malformed JSON from a desynced
+                    # client, or a socket error: drop the connection
+                    break
+                if req is None:
+                    break  # EOF (one-shot clients just close)
+                if not isinstance(req, dict):
+                    # answered, not severed: the framed stream stays in
+                    # sync for the client's next request
+                    try:
+                        write_frame(conn, {"ok": False,
+                                           "error": "frame must be a "
+                                                    "JSON object"})
+                    except OSError:
+                        break
+                    continue
+                ctx_seen = self._observe_ctx(req)
+                try:
+                    resp = self._handler(req)
+                except Exception as e:  # answer, never desync the wire
+                    log.exception("%s op failed: %r", self._name,
+                                  req.get("op"))
+                    resp = {"ok": False, "error": repr(e)}
+                if self._decorate is not None:
+                    try:
+                        self._decorate(req, resp)
+                    except Exception:  # pragma: no cover - defensive
+                        log.exception("%s response decorator failed",
+                                      self._name)
+                if ctx_seen:
+                    # echo a fresh stamp so the client's clock merges
+                    # ours; context-less peers get the pre-context wire
+                    # byte for byte
+                    resp.setdefault(_context.CTX_KEY,
+                                    _context.wire_stamp())
+                try:
+                    write_frame(conn, resp)
+                except OSError:
+                    break
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _observe_ctx(req: Dict) -> bool:
+        """Merge a request frame's span-context clock; True when the
+        request carried one (and observability is on)."""
+        ctx = req.get(_context.CTX_KEY)
+        if ctx is None or not _metrics.enabled():
+            return False
+        _context.observe_wire(ctx)
+        return True

@@ -102,10 +102,51 @@ class EndpointHub:
         # one-shot unroutable warning
         self._warned_unroutable.discard(event.entity_id)
 
+    @staticmethod
+    def _note_context(event: Event) -> None:
+        """Causality plane (obs/context.py): merge an inbound event's
+        logical clock into this process's (cross-process order without
+        clock trust), fill the run id a remote mint couldn't know, and
+        mint a context at interception for pre-context clients — one
+        enabled-check + dict work per event, nothing when disabled."""
+        ctx = obs.context.ensure(event)
+        if ctx is None:
+            return
+        obs.context.observe(ctx)
+        if not ctx.get("r"):
+            run_id = obs.recorder.current_run_id()
+            if run_id:
+                ctx["r"] = run_id
+
+    @staticmethod
+    def _note_context_batch(events, extra_lc: int = 0) -> None:
+        """Batch face of :meth:`_note_context`: ONE clock merge (the
+        max of the inbound stamps — Lamport merge is max-monotone, so
+        folding a batch through its max is exact) instead of a lock
+        round per event. ``extra_lc`` folds in op-level stamps riding
+        beside the events (the edge's per-chunk decision stamp)."""
+        if not obs.metrics.enabled():
+            return
+        run_id = obs.recorder.current_run_id() or ""
+        lc_of = obs.context.lc_of
+        max_lc = int(extra_lc)
+        for event in events:
+            ctx = obs.context.ensure(event)
+            if ctx is None:
+                continue
+            lc = lc_of(ctx)
+            if lc > max_lc:
+                max_lc = lc
+            if run_id and not ctx.get("r"):
+                ctx["r"] = run_id
+        if max_lc > 0:
+            obs.context.clock().observe(max_lc)
+
     def post_event(self, event: Event, endpoint_name: str) -> None:
         with self._lock:
             self._note_inbound(event, endpoint_name)
         event.mark_arrived()
+        self._note_context(event)
         obs.mark(event, "intercepted")
         obs.event_intercepted(endpoint_name, event.entity_id)
         obs.record_intercepted(event, endpoint_name)
@@ -120,6 +161,7 @@ class EndpointHub:
         with self._lock:
             for event in events:
                 self._note_inbound(event, endpoint_name)
+        self._note_context_batch(events)
         for event in events:
             event.mark_arrived()
             obs.mark(event, "intercepted")
@@ -145,6 +187,16 @@ class EndpointHub:
         with self._lock:
             for event, _ in items:
                 self._note_inbound(event, endpoint_name)
+        # the edge's per-chunk decision stamp (added at backhaul
+        # serialization) merges too — the reconcile point is causally
+        # after the decision, whatever the wall clocks say
+        extra_lc = 0
+        for _, decision in items:
+            lc = decision.get("lc")
+            if isinstance(lc, int) and lc > extra_lc:
+                extra_lc = lc
+        self._note_context_batch([ev for ev, _ in items],
+                                 extra_lc=extra_lc)
         per_entity: Dict[str, int] = {}
         put = self.event_queue.put
         for event, decision in items:

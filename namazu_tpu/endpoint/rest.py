@@ -86,6 +86,7 @@ _CONTROL_RE = re.compile(rf"^{API_ROOT}/control$")
 _POLICY_TABLE_RE = re.compile(rf"^{API_ROOT}/policy/table$")
 _TELEMETRY_RE = re.compile(rf"^{API_ROOT}/telemetry$")
 _TRACES_RE = re.compile(r"^/traces(?:/([^/]+))?$")
+_CAUSALITY_RE = re.compile(r"^/causality/([^/]+)(?:/([^/]+))?$")
 
 
 class ActionQueue:
@@ -301,6 +302,11 @@ class QueuedEndpoint(Endpoint):
         obs.mark(action, "acked")
         obs.record_acked(action)
         obs.rest_ack(entity, obs.latency(action, "dispatched"))
+        # every central lifecycle stamp is in hand at the ack: publish
+        # the per-segment latency decomposition (queue/decision/
+        # parking/dispatch/wire) into nmz_event_stage_seconds — the
+        # causality plane's live histogram face (obs/causality.py)
+        obs.causality.observe_stage_segments(action)
 
     # -- zero-RTT edge backhaul (doc/performance.md) ---------------------
 
@@ -667,6 +673,10 @@ class RestEndpoint(QueuedEndpoint):
                 m = _TRACES_RE.match(url.path)
                 if m:
                     return self._get_traces(m.group(1), parse_qs(url.query))
+                m = _CAUSALITY_RE.match(url.path)
+                if m:
+                    return self._get_causality(m.group(1), m.group(2),
+                                               parse_qs(url.query))
                 m = _ACTIONS_RE.match(url.path)
                 if not (m and m.group(2) is None):
                     return self._reply(404, {"error": f"no route {url.path}"})
@@ -786,6 +796,34 @@ class RestEndpoint(QueuedEndpoint):
                     log.exception("fleet payload failed")
                     return self._reply(
                         500, {"error": f"fleet failed: {e}"})
+                self._reply(200, payload)
+
+            def _get_causality(self, run_a, run_b, query) -> None:
+                """Causality surface (obs/causality.py): one run's
+                happens-before graph + critical-path attribution, or —
+                with two run ids — the ordering-relation divergence
+                explanation ``nmz-tpu tools why`` renders."""
+                raw_top = (query.get("top") or [None])[0]
+                try:
+                    top = 20 if raw_top is None else max(1, int(raw_top))
+                except ValueError:
+                    return self._reply(
+                        400, {"error": f"bad top={raw_top!r} "
+                              "(want a positive integer)"})
+                try:
+                    if run_b is None:
+                        payload = obs.causality_run_payload(run_a)
+                    else:
+                        payload = obs.causality_why_payload(
+                            run_a, run_b, top=top)
+                except Exception as e:  # analysis bugs must not kill ops
+                    log.exception("causality payload failed")
+                    return self._reply(
+                        500, {"error": f"causality failed: {e}"})
+                if payload is None:
+                    return self._reply(
+                        404, {"error": "no recorded run "
+                              f"{run_a if run_b is None else (run_a, run_b)!r}"})
                 self._reply(200, payload)
 
             def _get_traces(self, run_id, query) -> None:

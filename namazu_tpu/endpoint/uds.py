@@ -42,14 +42,10 @@ anyway.
 
 from __future__ import annotations
 
-import os
-import socket
-import stat
-import threading
-from typing import List, Optional, Set
+from typing import List, Optional
 
 from namazu_tpu import obs
-from namazu_tpu.endpoint.agent import read_frame, write_frame
+from namazu_tpu.endpoint.framed import FramedServer
 from namazu_tpu.endpoint.rest import QueuedEndpoint
 from namazu_tpu.signal.base import SignalError, signal_from_jsonable
 from namazu_tpu.signal.event import Event
@@ -72,160 +68,43 @@ class UdsEndpoint(QueuedEndpoint):
         # unboundedly. 0 = unbounded.
         self.ingress_cap = max(0, int(ingress_cap))
         self.retry_after_s = max(0.0, float(retry_after_s))
-        self._server: Optional[socket.socket] = None
-        self._accept_thread: Optional[threading.Thread] = None
-        self._conns: Set[socket.socket] = set()
-        self._conns_lock = threading.Lock()
-        self._stop = threading.Event()
+        # the shared keep-alive serve loop (endpoint/framed.py): frame
+        # hygiene, error answering, span-context merge/echo, severable
+        # connections — one implementation across the framed wires
+        self._server: Optional[FramedServer] = None
 
     # -- lifecycle -------------------------------------------------------
 
     def start(self) -> None:
         if self._server is not None:
             return
-        self._reclaim_stale_socket()
-        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        srv.bind(self.path)
-        srv.listen(64)
+        srv = FramedServer(self._handle, name="uds-endpoint",
+                           decorate=self._decorate)
+        srv.bind_unix(self.path)
+        srv.start()
         self._server = srv
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="uds-endpoint", daemon=True)
-        self._accept_thread.start()
         log.info("UDS endpoint on %s", self.path)
 
-    def _reclaim_stale_socket(self) -> None:
-        """A socket inode left by a dead predecessor would EADDRINUSE
-        the bind. Unlink ONLY a socket with no live listener behind it:
-        a probe connect that succeeds means another orchestrator is
-        serving this path, and stealing it would silently split the
-        entity's event stream across two servers. Anything that is not
-        a socket (regular file, directory, FIFO) is never clobbered —
-        the bind fails loudly instead."""
-        try:
-            st = os.stat(self.path)
-        except OSError:
-            return  # nothing there
-        if not stat.S_ISSOCK(st.st_mode):
-            return
-        probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        try:
-            probe.settimeout(0.2)
-            try:
-                probe.connect(self.path)
-            except OSError:
-                # no listener: stale — reclaim the path
-                try:
-                    os.unlink(self.path)
-                except OSError:
-                    pass
-                return
-        finally:
-            try:
-                probe.close()
-            except OSError:
-                pass
-        raise RuntimeError(
-            f"uds path {self.path!r} already has a live listener "
-            "(another orchestrator?); refusing to take it over")
+    def _decorate(self, req: dict, resp: dict) -> None:
+        """The zero-RTT version piggyback: every response carries
+        ``table_version`` when this hub has a table plane — how an edge
+        notices a rollover within one batch (doc/performance.md)."""
+        version = self.hub.table_version() \
+            if getattr(self, "hub", None) is not None else None
+        if version is not None:
+            resp.setdefault("table_version", version)
 
     def shutdown(self) -> None:
-        self._stop.set()
         srv, self._server = self._server, None
         if srv is not None:
-            try:
-                srv.close()
-            except OSError:
-                pass
-        with self._conns_lock:
-            conns = list(self._conns)
-        for conn in conns:
-            try:
-                conn.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                conn.close()
-            except OSError:
-                pass
-        try:
-            os.unlink(self.path)
-        except OSError:
-            pass
+            srv.shutdown()
 
     def sever(self) -> int:
         """Cut every live connection (simulated crash, like
         RestEndpoint.sever): a parked client poll must error and
         reconnect, not keep talking to a dead orchestrator."""
-        with self._conns_lock:
-            conns = list(self._conns)
-        for conn in conns:
-            try:
-                conn.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-        return len(conns)
-
-    # -- connection handling ---------------------------------------------
-
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            srv = self._server
-            if srv is None:
-                return
-            try:
-                conn, _ = srv.accept()
-            except OSError:
-                return  # closed by shutdown
-            with self._conns_lock:
-                self._conns.add(conn)
-            threading.Thread(target=self._serve_conn, args=(conn,),
-                             name="uds-conn", daemon=True).start()
-
-    def _serve_conn(self, conn: socket.socket) -> None:
-        try:
-            while not self._stop.is_set():
-                try:
-                    req = read_frame(conn)
-                except (SignalError, ValueError, OSError):
-                    # oversized frame, malformed JSON from a desynced
-                    # client, or a socket error: drop the connection
-                    # cleanly (same set the client-side _FramedConn
-                    # treats as connection-fatal)
-                    break
-                if req is None:
-                    break  # EOF
-                if not isinstance(req, dict):
-                    # valid JSON but not an op object: answer (the
-                    # framed stream stays in sync) instead of letting
-                    # _handle's AttributeError escape the handler
-                    try:
-                        write_frame(conn, {"ok": False,
-                                           "error": "frame must be a "
-                                                    "JSON object"})
-                    except OSError:
-                        break
-                    continue
-                try:
-                    resp = self._handle(req)
-                except Exception as e:  # a handler bug must answer,
-                    # not silently desync the framed stream
-                    log.exception("uds op failed: %r", req.get("op"))
-                    resp = {"ok": False, "error": repr(e)}
-                version = self.hub.table_version() \
-                    if getattr(self, "hub", None) is not None else None
-                if version is not None:
-                    resp.setdefault("table_version", version)
-                try:
-                    write_frame(conn, resp)
-                except OSError:
-                    break
-        finally:
-            with self._conns_lock:
-                self._conns.discard(conn)
-            try:
-                conn.close()
-            except OSError:
-                pass
+        srv = self._server
+        return srv.sever() if srv is not None else 0
 
     # -- ops --------------------------------------------------------------
 

@@ -477,21 +477,39 @@ class EdgeDispatcher:
                     return
 
     @staticmethod
-    def _wire_item(raw) -> dict:
+    def _wire_stamp() -> Optional[Tuple[int, str]]:
+        """One causality stamp per backhaul CHUNK (obs/context.py):
+        the edge's logical clock ticked once — every item in the chunk
+        was decided before this flush, so a shared stamp preserves the
+        happens-before the per-item tick would encode, without a clock
+        lock round per event. None while observability is off."""
+        from namazu_tpu.obs import context as _context
+        from namazu_tpu.obs import metrics as _metrics
+
+        if not _metrics.enabled():
+            return None
+        return _context.clock().tick(), _context.origin()
+
+    @staticmethod
+    def _wire_item(raw, stamp: Optional[Tuple[int, str]] = None) -> dict:
         event, version, delay, m0, m1, w0, w1 = raw
-        return {
-            "event": event.to_jsonable(),
-            "decision": {
-                "delay": delay,
-                "source": "table",
-                "decision_source": "edge",
-                "table_version": version,
-                "t_intercepted": m0,
-                "t_dispatched": m1,
-                "arrived_wall": w0,
-                "triggered_wall": w1,
-            },
+        decision = {
+            "delay": delay,
+            "source": "table",
+            "decision_source": "edge",
+            "table_version": version,
+            "t_intercepted": m0,
+            "t_dispatched": m1,
+            "arrived_wall": w0,
+            "triggered_wall": w1,
         }
+        # the reconcile side merges this clock and attributes the
+        # stamps to THIS process; the event's own span context rides
+        # event.to_jsonable(). Built on the flush thread, never the
+        # zero-RTT path.
+        if stamp is not None:
+            decision["lc"], decision["o"] = stamp
+        return {"event": event.to_jsonable(), "decision": decision}
 
     def _flush_backhaul_once(self) -> bool:
         """Drain the buffer onto the wire in entity-grouped chunks;
@@ -507,9 +525,11 @@ class EdgeDispatcher:
         for e_idx, (entity, items) in enumerate(entities):
             for i in range(0, len(items), self.backhaul_max):
                 chunk = items[i:i + self.backhaul_max]
+                stamp = self._wire_stamp()
                 try:
                     server_version = self._send_backhaul(
-                        entity, [self._wire_item(raw) for raw in chunk])
+                        entity, [self._wire_item(raw, stamp)
+                                 for raw in chunk])
                 except Exception as e:
                     # keep everything not yet acknowledged at the
                     # buffer HEAD: the chunk that raised (whose ack may

@@ -23,6 +23,7 @@ import threading
 from typing import Dict, Optional
 
 from namazu_tpu.endpoint.local import LocalEndpoint
+from namazu_tpu.obs import context as _context
 from namazu_tpu.signal.action import Action
 from namazu_tpu.signal.event import Event
 from namazu_tpu.utils.log import get_logger
@@ -58,6 +59,10 @@ class Transceiver:
         part of the event plane's per-event budget
         (doc/performance.md).
         """
+        # causality plane (obs/context.py): the span context is minted
+        # HERE — the inspector-side interception point — so it rides
+        # every wire the event takes (no-op when observability is off)
+        _context.ensure(event)
         ch: "queue.SimpleQueue[Action]" = queue.SimpleQueue()
         with self._lock:
             self._waiters[event.uuid] = ch
@@ -79,6 +84,9 @@ class Transceiver:
         send_event: deferred events only. On error no waiter remains
         registered."""
         events = list(events)
+        # batch mint: one clock tick + one enabled check for the whole
+        # burst (the zero-RTT path's per-event budget, obs/context.py)
+        _context.mint_many(events)
         chans: "list[queue.SimpleQueue]" = []
         with self._lock:
             for event in events:
@@ -102,6 +110,7 @@ class Transceiver:
 
     def send_notification(self, event: Event) -> None:
         """Send an observation-only event without awaiting any action."""
+        _context.ensure(event)
         self._post(event)
 
     def forget(self, event: Event) -> None:

@@ -137,6 +137,12 @@ class KnowledgeClient:
         service refused the op). Never raises."""
         req = dict(req, v=1, tenant=self.tenant,
                    scenario=req.get("scenario", self.scenario))
+        if obs.metrics.enabled():
+            # causality plane (obs/context.py): stamp the op frame so
+            # the service's clock merges ours (the framed server echoes
+            # a stamp back, merged below) — knowledge traffic is part
+            # of the cross-process happens-before story too
+            req.setdefault("ctx", obs.context.wire_stamp())
         with self._lock:
             now = time.monotonic()
             if now < self._down_until:
@@ -158,6 +164,7 @@ class KnowledgeClient:
                 return None
             self._down_until = 0.0
             self._warned = False
+            obs.context.observe_wire(resp.get("ctx"))
             return resp
 
     def _mark_outage(self, why: str) -> None:

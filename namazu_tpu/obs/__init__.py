@@ -32,6 +32,8 @@ from __future__ import annotations
 
 from namazu_tpu.obs import (  # noqa: F401
     analytics,
+    causality,
+    context,
     export,
     federation,
     metrics,
@@ -83,6 +85,8 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     entity_stalled,
     event_batch,
     event_intercepted,
+    event_stage,
+    event_stage_many,
     experiment_stats,
     fleet_occupancy,
     ingress_rejected,
@@ -112,6 +116,7 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     slo_breach,
     slo_burn,
     span,
+    span_delta,
     table_version,
     telemetry_forward_dropped,
     telemetry_push,
@@ -183,6 +188,63 @@ def analytics_payload(top: int = analytics.DEFAULT_TOP,
     """The experiment-analytics document (the ``GET /analytics`` body):
     the registered storage joined with this process's recorded runs."""
     return analytics.payload(top=top, window=window)
+
+
+def causality_run_payload(run_id: str):
+    """The ``GET /causality/<run_id>`` body (happens-before graph +
+    critical-path attribution), or None for an unknown run."""
+    run = recorder.recorder().run(run_id)
+    if run is None:
+        return None
+    return causality.run_payload(run)
+
+
+#: memoized fault-localization ranking for the why route:
+#: (storage dir, run count, top) -> analyzer ranking. analyze_storage
+#: reads every stored run's coverage file — repeating that per
+#: GET /causality/<a>/<b> would turn a ranking hint into full-storage
+#: I/O in the request handler; the ranking only changes when a run
+#: completes, which the run count witnesses.
+_why_suspicious_cache: dict = {}
+
+
+def _why_suspicious(top: int):
+    d = analytics.storage_dir()
+    if not d:
+        return None
+    try:
+        from namazu_tpu.analyzer import analyze_storage
+        from namazu_tpu.storage import load_storage
+
+        st = load_storage(d)
+        try:
+            key = (d, st.nr_stored_histories(), top)
+            if key in _why_suspicious_cache:
+                return _why_suspicious_cache[key]
+            ranking = analyze_storage(st, top=top)
+        finally:
+            st.close()
+        _why_suspicious_cache.clear()  # one storage, one live key
+        _why_suspicious_cache[key] = ranking
+        return ranking
+    except Exception:  # localization is a ranking hint, never a 500
+        return None
+
+
+def causality_why_payload(run_a: str, run_b: str, top: int = 20):
+    """The ``GET /causality/<a>/<b>`` body (ordering-relation flips +
+    per-run causality summaries), or None when either run is unknown.
+    The analyzer's fault-localization ranking (from the registered
+    analytics storage, when one exists) feeds the flip scoring."""
+    a = recorder.recorder().run(run_a)
+    b = recorder.recorder().run(run_b)
+    if a is None or b is None:
+        return None
+    docs_a, _, rid_a = causality.docs_of_run(a)
+    docs_b, _, rid_b = causality.docs_of_run(b)
+    return causality.why_payload(docs_a, docs_b, rid_a, rid_b,
+                                 top=top,
+                                 suspicious=_why_suspicious(top))
 
 
 def note_telemetry_push(doc) -> dict:

@@ -529,6 +529,30 @@ def payload(top: int = DEFAULT_TOP,
     except Exception:
         log.warning("slo summary failed; payload served without it",
                     exc_info=True)
+    # causality fold (obs/causality.py): per-run critical-path latency
+    # attribution over this process's recorded runs — additive like the
+    # knowledge/slo sections (no recorded runs, no section), so the
+    # compute_payload parity stays untouched
+    try:
+        runs = _recorder.recorder().runs()
+        if runs:
+            from namazu_tpu.obs import causality
+
+            rows = []
+            for run in runs[-4:]:  # newest runs; a bounded fold
+                records, gens, run_id = causality.docs_of_run(run)
+                if not records:
+                    continue
+                graph = causality.build_graph(records, gens, run_id)
+                row = causality.critical_path(records, run_id)
+                row["acyclic"] = graph.is_acyclic()
+                row["stamp_inversions"] = len(graph.stamp_inversions())
+                rows.append(row)
+            if rows:
+                doc["causality"] = {"runs": rows}
+    except Exception:
+        log.warning("causality fold failed; payload served without it",
+                    exc_info=True)
     return doc
 
 

@@ -1,0 +1,697 @@
+"""Causality plane: happens-before graphs, critical-path latency
+attribution, and divergence explanation over recorded runs.
+
+Namazu's product is an event *ordering*; until this module the
+observability stack could only compare orderings as opaque digests or
+diff them as flat sequences (obs/export.py). Three analyses close that
+gap (doc/observability.md "Causality"):
+
+* :func:`build_graph` — the per-run **happens-before DAG**. Nodes are
+  ``(event, lifecycle-stage)`` points (plus one node per schedule
+  install); edges are the four relation families the system actually
+  enforces:
+
+  - ``chain``    — an event's own stage progression (intercepted ->
+    ... -> acked/reconciled);
+  - ``program``  — per-entity interception order (the order the testee
+    emitted events);
+  - ``dispatch`` — the policy-imposed release order, the total order
+    Namazu exists to control (its edge list IS the flight recorder's
+    release sequence);
+  - ``install``  — a schedule install precedes every decision tagged
+    with its generation (the search plane's causal reach into the
+    event plane).
+
+  Stage-level nodes make the graph acyclic **by construction** even
+  when the policy reorders events against program order (the entire
+  point of a fuzzer): a reordering shows up as ``program`` and
+  ``dispatch`` edges crossing between stage columns, never as a cycle.
+  A vector-clock pass assigns per-process clocks, and
+  :meth:`HBGraph.stamp_inversions` flags edges whose monotonic stamps
+  run *backwards* across process boundaries — the forensic check for
+  clock skew or a hub that reordered what it claims it didn't.
+
+* :func:`critical_path` — decompose each event's intercepted->acked
+  span into the named segments ``queue`` (hub queue), ``decision``
+  (policy), ``parking`` (the injected delay), ``dispatch`` (action
+  loop), ``wire`` (dispatch -> inspector ack); edge-decided events
+  contribute ``edge_parking`` and ``backhaul`` instead. The central
+  segments telescope — they sum to the end-to-end span exactly — so
+  per-stage p50/p99 and "which stage dominates" are queries, not bench
+  runs. The same segments feed ``nmz_event_stage_seconds{stage}``
+  live (obs/spans.event_stage).
+
+* :func:`relation_flips` — given two runs (a failing and a passing
+  one), the **minimal set of ordering-relation flips** between their
+  dispatch orders: pairs ``(x, y)`` dispatched ``x`` before ``y`` in
+  one run and ``y`` before ``x`` in the other, reduced to the pairs
+  not implied by other flips (transitive reduction of the inversion
+  set), ranked by positional displacement plus the analyzer's
+  fault-localization scores when available. This extends the PR 2
+  differ from "the sequences differ" to "these relations flipped" —
+  the answer to RESULTS.md's "why does B's schedule reproduce and A's
+  near-identical one doesn't".
+
+All three work off the NDJSON record shape (``EventRecord.to_jsonable``)
+so they run identically over a live :class:`RunTrace`, a
+``GET /traces/<id>?format=ndjson`` body, or a dump file on disk —
+``GET /causality/...`` and ``nmz-tpu tools why`` are thin wrappers.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import OrderedDict
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from namazu_tpu.obs import export
+from namazu_tpu.obs.recorder import STAGES
+
+__all__ = [
+    "SCHEMA_GRAPH", "SCHEMA_WHY", "CENTRAL_SEGMENTS", "EDGE_SEGMENTS",
+    "HBGraph", "build_graph", "docs_of_run", "split_ndjson",
+    "segments_of", "observe_stage_segments", "critical_path",
+    "run_payload", "relation_flips", "why_payload", "render_why_md",
+]
+
+SCHEMA_GRAPH = "nmz-causality-v1"
+SCHEMA_WHY = "nmz-why-v1"
+
+#: (segment name, from-stage, to-stage) for centrally-decided events.
+#: Telescoping by construction: consecutive segments share a stamp, so
+#: their sum equals the intercepted->acked span whenever all stamps are
+#: present (the <=5%% attribution acceptance is an identity, not a fit).
+CENTRAL_SEGMENTS = (
+    ("queue", "intercepted", "enqueued"),
+    ("decision", "enqueued", "decided"),
+    ("parking", "decided", "released"),
+    ("dispatch", "released", "dispatched"),
+    ("wire", "dispatched", "acked"),
+)
+
+#: edge-decided events (``decision_source == "edge"``): the local
+#: decide collapses intercepted/enqueued/decided onto one stamp and the
+#: record never sees a REST ack; what matters is how long the event sat
+#: in the edge's parked heap and how far the async backhaul ran behind.
+EDGE_SEGMENTS = (
+    ("edge_parking", "decided", "released"),
+    ("backhaul", "dispatched", "reconciled"),
+)
+
+#: monotonic-stamp slack before an edge counts as inverted: same-host
+#: CLOCK_MONOTONIC is shared, so anything past scheduler noise is a
+#: real inversion (cross-host stamps, a reordering hub, a torn merge)
+INVERSION_EPS_S = 1e-6
+
+
+def _is_edge(doc: Dict[str, Any]) -> bool:
+    return (doc.get("decision") or {}).get("decision_source") == "edge"
+
+
+# -- input shaping ---------------------------------------------------------
+
+def docs_of_run(run) -> Tuple[List[dict], List[dict], str]:
+    """``(record_docs, generation_docs, run_id)`` of a live RunTrace."""
+    snap = run.snapshot()
+    return ([entry["json"] for entry in snap["records"]],
+            snap["generations"], snap["run_id"])
+
+
+def split_ndjson(text: str) -> Tuple[List[dict], List[dict], str]:
+    """Parse an NDJSON trace dump (obs/export.to_ndjson) into record
+    docs + search-plane docs; malformed lines are skipped (a torn tail
+    must not kill an offline analysis)."""
+    records: List[dict] = []
+    gens: List[dict] = []
+    run_id = ""
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(doc, dict):
+            continue
+        run_id = run_id or str(doc.get("run_id") or "")
+        if doc.get("kind"):
+            gens.append(doc)
+        elif doc.get("event"):
+            records.append(doc)
+    return records, gens, run_id
+
+
+# -- happens-before graph --------------------------------------------------
+
+class HBGraph:
+    """The per-run happens-before DAG (see the module header)."""
+
+    def __init__(self, run_id: str = "") -> None:
+        self.run_id = run_id
+        #: node key -> {"t": stamp|None, "proc": clock domain,
+        #:              "event": uuid|None, "stage": stage|None}
+        self.nodes: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        #: (src key, dst key, kind)
+        self.edges: List[Tuple[str, str, str]] = []
+        #: event uuids in policy release order (== the dispatch-order
+        #: edge chain; the acceptance join against the flight recorder)
+        self.dispatch_order: List[str] = []
+        #: uuids of every record that reached a released/dispatched
+        #: stamp (coverage: each must appear in the graph)
+        self.dispatched_events: List[str] = []
+
+    # -- construction ------------------------------------------------------
+
+    def _add_node(self, key: str, t: Optional[float], proc: str,
+                  event: Optional[str] = None,
+                  stage: Optional[str] = None) -> str:
+        if key not in self.nodes:
+            self.nodes[key] = {"t": t, "proc": proc,
+                               "event": event, "stage": stage}
+        return key
+
+    def _add_edge(self, src: str, dst: str, kind: str) -> None:
+        self.edges.append((src, dst, kind))
+
+    # -- analysis ----------------------------------------------------------
+
+    def topo_order(self) -> Optional[List[str]]:
+        """Kahn topological order, or None when the graph has a cycle
+        (which build_graph's edge families cannot produce — a None here
+        means corrupted input and the payload says so)."""
+        indeg = {k: 0 for k in self.nodes}
+        succ: Dict[str, List[str]] = {k: [] for k in self.nodes}
+        for src, dst, _ in self.edges:
+            succ[src].append(dst)
+            indeg[dst] += 1
+        ready = [k for k in self.nodes if indeg[k] == 0]
+        order: List[str] = []
+        while ready:
+            node = ready.pop()
+            order.append(node)
+            for nxt in succ[node]:
+                indeg[nxt] -= 1
+                if indeg[nxt] == 0:
+                    ready.append(nxt)
+        return order if len(order) == len(self.nodes) else None
+
+    def is_acyclic(self) -> bool:
+        return self.topo_order() is not None
+
+    def vector_clocks(self) -> Optional[Dict[str, Dict[str, int]]]:
+        """Per-node vector clocks over the graph's clock domains
+        (orchestrator / each edge process / the search plane), derived
+        from the DAG itself — the order witness that needs no clock
+        trust. None on a cyclic (corrupt) graph."""
+        order = self.topo_order()
+        if order is None:
+            return None
+        pred: Dict[str, List[str]] = {k: [] for k in self.nodes}
+        for src, dst, _ in self.edges:
+            pred[dst].append(src)
+        clocks: Dict[str, Dict[str, int]] = {}
+        for key in order:
+            vc: Dict[str, int] = {}
+            for p in pred[key]:
+                for proc, val in clocks[p].items():
+                    if val > vc.get(proc, 0):
+                        vc[proc] = val
+            proc = self.nodes[key]["proc"]
+            vc[proc] = vc.get(proc, 0) + 1
+            clocks[key] = vc
+        return clocks
+
+    def stamp_inversions(self,
+                         eps: float = INVERSION_EPS_S) -> List[dict]:
+        """Edges whose monotonic stamps contradict the happens-before
+        direction: the DAG says src precedes dst, the clocks say dst's
+        stamp is EARLIER. On one host (shared CLOCK_MONOTONIC) this is
+        the forensic smoking gun — a reordering merge point, a torn
+        record, or genuinely skewed cross-host stamps."""
+        out = []
+        for src, dst, kind in self.edges:
+            ts = self.nodes[src]["t"]
+            td = self.nodes[dst]["t"]
+            if ts is None or td is None:
+                continue
+            if ts - td > eps:
+                out.append({
+                    "src": src, "dst": dst, "kind": kind,
+                    "skew_s": round(ts - td, 6),
+                    "cross_process": (self.nodes[src]["proc"]
+                                      != self.nodes[dst]["proc"]),
+                })
+        return out
+
+    def edge_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for _, _, kind in self.edges:
+            counts[kind] = counts.get(kind, 0) + 1
+        return counts
+
+    def to_jsonable(self, max_edges: int = 4096) -> Dict[str, Any]:
+        inversions = self.stamp_inversions()
+        doc: Dict[str, Any] = {
+            "run_id": self.run_id,
+            "nodes": len(self.nodes),
+            "events": len(self.dispatched_events),
+            "acyclic": self.is_acyclic(),
+            "edge_counts": self.edge_counts(),
+            "dispatch_order": list(self.dispatch_order),
+            "inversions": inversions,
+        }
+        if len(self.edges) <= max_edges:
+            doc["edges"] = [{"src": s, "dst": d, "kind": k}
+                            for s, d, k in self.edges]
+        else:
+            # no silent caps: say what was dropped instead of shipping
+            # a graph that reads complete but isn't
+            doc["edges_truncated"] = len(self.edges)
+        return doc
+
+
+def _stage_proc(doc: Dict[str, Any], stage: str) -> str:
+    """The clock domain a stage's stamp came from: edge-decided events
+    stamp intercepted..dispatched in the edge process, everything else
+    (and the reconcile itself) stamps in the orchestrator."""
+    if _is_edge(doc) and stage != "reconciled":
+        return f"edge:{doc.get('entity', '')}"
+    return "orc"
+
+
+def build_graph(record_docs: Iterable[dict],
+                generation_docs: Iterable[dict] = (),
+                run_id: str = "") -> HBGraph:
+    """Construct the happens-before DAG from NDJSON-shaped records."""
+    g = HBGraph(run_id)
+    docs = [d for d in record_docs if isinstance(d.get("t"), dict)
+            and d.get("event")]
+
+    # chain edges: each event's own stage progression
+    for doc in docs:
+        t = doc["t"]
+        uuid = doc["event"]
+        prev = None
+        for stage in STAGES:
+            if stage not in t:
+                continue
+            key = g._add_node(f"{uuid}:{stage}", t[stage],
+                              _stage_proc(doc, stage),
+                              event=uuid, stage=stage)
+            if prev is not None:
+                g._add_edge(prev, key, "chain")
+            prev = key
+
+    # program edges: per-entity interception order (stable: ties keep
+    # record insertion order, which IS interception order)
+    by_entity: Dict[str, List[dict]] = {}
+    for doc in docs:
+        if "intercepted" in doc["t"]:
+            by_entity.setdefault(str(doc.get("entity") or ""),
+                                 []).append(doc)
+    for entity, rows in by_entity.items():
+        rows.sort(key=lambda d: d["t"]["intercepted"])
+        for a, b in zip(rows, rows[1:]):
+            g._add_edge(f"{a['event']}:intercepted",
+                        f"{b['event']}:intercepted", "program")
+
+    # dispatch edges: the policy's realized release order. ``released``
+    # is the policy's own stamp; records lacking it (edge bursts stamp
+    # released == dispatched, orchestrator-side actions) fall back to
+    # the dispatch stamp — the same sequence export.order_lines sorts.
+    released = [d for d in docs
+                if "released" in d["t"] or "dispatched" in d["t"]]
+    released.sort(key=lambda d: d["t"].get("released",
+                                           d["t"].get("dispatched")))
+    g.dispatched_events = [d["event"] for d in docs
+                           if "dispatched" in d["t"]]
+    g.dispatch_order = [d["event"] for d in released]
+
+    def _rel_node(doc: dict) -> str:
+        stage = "released" if "released" in doc["t"] else "dispatched"
+        return f"{doc['event']}:{stage}"
+
+    for a, b in zip(released, released[1:]):
+        g._add_edge(_rel_node(a), _rel_node(b), "dispatch")
+
+    # parent edges: explicit causal descent (obs/context.child_of —
+    # an inspector emitted this event BECAUSE of the action answering
+    # its parent, so the parent's dispatch precedes the child's
+    # emission). A lying parent claim can surface as a cycle or a
+    # stamp inversion — either IS the finding, not a crash.
+    by_uuid = {d["event"]: d for d in docs}
+    for doc in docs:
+        parent = (doc.get("ctx") or {}).get("p")
+        if not parent or parent not in by_uuid \
+                or "intercepted" not in doc["t"]:
+            continue
+        pt = by_uuid[parent]["t"]
+        for stage in ("dispatched", "released", "decided",
+                      "intercepted"):
+            if stage in pt:
+                g._add_edge(f"{parent}:{stage}",
+                            f"{doc['event']}:intercepted", "parent")
+                break
+
+    # install edges: a schedule install happens-before every decision
+    # tagged with its generation id
+    installs: Dict[int, str] = {}
+    for i, entry in enumerate(generation_docs):
+        if entry.get("kind") != "install":
+            continue
+        gen = entry.get("generation")
+        if not isinstance(gen, (int, float)):
+            continue
+        key = g._add_node(f"install:{int(gen)}:{i}", entry.get("t"),
+                          "search")
+        installs[int(gen)] = key
+    if installs:
+        for doc in docs:
+            gen = (doc.get("decision") or {}).get("generation")
+            if isinstance(gen, (int, float)) \
+                    and int(gen) in installs and "decided" in doc["t"]:
+                g._add_edge(installs[int(gen)],
+                            f"{doc['event']}:decided", "install")
+    return g
+
+
+# -- critical-path latency attribution -------------------------------------
+
+def segments_of(doc: Dict[str, Any]) -> Dict[str, float]:
+    """One record's named latency segments (missing stamps = missing
+    segments, never zeros)."""
+    t = doc.get("t") or {}
+    segments = EDGE_SEGMENTS if _is_edge(doc) else CENTRAL_SEGMENTS
+    out: Dict[str, float] = {}
+    for name, since, until in segments:
+        t0, t1 = t.get(since), t.get(until)
+        if t0 is not None and t1 is not None:
+            out[name] = max(0.0, t1 - t0)
+    return out
+
+
+def observe_stage_segments(sig) -> None:
+    """Publish a centrally-dispatched signal's completed segments into
+    ``nmz_event_stage_seconds`` from its span dict (called at the ack
+    choke point, where every central stamp is in hand)."""
+    from namazu_tpu.obs import metrics, spans
+
+    if not metrics.enabled():
+        return
+    for name, since, until in CENTRAL_SEGMENTS:
+        spans.event_stage(name, spans.span_delta(sig, since, until))
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def critical_path(record_docs: Iterable[dict],
+                  run_id: str = "") -> Dict[str, Any]:
+    """Per-run latency attribution: where each event's span went, which
+    stage dominates, and how much of the measured span the segments
+    explain (``attribution_coverage`` ~1.0 = the decomposition is an
+    identity, not an estimate)."""
+    per_stage: Dict[str, List[float]] = {}
+    spans_s: List[float] = []
+    explained = 0.0
+    span_total = 0.0
+    events = 0
+    in_flight = 0
+    for doc in record_docs:
+        t = doc.get("t") or {}
+        if "intercepted" not in t:
+            continue
+        end = t.get("acked", t.get("dispatched"))
+        if end is None:
+            # still in flight (a live /analytics read mid-run): its
+            # partial segments must not fold into the per-stage stats
+            # while its span cannot reach span_total — the shares
+            # would sum past 1 and misname the critical stage exactly
+            # when an operator is watching live
+            in_flight += 1
+            continue
+        segs = segments_of(doc)
+        for name, value in segs.items():
+            per_stage.setdefault(name, []).append(value)
+        events += 1
+        span = max(0.0, end - t["intercepted"])
+        spans_s.append(span)
+        span_total += span
+        # backhaul runs PAST the event's own end-to-end span (it is the
+        # reconcile lag, not delivery latency): exclude it from the
+        # "does the decomposition sum to the span" coverage figure
+        explained += sum(v for n, v in segs.items() if n != "backhaul")
+    spans_s.sort()
+    stages: Dict[str, Any] = {}
+    for name, vals in sorted(per_stage.items()):
+        vals.sort()
+        total = sum(vals)
+        stages[name] = {
+            "count": len(vals),
+            "total_s": round(total, 6),
+            "mean_s": round(total / len(vals), 6),
+            "p50_s": round(_quantile(vals, 0.50), 6),
+            "p99_s": round(_quantile(vals, 0.99), 6),
+            "share": (round(total / span_total, 4)
+                      if span_total > 0 else None),
+        }
+    critical = max(
+        (name for name in stages if name != "backhaul"),
+        key=lambda name: stages[name]["total_s"], default=None)
+    return {
+        "run_id": run_id,
+        "events": events,
+        "in_flight": in_flight,
+        "span_p50_s": round(_quantile(spans_s, 0.50), 6),
+        "span_p99_s": round(_quantile(spans_s, 0.99), 6),
+        "span_total_s": round(span_total, 6),
+        "attribution_coverage": (round(explained / span_total, 4)
+                                 if span_total > 0 else None),
+        "critical_stage": critical,
+        "stages": stages,
+    }
+
+
+def run_payload(run) -> Dict[str, Any]:
+    """The ``GET /causality/<run_id>`` body: one run's happens-before
+    graph + critical-path attribution."""
+    records, gens, run_id = docs_of_run(run)
+    graph = build_graph(records, gens, run_id)
+    return {
+        "schema": SCHEMA_GRAPH,
+        "run_id": run_id,
+        "graph": graph.to_jsonable(),
+        "critical_path": critical_path(records, run_id),
+    }
+
+
+# -- divergence explanation ------------------------------------------------
+
+#: shared-identity cap for the O(n^2) inversion scan; past it the
+#: payload carries ``truncated`` with the dropped count
+FLIP_SCAN_CAP = 512
+#: inverted-pair budget for the FULL transitive-reduction pass. A
+#: near-reversed 512-event pair holds ~131k inversions, and reducing
+#: every one (O(interval) probes each) would pin a live REST handler
+#: for seconds — past this budget only the top-scored pairs are
+#: reduced and the payload says so (``minimality_bounded``).
+MINIMALITY_BUDGET = 2048
+
+
+def _occurrence_keys(record_docs: Iterable[dict]) -> List[str]:
+    """Dispatch-ordered identity keys: the PR 2 order-line identity
+    (entity + class:hint) made unique by occurrence index, so repeated
+    hints — the normal case — pair up positionally across runs."""
+    seen: Dict[str, int] = {}
+    keys = []
+    for line in export.order_lines_from_docs(record_docs):
+        n = seen.get(line, 0)
+        seen[line] = n + 1
+        keys.append(f"{line}#{n}")
+    return keys
+
+
+def relation_flips(docs_a: Iterable[dict], docs_b: Iterable[dict],
+                   top: int = 20,
+                   suspicious: Optional[List] = None
+                   ) -> Dict[str, Any]:
+    """The ordering-relation diff between two runs' dispatch orders
+    (see the module header). ``suspicious`` is the analyzer's
+    fault-localization ranking (``[(branch, divergence, ...), ...]``);
+    flips touching a suspicious branch's identity rank first."""
+    keys_a = _occurrence_keys(docs_a)
+    keys_b = _occurrence_keys(docs_b)
+    set_a, set_b = set(keys_a), set(keys_b)
+    shared_order = [k for k in keys_a if k in set_b]
+    truncated = 0
+    if len(shared_order) > FLIP_SCAN_CAP:
+        truncated = len(shared_order) - FLIP_SCAN_CAP
+        shared_order = shared_order[:FLIP_SCAN_CAP]
+    shared = set(shared_order)
+    # positions live in SHARED coordinates on both sides: indexing the
+    # full per-run sequences would skew the minimality scan (and the
+    # displacement score) whenever a run holds only-in-one events
+    # before the flip region
+    b_shared = [k for k in keys_b if k in shared]
+    pos_a = {k: i for i, k in enumerate(shared_order)}
+    pos_b = {k: i for i, k in enumerate(b_shared)}
+
+    inverted = set()
+    n = len(shared_order)
+    for i in range(n):
+        x = shared_order[i]
+        for j in range(i + 1, n):
+            y = shared_order[j]
+            if pos_b[y] < pos_b[x]:
+                inverted.add((x, y))
+
+    def _minimal(x: str, y: str) -> bool:
+        # a flip implied by two smaller flips through an intermediate z
+        # is not part of the minimal explanation
+        for z in shared_order[pos_a[x] + 1:pos_a[y]]:
+            if (x, z) in inverted and (z, y) in inverted:
+                return False
+        return True
+
+    boosts: List[Tuple[str, float]] = []
+    for row in suspicious or []:
+        try:
+            branch, divergence = str(row[0]), float(row[1])
+        except (IndexError, TypeError, ValueError):
+            continue
+        if branch and divergence > 0:
+            boosts.append((branch, divergence))
+
+    def _score(x: str, y: str) -> float:
+        disp = abs(pos_a[x] - pos_b[x]) + abs(pos_a[y] - pos_b[y])
+        boost = 0.0
+        for branch, divergence in boosts:
+            if branch in x or branch in y:
+                boost = max(boost, divergence)
+        return disp + 100.0 * boost
+
+    # bound the reduction work: the full pass costs O(pairs x interval)
+    # set probes, fine for real divergences (a handful to a few
+    # thousand inversions) but quadratic-cubed for a near-reversed
+    # pair — there, reduce only the pairs worth reporting
+    bounded = len(inverted) > MINIMALITY_BUDGET
+    candidates = sorted(inverted, key=lambda p: (-_score(*p), p))
+    if bounded:
+        candidates = candidates[:4 * max(1, top)]
+    flips = [{
+        "first": x, "then": y,
+        "a_pos": [pos_a[x], pos_a[y]],
+        "b_pos": [pos_b[x], pos_b[y]],
+        "score": round(_score(x, y), 3),
+    } for x, y in candidates if _minimal(x, y)]
+    flips.sort(key=lambda f: (-f["score"], f["first"], f["then"]))
+
+    return {
+        "shared_events": len(shared),
+        "truncated": truncated,
+        "inverted_pairs": len(inverted),
+        # bounded = a lower bound over the top-scored pairs only (the
+        # payload must never read as exhaustive when it is not)
+        "flips_minimal": len(flips),
+        "minimality_bounded": bounded,
+        "flips": flips[:max(1, top)],
+        "only_in_a": sorted(set_a - set_b),
+        "only_in_b": sorted(set_b - set_a),
+        "identical_order": not inverted and set_a == set_b,
+    }
+
+
+def why_payload(records_a: List[dict], records_b: List[dict],
+                run_a: str, run_b: str, top: int = 20,
+                suspicious: Optional[List] = None) -> Dict[str, Any]:
+    """The ``GET /causality/<a>/<b>`` body: the relation diff plus each
+    run's graph summary and critical path, one self-contained document
+    (``nmz-tpu tools why`` renders it)."""
+    graph_a = build_graph(records_a, run_id=run_a)
+    graph_b = build_graph(records_b, run_id=run_b)
+    # per-run summaries keyed by SIDE, not run id: two storages'
+    # traces legitimately share sequence-numbered ids (00000002 vs
+    # 00000002), and id-keyed entries would silently collapse to one
+    return {
+        "schema": SCHEMA_WHY,
+        "run_a": run_a,
+        "run_b": run_b,
+        "diff": relation_flips(records_a, records_b, top=top,
+                               suspicious=suspicious),
+        "runs": {
+            side: {
+                "run_id": run_label,
+                "events": len(graph.dispatched_events),
+                "acyclic": graph.is_acyclic(),
+                "inversions": len(graph.stamp_inversions()),
+                "critical_path": critical_path(records, run_label),
+            }
+            for side, run_label, graph, records in (
+                ("a", run_a, graph_a, records_a),
+                ("b", run_b, graph_b, records_b))
+        },
+    }
+
+
+def render_why_md(doc: Dict[str, Any]) -> str:
+    """Markdown face of a why payload (``tools why --format md``)."""
+    diff = doc.get("diff") or {}
+    run_a, run_b = doc.get("run_a", "a"), doc.get("run_b", "b")
+    lines = [
+        f"# Why do runs `{run_a}` and `{run_b}` diverge?",
+        "",
+        f"- shared dispatched events: {diff.get('shared_events', 0)}",
+        f"- ordering relations flipped: {diff.get('inverted_pairs', 0)}"
+        f" (minimal explanation: {diff.get('flips_minimal', 0)} flips)",
+        f"- only in {run_a}: {len(diff.get('only_in_a') or [])};"
+        f" only in {run_b}: {len(diff.get('only_in_b') or [])}",
+    ]
+    if diff.get("truncated"):
+        lines.append(f"- NOTE: flip scan truncated past "
+                     f"{FLIP_SCAN_CAP} shared events "
+                     f"({diff['truncated']} dropped)")
+    if diff.get("minimality_bounded"):
+        lines.append("- NOTE: the runs are heavily divergent; the "
+                     "minimal-flip count covers only the top-scored "
+                     "inverted pairs, not an exhaustive reduction")
+    if diff.get("identical_order"):
+        lines += ["", "The realized dispatch orders are identical — "
+                      "any behavioral divergence is not an ordering "
+                      "effect visible to the recorder."]
+    flips = diff.get("flips") or []
+    if flips:
+        lines += ["", "## Minimal ordering flips (most suspicious "
+                      "first)", "",
+                  f"| score | first in `{run_a}` | then in `{run_a}` "
+                  f"| positions a | positions b |",
+                  "|---|---|---|---|---|"]
+        for f in flips:
+            lines.append(
+                f"| {f['score']} | `{f['first']}` | `{f['then']}` "
+                f"| {f['a_pos']} | {f['b_pos']} |")
+    runs = doc.get("runs") or {}
+    if runs:
+        lines += ["", "## Per-run causality summary", "",
+                  "| run | events | acyclic | stamp inversions "
+                  "| critical stage | span p99 |",
+                  "|---|---|---|---|---|---|"]
+        for side in sorted(runs):
+            row = runs[side]
+            cp = row.get("critical_path") or {}
+            lines.append(
+                f"| `{row.get('run_id', side)}` | {row.get('events')} "
+                f"| {row.get('acyclic')} | {row.get('inversions')} "
+                f"| {cp.get('critical_stage')} "
+                f"| {cp.get('span_p99_s')}s |")
+    lines += ["",
+              "Inspect either side visually: `nmz-tpu tools trace "
+              "export <run_id> --out trace.json` and load it in "
+              "ui.perfetto.dev (tracks per entity/policy; the decision "
+              "args carry the delay and table provenance).", ""]
+    return "\n".join(lines)

@@ -1,0 +1,215 @@
+"""Cross-process trace context: span contexts + a Lamport clock.
+
+The causality plane's wire layer (doc/observability.md "Causality").
+Every event minted by a transceiver (and every event first seen at an
+endpoint hub, for clients that predate this module) carries a compact
+**span context** — run id, event uuid, causal parent, a Lamport logical
+clock value, and the origin process — serialized as a ``ctx`` field on
+the signal's wire dict. Because the journal, the batch REST routes, the
+uds frames, and the edge backhaul all serialize signals through
+``Signal.to_jsonable``, the context survives every hop we own (replay
+after a reconnect, requeue after a failed backhaul flush, crash
+recovery from the WAL) without per-wire plumbing.
+
+The **logical clock** is the piece wall clocks cannot give us: each
+process ticks it on every mint and merges (``observe``) the remote
+value on every receive, so for any two context-stamped points connected
+by a message chain, ``lc`` ordering agrees with causality regardless of
+clock skew between processes. The happens-before analyzer
+(obs/causality.py) uses the monotonic stamps for *latency* and the
+logical clocks + graph structure for *order* — stamp inversions across
+process boundaries are detected, never trusted.
+
+Representation: a context IS its wire dict —
+``{"lc": int, "o": "pid@host"[, "r": run id][, "p": parent uuid]}`` —
+attached to signals as ``sig._obs_ctx``. Encode and decode are
+therefore attribute moves, not conversions, and the dict is minimal by
+design: the event's uuid is NOT repeated inside it (the signal carries
+it), and the run id is filled at hub interception rather than minted
+client-side. Both choices are load-bearing — the event plane serves
+six figures of events per second through
+``to_jsonable``/``signal_from_jsonable``, and an earlier per-event
+object round-trip plus a fatter dict measurably taxed the zero-RTT
+path.
+
+Op-level frames that carry no signal (knowledge push/pull, telemetry
+pushes, the framed fleet ops) attach a bare ``{"lc", "o"}`` stamp via
+:func:`wire_stamp`; the shared framed server (endpoint/framed.py) and
+the aggregator merge it with :func:`observe_wire`, so the clock stays
+coherent across every wire, not just the event plane.
+
+Cost contract: mirrors ``obs_enabled`` — with observability disabled
+every helper here is one global read and a return; nothing is minted,
+attached, or serialized.
+"""
+
+from __future__ import annotations
+
+import os
+import socket as _socket
+import threading
+from typing import Any, Dict, List, Optional
+
+from namazu_tpu.obs import metrics
+
+__all__ = [
+    "CTX_ATTR", "CTX_KEY", "LamportClock",
+    "clock", "origin", "mint", "mint_many", "ensure",
+    "attach", "context_of", "child_of", "observe_wire",
+    "observe", "lc_of", "wire_stamp", "reset",
+]
+
+#: attribute name on signals (same convention as spans.SPANS_ATTR)
+CTX_ATTR = "_obs_ctx"
+#: wire field on signal dicts and framed-op frames
+CTX_KEY = "ctx"
+
+
+class LamportClock:
+    """A process-wide Lamport clock: ``tick`` on local send/mint,
+    ``observe`` on receive (merge to ``max(local, remote) + 1``)."""
+
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self) -> None:
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def tick(self, n: int = 1) -> int:
+        with self._lock:
+            self._value += n
+            return self._value
+
+    def observe(self, remote: int) -> int:
+        with self._lock:
+            self._value = max(self._value, int(remote)) + 1
+            return self._value
+
+    def value(self) -> int:
+        return self._value
+
+
+_clock = LamportClock()
+
+
+def clock() -> LamportClock:
+    return _clock
+
+
+_origin: Optional[str] = None
+
+
+def origin() -> str:
+    """``pid@host`` — the process identity carried in contexts (and the
+    forensic key for "which process stamped this"). Re-derived after a
+    fork so children do not impersonate their parent."""
+    global _origin
+    o = _origin
+    if o is None:
+        o = _origin = f"{os.getpid()}@{_socket.gethostname()}"
+    return o
+
+
+def _forget_origin() -> None:
+    global _origin
+    _origin = None
+
+
+if hasattr(os, "register_at_fork"):  # pragma: no branch
+    os.register_at_fork(after_in_child=_forget_origin)
+
+
+def mint(parent: str = "") -> Dict[str, Any]:
+    """A fresh context: ticks the process clock once. The run id is
+    deliberately absent — the hub fills it at interception, where the
+    authoritative run is known (a remote mint can only guess)."""
+    ctx: Dict[str, Any] = {"lc": _clock.tick(), "o": origin()}
+    if parent:
+        ctx["p"] = parent
+    return ctx
+
+
+def mint_many(events: List[Any], parent: str = "") -> None:
+    """Batch mint for a burst (``Transceiver.send_events``): ONE clock
+    tick for the whole burst — the intra-burst order is already carried
+    by entity program order, and a per-event tick under the clock lock
+    would tax the zero-RTT path for nothing."""
+    if not metrics.enabled() or not events:
+        return
+    lc = _clock.tick()
+    org = origin()
+    for ev in events:
+        if getattr(ev, CTX_ATTR, None) is None:
+            ctx: Dict[str, Any] = {"lc": lc, "o": org}
+            if parent:
+                ctx["p"] = parent
+            setattr(ev, CTX_ATTR, ctx)
+
+
+def attach(sig: Any, ctx: Optional[Dict[str, Any]]) -> None:
+    if ctx is not None:
+        setattr(sig, CTX_ATTR, ctx)
+
+
+def context_of(sig: Any) -> Optional[Dict[str, Any]]:
+    return getattr(sig, CTX_ATTR, None)
+
+
+def ensure(sig: Any, parent: str = "") -> Optional[Dict[str, Any]]:
+    """The signal's context, minted on first use. None (and zero
+    allocation) while observability is disabled."""
+    if not metrics.enabled():
+        return None
+    ctx = getattr(sig, CTX_ATTR, None)
+    if ctx is None:
+        ctx = mint(parent=parent)
+        setattr(sig, CTX_ATTR, ctx)
+    return ctx
+
+
+def child_of(parent_sig: Any) -> Optional[Dict[str, Any]]:
+    """A context causally descending from ``parent_sig`` — for
+    follow-on events an inspector emits *because of* an action it
+    received (the explicit causal-parent edge in the DAG)."""
+    if not metrics.enabled():
+        return None
+    return mint(parent=getattr(parent_sig, "uuid", ""))
+
+
+def lc_of(ctx: Optional[Dict[str, Any]]) -> int:
+    if not ctx:
+        return 0
+    lc = ctx.get("lc")
+    return lc if isinstance(lc, int) else 0
+
+
+def observe(ctx: Optional[Dict[str, Any]]) -> None:
+    """Merge a context's clock into ours (the receive-side choke
+    points: endpoint hub, framed server, fleet aggregator)."""
+    lc = lc_of(ctx)
+    if lc > 0:
+        _clock.observe(lc)
+
+
+def observe_wire(d: Any) -> Optional[Dict[str, Any]]:
+    """Receive-side merge for a raw wire field (a signal's ctx, or a
+    bare op stamp): folds the clock, returns the context dict (None
+    for malformed input)."""
+    if not isinstance(d, dict):
+        return None
+    lc = d.get("lc")
+    if isinstance(lc, int) and lc > 0:
+        _clock.observe(lc)
+    return d
+
+
+def wire_stamp() -> Dict[str, Any]:
+    """A bare ``{"lc", "o"}`` stamp for op-level frames that carry no
+    signal (knowledge ops, telemetry pushes, framed fleet reads)."""
+    return {"lc": _clock.tick(), "o": origin()}
+
+
+def reset() -> None:
+    """Fresh clock (tests)."""
+    global _clock
+    _clock = LamportClock()

@@ -280,6 +280,13 @@ class TelemetryRelay:
         doc = {"schema": SCHEMA, "job": self.job,
                "instance": self.instance, "seq": self._seq,
                "interval_s": self.interval_s, "families": families}
+        if metrics.enabled():
+            # causality plane (obs/context.py): stamp the push so the
+            # aggregator's logical clock merges every producer's —
+            # federation hops keep the original producer's stamp
+            from namazu_tpu.obs import context as _context
+
+            doc["ctx"] = _context.wire_stamp()
         if self.local is not None:
             try:
                 # forward=False: our own doc must not land in the
@@ -434,6 +441,12 @@ class FleetAggregator:
             raise ValueError("telemetry doc needs an integer seq") \
                 from None
         now = time.monotonic() if now is None else now
+        if metrics.enabled():
+            # merge the producer's logical clock (obs/context.py) —
+            # the aggregator is a receive point like any other wire
+            from namazu_tpu.obs import context as _context
+
+            _context.observe_wire(doc.get("ctx"))
         hist_deltas: List[Tuple[str, List[float], List[int]]] = []
         with self._lock:
             st = self._instances.get((job, instance))
@@ -660,6 +673,42 @@ class FleetAggregator:
                 return fs.uppers[min(i, len(fs.uppers) - 1)]
         return fs.uppers[-1]
 
+    def _hist_quantile_by(self, st: _InstanceState, name: str,
+                          label: str, q: float) -> Dict[str, float]:
+        """Per-label-value quantiles of one histogram family (the
+        causality plane's ``nmz_event_stage_seconds{stage}`` read):
+        label value -> q-quantile upper bound, merged across the
+        family's other labels."""
+        fs = st.families.get(name)
+        if fs is None or fs.type != "histogram" or fs.uppers is None:
+            return {}
+        try:
+            idx = fs.labelnames.index(label)
+        except ValueError:
+            return {}
+        merged: Dict[str, List[int]] = {}
+        for key, v in fs.samples.items():
+            counts = v[0]
+            acc = merged.setdefault(key[idx],
+                                    [0] * (len(fs.uppers) + 1))
+            for i, c in enumerate(counts):
+                acc[i] += c
+        out: Dict[str, float] = {}
+        for value, counts in merged.items():
+            total = sum(counts)
+            if total <= 0:
+                continue
+            target = q * total
+            acc = 0
+            for i, c in enumerate(counts):
+                acc += c
+                if acc >= target:
+                    out[value] = fs.uppers[min(i, len(fs.uppers) - 1)]
+                    break
+            else:  # pragma: no cover - defensive
+                out[value] = fs.uppers[-1]
+        return out
+
     def max_gauge(self, name: str) -> Optional[float]:
         """Fleet-wide max of a gauge (the staleness-SLO resolver)."""
         best: Optional[float] = None
@@ -733,6 +782,12 @@ class FleetAggregator:
                         st, spans.EVENT_E2E, 0.99),
                     "backhaul_lag_p99_s": self._hist_quantile(
                         st, spans.EDGE_BACKHAUL_LAG, 0.99),
+                    # per-lifecycle-segment p99s (queue/decision/
+                    # parking/dispatch/wire/edge_parking/backhaul) —
+                    # the causality plane's "where does the
+                    # millisecond go", federated (obs/causality.py)
+                    "stage_p99_s": self._hist_quantile_by(
+                        st, spans.EVENT_STAGE, "stage", 0.99),
                     "table_version": held,
                     "table_skew": (round(fleet_version - held)
                                    if held is not None else None),
@@ -1005,139 +1060,50 @@ def handle_obs_op(req: dict,
 
 
 class TelemetryServer:
-    """The campaign supervisor's collector: a minimal framed-JSON
-    AF_UNIX server answering :func:`handle_obs_op` (plus ``ping``) —
-    same-host ``run`` children and ``tools top --url uds://...`` speak
-    to it without the supervisor growing an HTTP stack or a TCP
-    port."""
+    """The campaign supervisor's collector: the shared framed-JSON
+    serve loop (endpoint/framed.py) over AF_UNIX answering
+    :func:`handle_obs_op` (plus ``ping``) — same-host ``run`` children
+    and ``tools top --url uds://...`` speak to it without the
+    supervisor growing an HTTP stack or a TCP port."""
 
     def __init__(self, path: str,
                  agg: Optional[FleetAggregator] = None) -> None:
         self.path = path
         self._agg = agg
-        self._server: Optional[_socket.socket] = None
-        self._stop = threading.Event()
-        self._conns: set = set()
-        self._conns_lock = threading.Lock()
+        self._server = None
 
     def aggregator(self) -> FleetAggregator:
         return self._agg if self._agg is not None else aggregator()
 
+    def _handle(self, req: dict) -> dict:
+        resp = handle_obs_op(req, self.aggregator())
+        if resp is None:
+            resp = ({"ok": True, "server": "telemetry"}
+                    if req.get("op") == "ping" else
+                    {"ok": False,
+                     "error": f"unknown op {req.get('op')!r}"})
+        return resp
+
     def start(self) -> None:
         if self._server is not None:
             return
-        # reclaim only a LISTENER-LESS stale socket inode (same rule as
-        # the uds event endpoint): a live listener means another
-        # collector owns this path
-        if os.path.exists(self.path):
-            probe = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
-            probe.settimeout(0.2)
-            try:
-                probe.connect(self.path)
-            except OSError:
-                try:
-                    os.unlink(self.path)
-                except OSError:
-                    pass
-            else:
-                raise RuntimeError(
-                    f"telemetry collector path {self.path!r} already "
-                    "has a live listener")
-            finally:
-                try:
-                    probe.close()
-                except OSError:
-                    pass
-        srv = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
-        srv.bind(self.path)
-        srv.listen(32)
+        # lazy: obs modules must stay importable without the endpoint
+        # package resolving at module load
+        from namazu_tpu.endpoint.framed import FramedServer
+
+        srv = FramedServer(self._handle, name="telemetry-collector")
+        # bind_unix reclaims only a LISTENER-LESS stale socket inode
+        # (same rule as the uds event endpoint): a live listener means
+        # another collector owns this path, and raises
+        srv.bind_unix(self.path, backlog=32)
+        srv.start()
         self._server = srv
-        threading.Thread(target=self._accept_loop,
-                         name="telemetry-collector", daemon=True).start()
         log.info("fleet telemetry collector on %s", self.path)
 
     def shutdown(self) -> None:
-        self._stop.set()
         srv, self._server = self._server, None
         if srv is not None:
-            try:
-                srv.close()
-            except OSError:
-                pass
-        with self._conns_lock:
-            conns = list(self._conns)
-        for conn in conns:
-            try:
-                conn.shutdown(_socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                conn.close()
-            except OSError:
-                pass
-        try:
-            os.unlink(self.path)
-        except OSError:
-            pass
-
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            srv = self._server
-            if srv is None:
-                return
-            try:
-                conn, _ = srv.accept()
-            except OSError:
-                return
-            with self._conns_lock:
-                self._conns.add(conn)
-            threading.Thread(target=self._serve_conn, args=(conn,),
-                             name="telemetry-conn", daemon=True).start()
-
-    def _serve_conn(self, conn: _socket.socket) -> None:
-        from namazu_tpu.endpoint.agent import read_frame, write_frame
-
-        try:
-            while not self._stop.is_set():
-                try:
-                    req = read_frame(conn)
-                except (ValueError, OSError):
-                    break
-                if req is None:
-                    break
-                if not isinstance(req, dict):
-                    # same contract as the uds event endpoint: a
-                    # valid-JSON non-object frame is ANSWERED, keeping
-                    # the client's keep-alive stream in sync, instead
-                    # of severing the connection
-                    try:
-                        write_frame(conn, {
-                            "ok": False,
-                            "error": "frame must be a JSON object"})
-                    except OSError:
-                        break
-                    continue
-                try:
-                    resp = handle_obs_op(req, self.aggregator())
-                    if resp is None:
-                        resp = ({"ok": True, "server": "telemetry"}
-                                if req.get("op") == "ping" else
-                                {"ok": False,
-                                 "error": f"unknown op {req.get('op')!r}"})
-                except Exception as e:  # answer, never desync the wire
-                    log.exception("telemetry op failed")
-                    resp = {"ok": False, "error": repr(e)}
-                try:
-                    write_frame(conn, resp)
-                except OSError:
-                    break
-        finally:
-            with self._conns_lock:
-                self._conns.discard(conn)
-            try:
-                conn.close()
-            except OSError:
-                pass
+            srv.shutdown()
 
 
 # -- process-global wiring -------------------------------------------------

@@ -54,16 +54,20 @@ __all__ = [
 
 #: lifecycle stamp names, in causal order (export sorts tracks by the
 #: first present stamp; the acceptance invariant "monotonic per-track
-#: timestamps" holds because each stage stamps with time.monotonic())
+#: timestamps" holds because each stage stamps with time.monotonic()).
+#: ``reconciled`` exists only on edge-decided records: the moment their
+#: async backhaul folded back into the orchestrator — the anchor of the
+#: causality plane's ``backhaul`` latency segment (obs/causality.py)
 STAGES = ("intercepted", "enqueued", "decided", "released",
-          "dispatched", "acked")
+          "dispatched", "acked", "reconciled")
 
 
 class EventRecord:
     """One event's full lifecycle through the control plane."""
 
     __slots__ = ("event_id", "entity", "endpoint", "event_class", "hint",
-                 "policy", "decision", "action_class", "action_kind", "t")
+                 "policy", "decision", "action_class", "action_kind", "t",
+                 "ctx")
 
     def __init__(self, event_id: str, entity: str = "",
                  endpoint: str = "", event_class: str = "",
@@ -81,6 +85,10 @@ class EventRecord:
         self.action_kind = ""
         #: stage -> monotonic stamp (subset of STAGES)
         self.t: Dict[str, float] = {}
+        #: the event's span context in wire form (obs/context.py):
+        #: causal parent, Lamport clock at mint, origin process — None
+        #: for events from pre-context clients
+        self.ctx: Optional[Dict[str, Any]] = None
 
     def copy(self) -> "EventRecord":
         """Deep-enough copy for lock-free export: writers keep mutating
@@ -93,6 +101,7 @@ class EventRecord:
         dup.action_class = self.action_class
         dup.action_kind = self.action_kind
         dup.t = dict(self.t)
+        dup.ctx = dict(self.ctx) if self.ctx else None
         return dup
 
     def first_stamp(self) -> Optional[float]:
@@ -104,7 +113,7 @@ class EventRecord:
     def to_jsonable(self, anchor: float = 0.0) -> Dict[str, Any]:
         """Record as a plain dict; timestamps become offsets (seconds,
         µs precision) from ``anchor`` so two runs' dumps diff cleanly."""
-        return {
+        doc = {
             "event": self.event_id,
             "entity": self.entity,
             "endpoint": self.endpoint,
@@ -117,6 +126,11 @@ class EventRecord:
             "t": {name: round(self.t[name] - anchor, 6)
                   for name in STAGES if name in self.t},
         }
+        # additive: context-less records (old clients, obs-off mints)
+        # serialize exactly as before, so existing dumps stay diffable
+        if self.ctx:
+            doc["ctx"] = dict(self.ctx)
+        return doc
 
 
 class RunTrace:
@@ -365,7 +379,8 @@ def record_intercepted(event, endpoint: str,
         return
     run.stamp(event.uuid, "intercepted", now=now,
               entity=event.entity_id, endpoint=endpoint,
-              event_class=event.class_name(), hint=event.replay_hint())
+              event_class=event.class_name(), hint=event.replay_hint(),
+              ctx=getattr(event, "_obs_ctx", None))
 
 
 def record_enqueued(event, policy: str,
@@ -428,13 +443,15 @@ def record_edge(event, endpoint: str, policy: str, action,
     if run is None:
         return
     detail = {name: decision[name] for name in
-              ("delay", "source", "decision_source", "table_version")
+              ("delay", "source", "decision_source", "table_version",
+               "lc", "o")
               if name in decision}
     rec = run.record_for(
         event.uuid, entity=event.entity_id, endpoint=endpoint,
         event_class=event.class_name(), hint=event.replay_hint(),
         policy=policy, decision=detail,
-        action_class=action.class_name(), action_kind="edge")
+        action_class=action.class_name(), action_kind="edge",
+        ctx=getattr(event, "_obs_ctx", None))
     if rec is None:
         return
     now = time.monotonic()
@@ -444,9 +461,11 @@ def record_edge(event, endpoint: str, policy: str, action,
     t1 = now if t1 is None else float(t1)
     # dict assignment is GIL-atomic and snapshot copies under the run
     # lock, so stamping outside record_for's lock is race-free enough
-    # (the same contract stamp() relies on)
+    # (the same contract stamp() relies on). ``reconciled`` = THIS
+    # moment — the backhaul-lag anchor the causality plane attributes
+    # the async window to.
     rec.t.update(intercepted=t0, enqueued=t0, decided=t0,
-                 released=t1, dispatched=t1)
+                 released=t1, dispatched=t1, reconciled=now)
 
 
 def record_dispatched(action, kind: str,

@@ -130,6 +130,16 @@ KNOWLEDGE_TENANTS = "nmz_knowledge_tenants"
 KNOWLEDGE_POOL = "nmz_knowledge_pool_entries"
 KNOWLEDGE_OUTAGES = "nmz_knowledge_outages_total"
 
+# causality plane (doc/observability.md "Causality"): each event's
+# intercepted->acked span decomposed into named segments — queue (hub
+# queue dwell), decision (policy), parking (the injected delay),
+# dispatch (action loop), wire (dispatch -> inspector ack); edge events
+# contribute edge_parking (local decide -> local release) and backhaul
+# (edge dispatch -> orchestrator reconcile). The central segments
+# telescope: their sum IS the intercepted->acked span, so "where does
+# the millisecond go" is a histogram query, not a bench run.
+EVENT_STAGE = "nmz_event_stage_seconds"
+
 # experiment plane (cross-run aggregates, set by obs/analytics.py when a
 # payload is computed — GET /analytics, nmz-tpu tools report)
 EXPERIMENT_RUNS = "nmz_experiment_runs"
@@ -200,6 +210,19 @@ def latency(sig, since: str, now: Optional[float] = None) -> Optional[float]:
     if t0 is None:
         return None
     return (time.monotonic() if now is None else now) - t0
+
+
+def span_delta(sig, since: str, until: str) -> Optional[float]:
+    """Seconds between two already-stamped spans, or None when either
+    is missing — the per-segment read the stage attribution uses."""
+    spans = getattr(sig, SPANS_ATTR, None)
+    if not spans:
+        return None
+    t0 = spans.get(since)
+    t1 = spans.get(until)
+    if t0 is None or t1 is None:
+        return None
+    return t1 - t0
 
 
 def carry(dst, src) -> None:
@@ -524,6 +547,37 @@ def event_batch(stage: str, size: int) -> None:
         ("stage",),
         buckets=BATCH_BUCKETS,
     ).labels(stage=stage).observe(size)
+
+
+_EVENT_STAGE_HELP = ("per-event latency by lifecycle segment (queue/"
+                     "decision/parking/dispatch/wire; edge_parking/"
+                     "backhaul on the edge path)")
+
+
+def event_stage(stage: str, seconds: Optional[float]) -> None:
+    """One event's time through one lifecycle segment (the critical-
+    path attribution's histogram face; None = the bounding stamps were
+    absent, e.g. wire-less local transports — observe nothing rather
+    than a fake 0)."""
+    if seconds is None or not metrics.enabled():
+        return
+    metrics.get().histogram(
+        EVENT_STAGE, _EVENT_STAGE_HELP, ("stage",),
+    ).labels(stage=stage).observe(max(0.0, seconds))
+
+
+def event_stage_many(stage: str, values) -> None:
+    """Batch face of :func:`event_stage`: ONE registry/label
+    resolution for a whole burst's samples — the edge-backhaul
+    reconcile runs at zero-RTT rates, where a per-event family lookup
+    would tax the serving plane it measures."""
+    if not values or not metrics.enabled():
+        return
+    child = metrics.get().histogram(
+        EVENT_STAGE, _EVENT_STAGE_HELP, ("stage",),
+    ).labels(stage=stage)
+    for v in values:
+        child.observe(max(0.0, v))
 
 
 def transport_rtt(op: str, seconds: float) -> None:

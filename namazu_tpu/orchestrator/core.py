@@ -401,6 +401,8 @@ class Orchestrator:
         policy/action loops."""
         policy_name = (self.policy if self.enabled else self.dumb).name
         now_mono = time.monotonic()
+        lags = []
+        parkings = []
         for ev in events:
             d = ev._edge_decision
             action = ev.default_action()
@@ -414,8 +416,17 @@ class Orchestrator:
             stamp = d.get("t_dispatched")
             if isinstance(stamp, (int, float)):
                 obs.edge_backhaul_lag(ev.entity_id, now_mono - stamp)
+                lags.append(now_mono - stamp)
+                t0 = d.get("t_intercepted")
+                if isinstance(t0, (int, float)):
+                    parkings.append(stamp - t0)
             if self.collect_trace:
                 self.trace.append(action)
+        # causality-plane stage attribution (obs/causality.py): the
+        # edge path's two segments, observed batch-wise (one family
+        # resolution per burst — this loop runs at zero-RTT rates)
+        obs.event_stage_many("backhaul", lags)
+        obs.event_stage_many("edge_parking", parkings)
         obs.action_dispatched("edge", None, n=len(events))
 
     def _forward_loop_factory(self, policy: ExplorePolicy):

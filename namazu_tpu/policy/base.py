@@ -170,6 +170,10 @@ class QueueBackedPolicy(ExplorePolicy):
                 return
             actions = []
             for event in events:
+                # the released span feeds the causality plane's
+                # parking/dispatch segment split (obs/causality.py);
+                # the shared span dict makes it visible on the action
+                obs.mark(event, "released")
                 obs.record_released(event, self.name)
                 obs.queue_dwell(self.name, event.entity_id,
                                 obs.latency(event, "enqueued"))

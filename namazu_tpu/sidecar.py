@@ -51,6 +51,7 @@ import numpy as np
 
 from namazu_tpu import obs
 from namazu_tpu.endpoint.agent import read_frame, write_frame
+from namazu_tpu.endpoint.framed import FramedServer
 from namazu_tpu.storage import load_storage
 from namazu_tpu.utils.log import get_logger
 
@@ -251,54 +252,36 @@ class SidecarServer:
         # the sidecar is its host process, sharing the framed wire
         self.knowledge = knowledge
         self._host, self._port = host, port
-        self._srv: Optional[socket.socket] = None
-        self._stop = threading.Event()
-        # live keep-alive connections: shutdown must sever them too, or
-        # "kill the service" would leave already-connected clients
-        # talking to a half-dead server instead of degrading cleanly
-        self._conns: set = set()
-        self._conns_lock = threading.Lock()
+        # the shared keep-alive serve loop (endpoint/framed.py): one
+        # frame-hygiene/error-answering/span-context implementation
+        # across the framed wires. Keep-alive matters here: knowledge
+        # clients push and pull on every run of a campaign, and
+        # re-paying TCP setup per request would tax exactly the
+        # cold-run path the warm-start exists to speed up; one-shot
+        # clients still work — their close is just the first EOF.
+        self._srv: Optional[FramedServer] = None
 
     @property
     def port(self) -> int:
         assert self._srv is not None
-        return self._srv.getsockname()[1]
+        return self._srv.port
 
     def start(self) -> None:
-        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        srv.bind((self._host, self._port))
-        srv.listen(8)
+        srv = FramedServer(self._dispatch, name="sidecar")
+        srv.bind_tcp(self._host, self._port)
+        srv.start()
         self._srv = srv
-        threading.Thread(target=self._accept_loop, daemon=True,
-                         name="sidecar-accept").start()
         log.info("search sidecar on %s:%d", self._host, self.port)
 
     def shutdown(self) -> None:
-        self._stop.set()
-        if self._srv is not None:
-            try:
-                self._srv.close()
-            except OSError:
-                pass
-        with self._conns_lock:
-            conns, self._conns = set(self._conns), set()
-        for conn in conns:
-            try:
-                conn.close()
-            except OSError:
-                pass
+        # shutdown severs live keep-alive connections too, or "kill
+        # the service" would leave already-connected clients talking
+        # to a half-dead server instead of degrading cleanly
+        srv, self._srv = self._srv, None
+        if srv is not None:
+            srv.shutdown()
         if self.knowledge is not None:
             self.knowledge.close()
-
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            try:
-                conn, _ = self._srv.accept()
-            except OSError:
-                return
-            threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True, name="sidecar-conn").start()
 
     def _dispatch(self, req: dict) -> dict:
         """Route one request: knowledge ops to the hosted knowledge
@@ -333,36 +316,6 @@ class SidecarServer:
             resp["knowledge"] = True
             resp["knowledge_v"] = self.knowledge.VERSION
         return resp
-
-    def _serve_conn(self, conn: socket.socket) -> None:
-        # keep-alive: serve request/response pairs until the client
-        # closes (EOF -> read_frame None). Knowledge clients push and
-        # pull on every run of a campaign, so re-paying TCP setup (and
-        # slow-start) per request would tax exactly the cold-run path
-        # the warm-start exists to speed up; one-shot clients still work
-        # — their close is just the first EOF.
-        with self._conns_lock:
-            self._conns.add(conn)
-        try:
-            while not self._stop.is_set():
-                req = read_frame(conn)
-                if req is None:
-                    return
-                try:
-                    resp = self._dispatch(req)
-                except Exception as e:
-                    log.exception("sidecar request failed")
-                    resp = {"ok": False, "error": repr(e)}
-                write_frame(conn, resp)
-        except OSError:
-            pass  # peer vanished mid-write: nothing to answer
-        finally:
-            with self._conns_lock:
-                self._conns.discard(conn)
-            try:
-                conn.close()
-            except OSError:
-                pass
 
 
 def request(addr: str, req: dict, timeout: float = 300.0) -> dict:

@@ -184,13 +184,23 @@ class Signal:
     # -- wire codec ------------------------------------------------------
 
     def to_jsonable(self) -> Dict[str, Any]:
-        return {
+        d = {
             "type": self.signal_type().value,
             "class": self.class_name(),
             "entity": self.entity_id,
             "uuid": self.uuid,
             "option": self.option,
         }
+        # causality-plane span context (obs/context.py): attached by
+        # the transceiver/hub when observability is on; riding the one
+        # signal codec means it survives EVERY wire that carries
+        # signals — batch routes, uds frames, edge backhaul, the crash
+        # journal, reconnect replays — without per-wire plumbing. The
+        # context IS its wire dict, so this is an attribute move.
+        ctx = getattr(self, "_obs_ctx", None)
+        if ctx is not None:
+            d["ctx"] = ctx
+        return d
 
     def to_json(self) -> str:
         return json.dumps(self.to_jsonable(), sort_keys=True)
@@ -214,6 +224,12 @@ def signal_from_jsonable(d: Dict[str, Any]) -> "Signal":
         )
     sig = cls.from_jsonable(d)
     sig.mark_arrived()
+    ctx = d.get("ctx")
+    if type(ctx) is dict:
+        # restore the span context (an attribute move — the context IS
+        # its wire dict; decode is PURE, the clock merge happens at the
+        # hub/framed-server choke points, not per parse)
+        sig._obs_ctx = ctx
     return sig
 
 

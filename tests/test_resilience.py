@@ -628,7 +628,13 @@ def test_orchestrator_sigkill_mid_run_campaign_recovers(
     ad-hoc monkeypatching: the campaign classifies the slot infra and
     retries it, the storage ends up quarantined or journal-recoverable
     (both legal), no testee process is orphaned (the phase.pgid sweep),
-    and the pre-crash events are sitting in the run's event journal."""
+    and the pre-crash events are sitting in the run's event journal.
+
+    Deflaked (PR 10): the crash fires on the FIRST journaled event
+    batch (``at: [0]``), not the third — under CPU load the event loop
+    coalesces inbound posts, so "the third batch" sometimes never
+    arrived and the run sailed on to its 60s deadline instead of
+    crashing (the timing sensitivity PR 9 noted)."""
     from namazu_tpu import chaos as chaos_mod
     from namazu_tpu.campaign import Campaign, CampaignSpec, EXIT_OK
     from namazu_tpu.chaos.journal import EventJournal
@@ -668,9 +674,11 @@ def test_orchestrator_sigkill_mid_run_campaign_recovers(
     storage = str(tmp_path / "st")
     assert cli_main(["init", str(config), str(materials), storage]) == 0
 
-    # the third event-loop batch SIGKILLs the orchestrator (run child)
+    # the first journaled event-loop batch SIGKILLs the orchestrator
+    # (run child) — batch-count-independent, so load-dependent post
+    # coalescing cannot defer the crash past the posting script
     monkeypatch.setenv(chaos_mod.ENV_VAR, chaos_mod.env_value(
-        1, {"orchestrator.crash": {"at": [2]}}))
+        1, {"orchestrator.crash": {"at": [0]}}))
     spec = CampaignSpec(storage_dir=storage, runs=1, retries=1,
                         run_wall_deadline_s=120, run_deadline_s=60,
                         backoff_base_s=0.05, backoff_cap_s=0.1, seed=1,
