@@ -247,6 +247,53 @@ def register(sub) -> None:
                     help="write to this file instead of stdout")
     pr.set_defaults(func=report)
 
+    pc = tsub.add_parser(
+        "coverage",
+        help="relation-coverage dump (guidance plane, doc/search.md): "
+             "bitmap occupancy, the coverage growth curve, and the top "
+             "uncovered (one-sided) ordering relations ranked by "
+             "predicted flip score — the frontier a guided search "
+             "mutates toward",
+    )
+    pc.add_argument("storage", nargs="?", default="",
+                    help="storage dir to analyze (omit with --url)")
+    pc.add_argument("--url", default="",
+                    help="a running orchestrator's REST endpoint: read "
+                         "the relation-coverage section of its live "
+                         "/analytics payload instead of a storage dir")
+    pc.add_argument("--top", type=int, default=12,
+                    help="one-sided relations listed (default 12)")
+    pc.add_argument("--format", choices=("md", "json"), default="md")
+    pc.add_argument("--out", default="",
+                    help="write to this file instead of stdout")
+    pc.set_defaults(func=coverage)
+
+    pg = tsub.add_parser(
+        "ab-guided",
+        help="guided-vs-blind A/B acceptance (guidance plane, "
+             "doc/search.md): two seeded campaigns of equal run budget "
+             "over one deterministic relation-bug workload — guided "
+             "must reach >= --min-ratio the blind arm's relation "
+             "coverage, dominate its curve, and not regress "
+             "time-to-first-failure; exit 1 on any violated criterion",
+    )
+    pg.add_argument("example", nargs="?", default="",
+                    help="example dir (e.g. examples/flaky-init): seed "
+                         "the workload's identity space from its "
+                         "config; omit for the synthetic default")
+    pg.add_argument("--seed", type=int, default=11)
+    pg.add_argument("--runs", type=int, default=72,
+                    help="runs per arm (default 72)")
+    pg.add_argument("--min-ratio", type=float, default=1.25,
+                    help="required guided/blind relation-coverage "
+                         "ratio (default 1.25)")
+    pg.add_argument("--workdir", default="",
+                    help="where the two arms' storages land (default: "
+                         "a temp dir)")
+    pg.add_argument("--out", default="",
+                    help="also write the report JSON to this path")
+    pg.set_defaults(func=ab_guided)
+
     pk = tsub.add_parser(
         "knowledge",
         help="global failure-knowledge service stats (doc/knowledge.md): "
@@ -680,6 +727,161 @@ def fsck(args) -> int:
         print("rerun with --repair to quarantine incomplete runs and "
               "sweep stray temps")
     return 1 if findings else 0
+
+
+def _coverage_md(doc: dict) -> str:
+    """Markdown face of a coverage dump."""
+    from namazu_tpu.obs.report import sparkline
+
+    stats = doc.get("stats") or {}
+    lines = [
+        "# Relation coverage",
+        "",
+        f"- source: `{doc.get('source', '')}`",
+        f"- covered: {stats.get('covered_bits', 0)} / "
+        f"{stats.get('width', 0)} bits "
+        f"(occupancy {stats.get('occupancy', 0)}) over "
+        f"{stats.get('runs_observed', 0)} run(s)",
+        f"- growth: `{sparkline(stats.get('curve', []))}` "
+        f"{stats.get('curve', [])}",
+        f"- directed pairs tracked: "
+        f"{_fmt_cell(stats.get('directed_pairs'))} "
+        f"(overflow {_fmt_cell(stats.get('pair_overflow'))})",
+    ]
+    if "relation_saturated" in doc:
+        # the aggregate verdicts the --url mode exists to surface
+        lines.append(
+            f"- relation saturated: "
+            f"{_fmt_cell(doc.get('relation_saturated'))} "
+            f"(open frontier: "
+            f"{_fmt_cell(doc.get('relation_frontier_bits'))} "
+            "one-sided relation bits)")
+    lines.append("")
+    rows = doc.get("one_sided_top") or []
+    if rows:
+        lines += ["## Top uncovered relations (by predicted flip "
+                  "score)", "",
+                  "| first | then (flip uncovered) | seen | min gap "
+                  "| flip score |",
+                  "|---|---|---:|---:|---:|"]
+        for r in rows:
+            lines.append(f"| `{r['first']}` | `{r['then']}` "
+                         f"| {r['count']} | {r['min_gap']} "
+                         f"| {r['flip_score']} |")
+    elif "one_sided_top" in doc:
+        lines.append("- no one-sided relations (every observed "
+                     "ordering has had its flip exercised)")
+    else:
+        lines.append("- one-sided relation identities are not "
+                     "available over --url (the /analytics payload "
+                     "carries curve aggregates only); point this tool "
+                     "at the storage dir for the full frontier")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def coverage(args) -> int:
+    """Relation-coverage dump (guidance plane): the campaign's covered
+    bitmap, growth curve, and one-sided frontier — from a storage dir
+    (full detail) or a live orchestrator's /analytics (aggregates)."""
+    from namazu_tpu.obs import analytics as an
+
+    if args.url:
+        payload = json.loads(_http_get(
+            args.url.rstrip("/") + "/analytics"))
+        cov = payload.get("coverage") or {}
+        doc = {
+            "schema": "nmz-coverage-v1",
+            "source": args.url,
+            "stats": {
+                "covered_bits": cov.get("relation_bits", 0),
+                "width": cov.get("relation_width", 0),
+                "occupancy": cov.get("relation_coverage", 0.0),
+                "runs_observed": cov.get("runs", 0),
+                "curve": cov.get("relation_curve", []),
+                "directed_pairs": None,
+                "pair_overflow": None,
+            },
+            "relation_saturated": cov.get("relation_saturated"),
+            "relation_frontier_bits": cov.get("relation_frontier_bits"),
+        }
+    elif args.storage:
+        from namazu_tpu.guidance import (
+            CoverageMap,
+            bucket_sequence_from_trace,
+        )
+
+        st = load_storage(args.storage)
+        try:
+            cmap = CoverageMap(H=an.RELATION_H, width=an.RELATION_WIDTH,
+                               window=an.RELATION_WINDOW)
+            is_quarantined = getattr(st, "is_quarantined", None)
+            for i in range(st.nr_stored_histories()):
+                if is_quarantined is not None and is_quarantined(i):
+                    continue
+                try:
+                    trace = st.get_stored_history(i)
+                except Exception:
+                    continue
+                cmap.observe(
+                    bucket_sequence_from_trace(trace, an.RELATION_H))
+        finally:
+            st.close()
+        doc = {
+            "schema": "nmz-coverage-v1",
+            "source": os.path.abspath(args.storage),
+            "stats": cmap.stats(),
+            "one_sided_top": cmap.one_sided(args.top),
+        }
+    else:
+        raise SystemExit("error: give a storage dir or --url")
+    if args.format == "json":
+        text = json.dumps(doc, sort_keys=True) + "\n"
+    else:
+        text = _coverage_md(doc)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {args.out}")
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+def ab_guided(args) -> int:
+    """The guidance plane's A/B acceptance gate (guidance/ab.py):
+    prints the per-arm summary + report JSON; exit 1 when any
+    acceptance criterion fails — CI-gateable."""
+    import tempfile
+
+    from namazu_tpu.guidance.ab import run_ab
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="nmz-ab-guided-")
+    try:
+        rep = run_ab(workdir, seed=args.seed, runs=args.runs,
+                     min_ratio=args.min_ratio, example=args.example)
+    except ValueError as e:  # e.g. a typo'd example path — loud, not
+        print(f"error: {e}", file=sys.stderr)  # a silent synthetic run
+        return 2
+    for name in ("blind", "guided"):
+        arm = rep["arms"][name]
+        ttff = arm["time_to_first_failure_run"]
+        print(f"{name:>7}: {arm['relation_bits']} relation bits, "
+              f"{arm['unique_digests']} digests, "
+              f"{arm['repros']} repro(s), "
+              f"ttff {'-' if ttff is None else f'run {ttff}'}")
+    print(f"coverage ratio {rep['coverage_ratio']}x "
+          f"(need >= {rep['min_ratio']}): "
+          f"{'OK' if rep['coverage_ratio_ok'] else 'FAIL'}; "
+          f"curve dominance {rep['curve_dominance']}: "
+          f"{'OK' if rep['curve_dominance_ok'] else 'FAIL'}; "
+          f"ttff: {'OK' if rep['ttff_ok'] else 'FAIL'}")
+    line = json.dumps(rep, sort_keys=True)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0 if rep["ok"] else 1
 
 
 def knowledge_stats(args) -> int:

@@ -1,0 +1,48 @@
+"""Guidance plane: causality-guided search (doc/search.md).
+
+PR 10's causality plane made per-run happens-before structure
+observable; this package makes it the search OBJECTIVE. The loop:
+
+* :mod:`namazu_tpu.guidance.signature` — derive from each run a
+  compact **relation-coverage signature**: which occurrence-indexed
+  (hint-bucket, hint-bucket) ordering relations the run exercised,
+  hashed into a fixed-width bitmap. Pure function of the recorded
+  run — deterministic, wall-clock-free.
+* :mod:`namazu_tpu.guidance.coverage` — the per-campaign
+  :class:`CoverageMap`: novelty accounting (a run is interesting when
+  it first-covers or FLIPS a relation, not merely when its digest is
+  new), candidate-order gain prediction, one-sided-relation frontier,
+  and the per-bucket mutation bias.
+* :mod:`namazu_tpu.guidance.ab` — the seeded guided-vs-blind A/B
+  acceptance driver (``nmz-tpu tools ab-guided``, the tier-1 smoke).
+
+Integration points: ``models/search.py`` (coverage-guided candidate
+pick + biased mutation through ``models/ga.py``/``parallel/islands``),
+``models/ingest.py`` (map rebuild from history + knowledge-plane
+coverage push/pull), ``obs/analytics.py`` (the relation-coverage curve
+next to the digest curve), ``nmz-tpu tools coverage``.
+"""
+
+from __future__ import annotations
+
+from namazu_tpu.guidance.coverage import (  # noqa: F401
+    CoverageDelta,
+    CoverageMap,
+    MAX_PAIRS,
+)
+from namazu_tpu.guidance.signature import (  # noqa: F401
+    DEFAULT_WIDTH,
+    DEFAULT_WINDOW,
+    GUIDANCE_DIMS,
+    SCAN_CAP,
+    bucket_sequence_from_docs,
+    bucket_sequence_from_encoded,
+    bucket_sequence_from_trace,
+    dag_shape_features,
+    hint_bucket,
+    occurrence_index,
+    pair_bit,
+    relation_pairs,
+    reverse_signature_bits,
+    signature_bits,
+)

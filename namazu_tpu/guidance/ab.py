@@ -1,0 +1,387 @@
+"""Seeded guided-vs-blind A/B: the guidance plane's acceptance driver.
+
+``nmz-tpu tools ab-guided`` (and the tier-1 "Guidance A/B smoke") runs
+two campaigns of equal run budget over ONE deterministic workload —
+the same event schedule, the same per-run arrival jitter, the same
+mutation kernel — differing ONLY in what guides them:
+
+* **blind** — the pre-guidance loop: mutate uniformly-chosen delay
+  buckets, keep a candidate when its realized interleaving has a new
+  ``trace_digest`` (digest novelty, the old coverage currency);
+* **guided** — the causality-guided loop: mutation buckets sampled
+  from the CoverageMap's bias (one-sided relations first), candidates
+  chosen by predicted relation-coverage gain, every executed run
+  observed back into the map (observe -> score -> mutate, closed).
+
+Both arms' runs are recorded into REAL storages (actions with hints,
+arrivals, and realized release stamps), so the acceptance claims are
+checked on the same surfaces operators use: the arms' relation-
+coverage curves come straight out of ``obs/analytics.py`` — the exact
+``GET /analytics`` payload — not from driver-private accounting.
+
+The workload's oracle is a relation bug: it "reproduces" exactly when
+one specific ordering relation flips against its arrival order — the
+regime PCT-style ordering-aware search exists for. The acceptance
+criteria (doc/search.md):
+
+* the guided arm reaches >= ``min_ratio`` (default 1.25x) the blind
+  arm's relation coverage at equal run budget;
+* the guided arm's time-to-first-failure is no worse;
+* the guided arm's relation-coverage curve DOMINATES the blind arm's
+  (cumulative coverage >= the blind arm's at >= 95% of run indices —
+  a whole-curve statistic, robust where any single saturation index
+  is run-to-run noise).
+
+Everything derives from the seed — a red run is a deterministic repro.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from namazu_tpu.guidance.coverage import CoverageMap
+from namazu_tpu.guidance.signature import hint_bucket
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("guidance.ab")
+
+#: workload shape: EVENTS slots round-robin over ENTITIES entities and
+#: IDENTITIES distinct hints; arrivals GAP_S apart with JITTER_S of
+#: seeded per-run noise. Delay tables live in [0, MAX_DELAY_S].
+ENTITIES = 2
+IDENTITIES = 12
+GAP_S = 0.010
+JITTER_S = 0.002
+MAX_DELAY_S = 0.100
+#: mutation kernel (shared verbatim by both arms)
+MUTATE_BUCKETS = 3
+MUTATE_SIGMA = 0.025
+#: candidate fan per run slot (the blind arm gets the same number of
+#: DRAWS but no simulator to rank them with — it executes its first
+#: digest-novel candidate, the pre-guidance acceptance rule)
+CANDIDATES = 6
+#: guided mutation-bias peak (CoverageMap.mutation_bias max_boost) —
+#: the hottest one-sided bucket mutates this many times as often
+BIAS_BOOST = 8.0
+
+
+def _schedule(events: int) -> List[Tuple[str, str]]:
+    return [(f"e{i % ENTITIES}", f"k{i % IDENTITIES:02d}")
+            for i in range(events)]
+
+
+def _arrivals(rng: np.random.Generator, events: int) -> np.ndarray:
+    base = np.arange(events, dtype=np.float64) * GAP_S
+    return base + rng.uniform(0.0, JITTER_S, size=events)
+
+
+def _oracle_pair(schedule, H: int) -> Tuple[int, int, float,
+                                            Tuple[str, str]]:
+    """The workload's planted relation bug: pick two identities whose
+    first occurrences arrive ~6 slots apart and hash to distinct
+    buckets (so a delay table CAN separate them) — the bug fires when
+    the later identity's first event is dispatched before the earlier
+    one's (a genuine ordering flip a blind delay walk rarely
+    produces). Returns the two identities' first SCHEDULE SLOTS (the
+    oracle checks those exact events' dispatch ranks — keying on
+    buckets would let an unrelated colliding identity satisfy it),
+    the natural arrival gap, and the hints for the report."""
+    first_pos: Dict[str, int] = {}
+    for i, (_e, hint) in enumerate(schedule):
+        first_pos.setdefault(hint, i)
+    hints = sorted(first_pos, key=lambda h: first_pos[h])
+    a = hints[1]
+    b = hints[min(len(hints) - 1, 7)]
+    if hint_bucket(a, H) == hint_bucket(b, H):
+        # same-bucket pair: a delay table cannot separate them — slide
+        for h in hints[2:]:
+            if hint_bucket(h, H) != hint_bucket(a, H) \
+                    and first_pos[h] > first_pos[a]:
+                b = h
+                break
+    gap = (first_pos[b] - first_pos[a]) * GAP_S
+    return first_pos[a], first_pos[b], gap, (a, b)
+
+
+class _Arm:
+    """One campaign arm: current table + per-run realization loop."""
+
+    def __init__(self, name: str, H: int, width: int,
+                 window: int) -> None:
+        self.name = name
+        self.table = np.zeros((H,), np.float32)
+        self.H = H
+        # the MEASUREMENT map: both arms are scored in this space; only
+        # the guided arm also READS it (bias + gain)
+        self.coverage = CoverageMap(H=H, width=width, window=window)
+        self.seen_digests: set = set()
+        self.bits_curve: List[int] = []
+        self.repro_runs: List[int] = []
+        self.runs = 0
+
+    def realize(self, buckets: np.ndarray,
+                arrivals: np.ndarray,
+                table: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(dispatch order permutation, realized times) under the
+        delay-mode release rule."""
+        times = arrivals + table[buckets]
+        order = np.argsort(times, kind="stable")
+        return order, times
+
+
+def _mutate(table: np.ndarray, picks: np.ndarray,
+            noise: np.ndarray) -> np.ndarray:
+    out = table.copy()
+    out[picks] = np.clip(out[picks] + noise, 0.0, MAX_DELAY_S)
+    return out
+
+
+def run_ab(workdir: str, seed: int = 7, runs: int = 72,
+           events: int = 24, H: int = 64, width: int = 2048,
+           window: int = 8, min_ratio: float = 1.25,
+           example: str = "") -> Dict[str, Any]:
+    """Run the guided-vs-blind pair; returns the acceptance report.
+
+    ``example`` (optional, e.g. ``examples/flaky-init``) seeds the
+    workload identity space from the example's config — the A/B then
+    measures guidance over that experiment's hint population instead
+    of the synthetic default."""
+    schedule = _schedule(events)
+    if example:
+        from_example = _example_schedule(example, events)
+        if from_example is None:
+            # loud, not a silent synthetic fallback: a typo'd example
+            # path must not green-light as if it validated the example
+            raise ValueError(
+                f"example {example!r} has no loadable config.toml")
+        schedule = from_example
+    buckets = np.asarray([hint_bucket(h, H) for _e, h in schedule],
+                         np.int64)
+    entities = [e for e, _h in schedule]
+    hints = [h for _e, h in schedule]
+    slot_a, slot_b, gap, oracle_hints = _oracle_pair(schedule, H)
+
+    arms = {
+        "blind": _Arm("blind", H, width, window),
+        "guided": _Arm("guided", H, width, window),
+    }
+    for arm in arms.values():
+        st = _new_storage(os.path.join(workdir, arm.name))
+        for r in range(runs):
+            # identical per-run arrival realization for both arms:
+            # the rng is keyed by (seed, run), not by arm
+            arr_rng = np.random.default_rng([seed, r])
+            arrivals = _arrivals(arr_rng, len(schedule))
+            mut_rng = np.random.default_rng(
+                [seed, r, 1 if arm.name == "guided" else 0])
+            candidate = _next_candidate(arm, buckets, arrivals, mut_rng)
+            order, times = arm.realize(buckets, arrivals, candidate)
+            seq = buckets[order]
+            # oracle: did the planted relation flip? Checked on the
+            # two chosen identities' EXACT schedule slots (their
+            # dispatch ranks), immune to other identities sharing a
+            # bucket with them
+            rank = np.empty((len(order),), np.int64)
+            rank[order] = np.arange(len(order))
+            reproduced = bool(rank[slot_b] < rank[slot_a])
+            arm.table = candidate
+            arm.seen_digests.add(tuple(int(b) for b in seq))
+            arm.coverage.observe(seq)
+            arm.bits_curve.append(arm.coverage.covered())
+            arm.runs += 1
+            if reproduced:
+                arm.repro_runs.append(r)
+            _record_run(st, entities, hints, arrivals, times,
+                        ok=not reproduced)
+        st.close()
+
+    report = _report(arms, workdir, runs, min_ratio, seed,
+                     oracle={"early": oracle_hints[0],
+                             "late": oracle_hints[1],
+                             "arrival_gap_s": round(gap, 4)})
+    return report
+
+
+def _next_candidate(arm: _Arm, buckets: np.ndarray,
+                    arrivals: np.ndarray,
+                    rng: np.random.Generator) -> np.ndarray:
+    """One run slot's executed table. Both arms draw CANDIDATES times
+    from the SAME kernel; they differ in bucket choice (uniform vs
+    bias-weighted) and in the acceptance rule (first digest-novel vs
+    best predicted relation gain)."""
+    H = arm.H
+    if arm.name == "blind":
+        # pre-guidance loop: execute the first candidate whose
+        # simulated interleaving has a new digest (else the last draw)
+        chosen = arm.table
+        for _ in range(CANDIDATES):
+            picks = rng.integers(0, H, size=MUTATE_BUCKETS)
+            noise = rng.normal(0.0, MUTATE_SIGMA, size=MUTATE_BUCKETS)
+            cand = _mutate(arm.table, picks, noise)
+            order, _ = arm.realize(buckets, arrivals, cand)
+            chosen = cand
+            if tuple(int(b) for b in buckets[order]) \
+                    not in arm.seen_digests:
+                break
+        return chosen
+    # guided loop: bias-weighted bucket choice, gain-ranked acceptance
+    bias = arm.coverage.mutation_bias(max_boost=BIAS_BOOST)
+    p = bias / bias.sum()
+    best, best_gain = arm.table, -1.0
+    for _ in range(CANDIDATES):
+        picks = rng.choice(H, size=MUTATE_BUCKETS, p=p)
+        noise = rng.normal(0.0, MUTATE_SIGMA, size=MUTATE_BUCKETS)
+        cand = _mutate(arm.table, picks, noise)
+        order, _ = arm.realize(buckets, arrivals, cand)
+        gain = arm.coverage.predicted_gain(buckets[order])
+        if gain > best_gain:
+            best, best_gain = cand, gain
+    return best
+
+
+# -- real-surface recording + the report -----------------------------------
+
+def _new_storage(path: str):
+    from namazu_tpu.storage import new_storage
+
+    st = new_storage("naive", path)
+    st.create()
+    return st
+
+
+def _record_run(st, entities, hints, arrivals, times, ok: bool) -> None:
+    """One simulated run recorded the way a real run is: actions with
+    hints, arrivals, realized release stamps — so analytics computes
+    the arm's curves from the same storage surface a live campaign
+    produces.
+
+    Actions are appended in PROGRAM order (the workload's fixed event
+    schedule), with the realized ordering carried by the release
+    stamps. The ``trace_digest`` is deliberately timing-invariant over
+    the appended hint/entity sequence (PR 1: it counts failure MODES),
+    so on the A/B artifact the digest curve saturates immediately —
+    the mode space of a fixed program is one mode — while the relation
+    curve keeps growing with every newly realized ordering. That is
+    the decoupling the guidance plane exists to expose: digest
+    coverage reads "done" exactly where ordering exploration has
+    barely started."""
+    from namazu_tpu.signal import PacketEvent
+    from namazu_tpu.signal.action import EventAcceptanceAction
+    from namazu_tpu.utils.trace import SingleTrace
+
+    st.create_new_working_dir()
+    trace = SingleTrace()
+    base = 1000.0
+    for i in range(len(hints)):
+        ev = PacketEvent.create(entities[i], entities[i], "peer",
+                                hint=hints[i])
+        a = EventAcceptanceAction.for_event(ev)
+        a.event_arrived = base + float(arrivals[i])
+        a.triggered_time = base + float(times[i])
+        trace.append(a)
+    st.record_new_trace(trace)
+    st.record_result(ok, GAP_S * len(hints))
+
+
+def _curve_last_growth(curve: List[int]) -> int:
+    """Index of the last run that grew the curve (-1 for an empty or
+    flat curve) — "saturates later" = a larger value."""
+    last = -1
+    prev = 0
+    for i, v in enumerate(curve):
+        if v > prev:
+            last = i
+        prev = v
+    return last
+
+
+def _analytics_payload(storage_dir: str) -> Optional[Dict[str, Any]]:
+    from namazu_tpu.obs import analytics
+    from namazu_tpu.storage import load_storage
+
+    st = load_storage(storage_dir)
+    try:
+        return analytics.compute_payload(storage=st, publish=False)
+    finally:
+        st.close()
+
+
+def _report(arms, workdir, runs, min_ratio, seed, oracle) -> Dict[str, Any]:
+    out: Dict[str, Any] = {
+        "schema": "nmz-guidance-ab-v1",
+        "seed": seed,
+        "runs_per_arm": runs,
+        "min_ratio": min_ratio,
+        "oracle": oracle,
+        "arms": {},
+    }
+    for name, arm in arms.items():
+        payload = _analytics_payload(os.path.join(workdir, name))
+        cov = (payload or {}).get("coverage", {})
+        out["arms"][name] = {
+            "relation_bits": arm.coverage.covered(),
+            "relation_occupancy": round(arm.coverage.occupancy(), 4),
+            "bits_curve": arm.bits_curve,
+            "curve_last_growth_run": _curve_last_growth(arm.bits_curve),
+            "one_sided": arm.coverage.one_sided_count(),
+            "unique_digests": len(arm.seen_digests),
+            "repros": len(arm.repro_runs),
+            "time_to_first_failure_run": (arm.repro_runs[0]
+                                          if arm.repro_runs else None),
+            "analytics_coverage": {
+                k: cov.get(k)
+                for k in ("unique_interleavings", "saturated",
+                          "relation_bits", "relation_curve",
+                          "relation_saturated",
+                          "relation_frontier_bits",
+                          "digests_saturated_relations_growing")
+            },
+        }
+    blind, guided = out["arms"]["blind"], out["arms"]["guided"]
+    ratio = (guided["relation_bits"] / blind["relation_bits"]
+             if blind["relation_bits"] else float("inf"))
+    ttff_b = blind["time_to_first_failure_run"]
+    ttff_g = guided["time_to_first_failure_run"]
+    # "no worse": found at least as early, or the blind arm never found
+    # it at all (None sorts as worst)
+    ttff_ok = (ttff_b is None
+               or (ttff_g is not None and ttff_g <= ttff_b))
+    # curve dominance: at what fraction of the run budget the guided
+    # arm's cumulative relation coverage was >= the blind arm's. The
+    # acceptance asks for dominance, not one lucky endpoint — a single
+    # "last growth run" index is run-to-run noise; >= 95% of the whole
+    # curve is not.
+    ca, cb = blind["bits_curve"], guided["bits_curve"]
+    dominance = (sum(1 for x, y in zip(ca, cb) if y >= x)
+                 / len(ca) if ca else 0.0)
+    out["coverage_ratio"] = round(ratio, 3)
+    out["coverage_ratio_ok"] = ratio >= min_ratio
+    out["ttff_ok"] = ttff_ok
+    out["curve_dominance"] = round(dominance, 3)
+    out["curve_dominance_ok"] = dominance >= 0.95
+    out["ok"] = bool(out["coverage_ratio_ok"] and ttff_ok
+                     and out["curve_dominance_ok"])
+    return out
+
+
+def _example_schedule(example: str,
+                      events: int) -> Optional[List[Tuple[str, str]]]:
+    """Derive the identity space from an example's config (best
+    effort): the policy's seed + proc-policy shape vary the hint
+    population so the A/B exercises that experiment's bucket layout."""
+    from namazu_tpu.utils.config import Config
+
+    cfg_path = os.path.join(example, "config.toml")
+    if not os.path.exists(cfg_path):
+        return None
+    try:
+        cfg = Config.from_file(cfg_path)
+    except Exception:
+        return None
+    name = os.path.basename(os.path.abspath(example))
+    policy = str(cfg.get("explore_policy") or "random")
+    return [(f"e{i % ENTITIES}", f"{name}:{policy}:k{i % IDENTITIES:02d}")
+            for i in range(events)]

@@ -37,6 +37,12 @@ from namazu_tpu.utils.log import get_logger
 
 log = get_logger("knowledge.client")
 
+#: knowledge wire version, single-sourced here (the service's VERSION
+#: re-exports it): v2 = v1 + the relation-coverage fields
+#: (doc/knowledge.md). The client stamps every frame with it, so
+#: version-gating logic sees what the peer actually speaks.
+WIRE_VERSION = 2
+
 
 def pairs_fingerprint(pairs) -> str:
     """Content fingerprint of a search's precedence-pair sample.
@@ -135,7 +141,7 @@ class KnowledgeClient:
     def _request(self, req: dict) -> Optional[dict]:
         """Send one knowledge op; ``None`` = degraded (outage or the
         service refused the op). Never raises."""
-        req = dict(req, v=1, tenant=self.tenant,
+        req = dict(req, v=WIRE_VERSION, tenant=self.tenant,
                    scenario=req.get("scenario", self.scenario))
         if obs.metrics.enabled():
             # causality plane (obs/context.py): stamp the op frame so
@@ -190,15 +196,20 @@ class KnowledgeClient:
     def push(self, entries: Sequence[dict] = (),
              best: Optional[dict] = None,
              examples: Sequence[dict] = (),
-             pairs_fp: str = "") -> Optional[dict]:
+             pairs_fp: str = "",
+             coverage: Optional[dict] = None) -> Optional[dict]:
         """Stream failure signatures / a best table / labeled surrogate
-        examples to the service; returns its response or ``None`` when
+        examples / a relation-coverage signature (guidance plane, wire
+        v2) to the service; returns its response or ``None`` when
         degraded."""
-        if not entries and best is None and not examples:
+        if not entries and best is None and not examples \
+                and coverage is None:
             return {"ok": True, "accepted": 0, "duplicates": 0}
         req: Dict = {"op": "pool_push", "entries": list(entries)}
         if best is not None:
             req["best"] = best
+        if coverage is not None:
+            req["coverage"] = coverage
         if examples:
             req["examples"] = list(examples)
             req["pairs_fp"] = pairs_fp
@@ -209,14 +220,22 @@ class KnowledgeClient:
         return resp
 
     def pull(self, H: int, exclude: Sequence[str] = (),
-             max_entries: int = MAX_LOAD
-             ) -> Optional[Tuple[List[PoolEntry], Optional[dict]]]:
+             max_entries: int = MAX_LOAD,
+             coverage_space: Optional[dict] = None
+             ) -> Optional[Tuple]:
         """Warm-start material: ``(pool entries, scenario table)`` —
         ``None`` when degraded (distinct from ``([], None)``, a healthy
-        but empty service)."""
-        resp = self._request({"op": "pool_pull", "H": int(H),
-                              "exclude": list(exclude),
-                              "max_entries": int(max_entries)})
+        but empty service). With ``coverage_space`` (``{"H", "w",
+        "win"}``, wire v2) the SAME round trip also fetches the
+        scenario's pooled relation-coverage bits and the return grows a
+        third element (the bit list; ``[]`` when nothing pooled for
+        that exact space or the service predates v2)."""
+        req = {"op": "pool_pull", "H": int(H),
+               "exclude": list(exclude),
+               "max_entries": int(max_entries)}
+        if coverage_space is not None:
+            req["coverage_space"] = dict(coverage_space)
+        resp = self._request(req)
         if resp is None:
             obs.knowledge_pull(False)
             return None
@@ -233,13 +252,40 @@ class KnowledgeClient:
                              "fitness": float(table["fitness"])}
             except (KeyError, TypeError, ValueError):
                 table = None
-        return entries, table
+        if coverage_space is None:
+            return entries, table
+        cov = resp.get("coverage")
+        bits: List[int] = []
+        if isinstance(cov, dict):
+            try:
+                bits = [int(b) for b in cov.get("bits", [])]
+            except (TypeError, ValueError):
+                bits = []
+        return entries, table, bits
 
     def scenario_table(self, H: int) -> Optional[dict]:
         """Just the scenario's best delay table (a cheap pull with no
         entries) — the cold-run hot-path warm-start."""
         pulled = self.pull(H, max_entries=0)
         return pulled[1] if pulled is not None else None
+
+    def pull_coverage(self, H: int, width: int,
+                      window: int) -> Optional[List[int]]:
+        """Just the scenario's pooled relation-coverage bits (guidance
+        plane, wire v2) for EXACTLY this (H, width, window) space —
+        ``None`` when degraded (outage). An empty list is a healthy
+        answer: nothing pooled yet, a pre-v2 service, or a pooled
+        space that differs (bit indices don't translate — there is
+        nothing safe to merge either way). ``window`` is required
+        because serving is an exact-space lookup: a guessable default
+        (0) would silently query a space no campaign pushes to.
+        Ingest piggybacks the coverage on its entry pull instead (one
+        round trip)."""
+        pulled = self.pull(0, max_entries=0,
+                           coverage_space={"H": int(H),
+                                           "w": int(width),
+                                           "win": int(window)})
+        return pulled[2] if pulled is not None else None
 
     def predict(self, feats: np.ndarray,
                 pairs_fp: str = "") -> Optional[np.ndarray]:

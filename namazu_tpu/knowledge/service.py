@@ -30,6 +30,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from namazu_tpu import obs
+from namazu_tpu.knowledge.client import WIRE_VERSION
 from namazu_tpu.models.failure_pool import (
     entry_from_jsonable,
     entry_to_jsonable,
@@ -132,7 +133,15 @@ class KnowledgeService:
     and tenants push/pull concurrently; one lock serializes state
     mutations (none of these ops are on an event hot path)."""
 
-    VERSION = 1
+    # v2: pool_push/pool_pull carry relation-coverage signatures
+    # (guidance plane, doc/search.md) — a per-(scenario, space)
+    # covered-bit set pooled by union, served back to warm-start a
+    # cold campaign's coverage frontier. v1 peers simply omit/ignore
+    # the new fields; nothing else about the framing changed. The
+    # version constant is single-sourced in knowledge/client.py so the
+    # frames the client stamps can never disagree with what the
+    # service declares.
+    VERSION = WIRE_VERSION
     OPS = ("pool_push", "pool_pull", "surrogate_predict", "stats")
 
     def __init__(self, pool_dir: str, state_dir: str = ""):
@@ -151,6 +160,14 @@ class KnowledgeService:
         self._tenants: Dict[str, Dict[str, Any]] = {}
         # scenario fingerprint -> {"delays", "fitness", "H", "updated_at"}
         self._scenarios: Dict[str, Dict[str, Any]] = {}
+        # "scenario@HxWxWIN" -> {"scenario", "H", "w", "win",
+        # "bits": set[int]} — the fleet's pooled relation coverage
+        # (guidance plane). Bits are only comparable within one
+        # (H, width, window) space, so the store is keyed by scenario
+        # AND space: mixed-width campaigns of one scenario accumulate
+        # side by side instead of wiping each other, and a pull is an
+        # exact-key lookup.
+        self._coverage: Dict[str, Dict[str, Any]] = {}
         # (scenario, pairs_fp, K) -> _SurrogateStore
         self._surrogates: Dict[Tuple[str, str, int], _SurrogateStore] = {}
         self._pushes = 0
@@ -182,15 +199,18 @@ class KnowledgeService:
     def _scenario_path(self) -> str:
         return os.path.join(self.state_dir, "scenarios.json")
 
+    def _coverage_path(self) -> str:
+        return os.path.join(self.state_dir, "coverage.json")
+
     def _store_path(self, key: Tuple[str, str, int]) -> str:
         sid = hashlib.sha256(
             f"{key[0]}|{key[1]}|{key[2]}".encode()).hexdigest()[:16]
         return os.path.join(self.state_dir, f"surrogate_{sid}.npz")
 
     def _load_state(self) -> None:
-        try:
-            import json
+        import json
 
+        try:
             with open(self._scenario_path()) as f:
                 self._scenarios = json.load(f)
         except FileNotFoundError:
@@ -198,6 +218,21 @@ class KnowledgeService:
         except Exception:
             log.exception("scenario table state unreadable; starting "
                           "with an empty table set")
+        try:
+            with open(self._coverage_path()) as f:
+                loaded = json.load(f)
+            self._coverage = {
+                key: {"scenario": str(c.get("scenario", key)),
+                      "H": int(c["H"]), "w": int(c["w"]),
+                      "win": int(c.get("win", 0)),
+                      "bits": {int(b) for b in c.get("bits", [])}}
+                for key, c in loaded.items()
+            }
+        except FileNotFoundError:
+            pass
+        except Exception:
+            log.exception("coverage state unreadable; starting with an "
+                          "empty coverage set")
 
     def _save_scenarios(self) -> None:
         try:
@@ -205,6 +240,22 @@ class KnowledgeService:
                               sort_keys=True)
         except OSError:
             log.exception("could not persist scenario tables")
+
+    def _save_coverage(self) -> None:
+        try:
+            atomic_write_json(
+                self._coverage_path(),
+                {key: {"scenario": c["scenario"], "H": c["H"],
+                       "w": c["w"], "win": c["win"],
+                       "bits": sorted(c["bits"])}
+                 for key, c in self._coverage.items()},
+                sort_keys=True)
+        except OSError:
+            log.exception("could not persist pooled coverage")
+
+    @staticmethod
+    def _coverage_key(scenario: str, h: int, w: int, win: int) -> str:
+        return f"{scenario}@{h}x{w}x{win}"
 
     def _save_store(self, key: Tuple[str, str, int], digests, feats,
                     labels) -> None:
@@ -316,6 +367,9 @@ class KnowledgeService:
         best = req.get("best")
         if best and scenario:
             self._install_best(scenario, best)
+        coverage = req.get("coverage")
+        if coverage and scenario:
+            self._merge_coverage(scenario, coverage)
         examples = req.get("examples") or []
         pairs_fp = str(req.get("pairs_fp") or "")
         deferred = []
@@ -349,6 +403,33 @@ class KnowledgeService:
             "updated_at": time.time(),
         }
         self._save_scenarios()
+
+    def _merge_coverage(self, scenario: str, coverage: dict) -> None:
+        """Union one campaign's relation-coverage bits into its
+        (scenario, space) pooled frontier (guidance plane). A malformed
+        push costs that push, never the stored state, and a push from a
+        different (H, width, window) space lands in its OWN store —
+        bits don't translate between spaces, and letting one space
+        replace another would wipe the fleet's accumulated frontier."""
+        try:
+            h = int(coverage["H"])
+            w = int(coverage["w"])
+            win = int(coverage.get("win", 0))
+            bits = {int(b) for b in coverage.get("bits", [])}
+        except (KeyError, TypeError, ValueError):
+            return
+        if w <= 0 or any(b < 0 or b >= w for b in bits):
+            return
+        key = self._coverage_key(scenario, h, w, win)
+        cur = self._coverage.get(key)
+        if cur is not None:
+            if bits <= cur["bits"]:
+                return  # nothing new: skip the persist
+            cur["bits"] |= bits
+        else:
+            self._coverage[key] = {"scenario": scenario, "H": h,
+                                   "w": w, "win": win, "bits": bits}
+        self._save_coverage()
 
     def _add_examples(self, scenario: str, pairs_fp: str,
                       examples: list) -> list:
@@ -419,8 +500,23 @@ class KnowledgeService:
         if cur is not None and (h <= 0 or cur.get("H") == h):
             table = {"delays": cur["delays"], "fitness": cur["fitness"],
                      "H": cur["H"]}
-        return {"ok": True, "entries": entries, "scenario_table": table,
+        resp = {"ok": True, "entries": entries, "scenario_table": table,
                 "pool_size": pool_size(self.pool_dir)}
+        space = req.get("coverage_space")
+        if isinstance(space, dict):
+            # v2 coverage warm-start: an exact (scenario, space) key
+            # lookup — bit indices mean nothing across spaces
+            try:
+                cov = self._coverage.get(self._coverage_key(
+                    scenario, int(space.get("H", 0)),
+                    int(space.get("w", 0)), int(space.get("win", 0))))
+            except (TypeError, ValueError):
+                cov = None
+            if cov is not None:
+                resp["coverage"] = {"H": cov["H"], "w": cov["w"],
+                                    "win": cov["win"],
+                                    "bits": sorted(cov["bits"])}
+        return resp
 
     def _surrogate_predict(self, req: dict) -> dict:
         """P(reproduce) for candidate schedule feature vectors, from the
@@ -471,6 +567,14 @@ class KnowledgeService:
             "pushes": self._pushes,
             "pulls": self._pulls,
             "dedupe_hits": self._dedupe_hits,
+            "coverage": {
+                key: {"scenario": c["scenario"], "H": c["H"],
+                      "w": c["w"],
+                      "covered_bits": len(c["bits"]),
+                      "occupancy": round(len(c["bits"]) / c["w"], 4)
+                      if c["w"] else 0.0}
+                for key, c in self._coverage.items()
+            },
             "surrogate": {
                 "stores": len(self._surrogates),
                 "examples": sum(len(s.examples)

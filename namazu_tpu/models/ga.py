@@ -59,18 +59,32 @@ def _uniform_crossover(key: jax.Array, a: jax.Array, b: jax.Array,
 
 
 def _mutate(key: jax.Array, x: jax.Array, sigma: float, rate: float,
-            lo: float, hi: float) -> jax.Array:
+            lo: float, hi: float, rate_scale=None) -> jax.Array:
+    """``rate_scale`` (f32[H], optional) multiplies the per-gene
+    mutation probability — the guidance plane's mutation bias
+    (doc/search.md): buckets participating in uncovered/one-sided
+    ordering relations mutate more often. ``None`` (and all-ones) is
+    bit-identical to the unbiased kernel: ``bernoulli(p)`` is
+    ``uniform < p`` either way, and the draw count is unchanged."""
     kn, km = jax.random.split(key)
     noise = jax.random.normal(kn, x.shape) * sigma
-    mask = jax.random.bernoulli(km, rate, x.shape)
+    p = rate if rate_scale is None \
+        else jnp.clip(rate * rate_scale, 0.0, 1.0)
+    mask = jax.random.bernoulli(km, p, x.shape)
     return jnp.clip(x + jnp.where(mask, noise, 0.0), lo, hi)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def ga_generation(key: jax.Array, pop: Population, fitness: jax.Array,
-                  cfg: GAConfig) -> Population:
+                  cfg: GAConfig, delay_bias=None) -> Population:
     """Evolve one generation. Elites (top elite_frac by fitness) survive
-    unchanged in the first slots; the rest are tournament offspring."""
+    unchanged in the first slots; the rest are tournament offspring.
+
+    ``delay_bias`` (f32[H], optional) scales the DELAY half's per-gene
+    mutation rate (clipped to [0, 1]) — coverage guidance concentrating
+    perturbation on the buckets whose relations are untested. The fault
+    half is untouched: fault flips change which events EXIST, not their
+    order, so ordering-coverage bias has nothing to say about them."""
     P, H = pop.delays.shape
     n_elite = max(1, int(P * cfg.elite_frac))
     ks = jax.random.split(key, 6)
@@ -84,7 +98,7 @@ def ga_generation(key: jax.Array, pop: Population, fitness: jax.Array,
     child_f = _uniform_crossover(ks[2], pop.faults[pa], pop.faults[pb],
                                  cfg.crossover_rate)
     child_d = _mutate(ks[3], child_d, cfg.mutation_sigma, cfg.mutation_rate,
-                      0.0, cfg.max_delay)
+                      0.0, cfg.max_delay, rate_scale=delay_bias)
     child_f = _mutate(ks[4], child_f, cfg.mutation_sigma * 0.5,
                       cfg.mutation_rate, 0.0, cfg.max_fault)
 

@@ -39,10 +39,17 @@ def _push_surrogate_examples(client, search, encoded) -> None:
 
     try:
         examples = []
-        for _enc, enc_rt, ok, _seed in encoded[-MAX_EXAMPLE_PUSH:]:
+        for enc, enc_rt, ok, _seed in encoded[-MAX_EXAMPLE_PUSH:]:
+            feats = search._feats_of(enc_rt)
+            if search.guidance_feats is not None:
+                # guided campaigns train on [precedence | DAG-shape];
+                # the widened K keys a separate service-side store, so
+                # the walling holds without any new wire field
+                feats = np.concatenate(
+                    [feats, search._guidance_feats_of(enc_rt, enc)])
             examples.append({
                 "digest": trace_digest(enc_rt),
-                "feats": [float(x) for x in search._feats_of(enc_rt)],
+                "feats": [float(x) for x in feats],
                 "label": 0.0 if ok else 1.0,
             })
         client.push(examples=examples,
@@ -79,6 +86,15 @@ class IngestParams(NamedTuple):
     knowledge: str = ""
     knowledge_tenant: str = ""
     knowledge_scenario: str = ""
+    # causality guidance (doc/search.md): rebuild the per-campaign
+    # relation CoverageMap from the stored history on every ingest (a
+    # pure function of the recorded runs — no extra persistence to
+    # corrupt), warm-start its frontier from the knowledge service's
+    # pooled coverage, and push the campaign's own bits back. 0 width/
+    # window = the guidance defaults.
+    guidance: bool = False
+    guidance_width: int = 0
+    guidance_window: int = 0
 
 
 def failure_seed(trace, H: int, max_interval: float):
@@ -127,6 +143,16 @@ def ingest_history(search, storage, p: IngestParams) -> List:
         n = storage.nr_stored_histories()
     except Exception:
         return []
+    # causality guidance: wire the map BEFORE any archive write so the
+    # DAG-shape feature fragments land slot-aligned with the archive.
+    # ``fresh``: every ingest re-feeds the WHOLE stored history, so the
+    # map rebuilds from scratch each time — a persistent (sidecar)
+    # search serving repeated requests must not double-observe
+    gmap = None
+    if p.guidance:
+        gmap = search.enable_guidance(p.guidance_width or None,
+                                      p.guidance_window or None,
+                                      fresh=True)
     encoded = []
     skipped_unstamped = 0
     for i in range(n):
@@ -214,9 +240,18 @@ def ingest_history(search, storage, p: IngestParams) -> List:
         if client is not None:
             client.push(entries=push_entries)  # None on outage: fine
             have = own | {e.digest for e in pooled}
-            remote = client.pull(p.H, exclude=have)
+            # the coverage-frontier warm-start piggybacks on the entry
+            # pull (one round trip): relations the FLEET already
+            # exercised are not this campaign's frontier. An outage
+            # returns None — local-only coverage, never a failed
+            # ingest (the cardinal knowledge rule).
+            space = (None if gmap is None
+                     else {"H": gmap.H, "w": gmap.width,
+                           "win": gmap.window})
+            remote = client.pull(p.H, exclude=have,
+                                 coverage_space=space)
             if remote is not None:
-                r_entries, _table = remote
+                r_entries, _table = remote[0], remote[1]
                 # the cold-run warm-start: fleet signatures this search
                 # has never seen are about to enter its archives
                 fresh = sum(
@@ -224,6 +259,9 @@ def ingest_history(search, storage, p: IngestParams) -> List:
                     if not search.has_failure_signature(e.digest))
                 obs.knowledge_warmstart("archive", fresh)
                 pooled = pooled + r_entries
+                if gmap is not None:
+                    obs.knowledge_warmstart(
+                        "coverage", gmap.merge_bits(remote[2]))
         if pooled:
             log.info("folding %d pooled failure signature(s) into the "
                      "search (pool %s%s)", len(pooled),
@@ -245,6 +283,17 @@ def ingest_history(search, storage, p: IngestParams) -> List:
     seeds = seeds[::-1] + [e.seed for e in pooled if e.seed is not None]
     if seeds:
         search.seed_population(seeds[: p.max_seed_genomes])
+    if gmap is not None:
+        # fold every known run's realized ordering into the coverage
+        # frontier — pooled entries too, and BEFORE the archive-dedupe
+        # skip below: a checkpoint-restored search may already hold a
+        # signature whose relations this (fresh) map has never seen
+        from namazu_tpu.guidance import bucket_sequence_from_encoded
+
+        for e in pooled:
+            gmap.observe(bucket_sequence_from_encoded(e.realized))
+        for _enc, enc_rt, _ok, _seed in encoded:
+            gmap.observe(bucket_sequence_from_encoded(enc_rt))
     for e in pooled:
         # same treatment as an in-storage failure: archive embedding
         # (novelty + surrogate positive) and failure-signature target —
@@ -256,18 +305,31 @@ def ingest_history(search, storage, p: IngestParams) -> List:
         # experiment — the storage's own must always survive a full pool
         if search.has_failure_signature(e.digest):
             continue
-        search.add_executed_trace(e.realized, reproduced=True)
+        search.add_executed_trace(e.realized, reproduced=True,
+                                  arrival=e.arrival)
         search.add_failure_trace(e.realized)
     failures, successes = [], []
     for enc, enc_rt, ok, _ in encoded:
         # "failure" = the run reproduced the bug (validate failed); the
         # label feeds the surrogate's training set
-        search.add_executed_trace(enc_rt, reproduced=not ok)
+        search.add_executed_trace(enc_rt, reproduced=not ok, arrival=enc)
         if not ok:
             search.add_failure_trace(enc_rt)
             failures.append(enc)
         else:
             successes.append(enc)
+    if gmap is not None:
+        scenario = p.knowledge_scenario or "local"
+        obs.relation_coverage(scenario, gmap.covered(), gmap.width,
+                              gmap.one_sided_count())
+        if client is not None:
+            # publish the campaign's frontier so the NEXT cold campaign
+            # of this scenario warm-starts past it; best-effort like
+            # every knowledge op
+            client.push(coverage={
+                "H": gmap.H, "w": gmap.width, "win": gmap.window,
+                "bits": gmap.bits_list(),
+            })
     if client is not None and encoded:
         _push_surrogate_examples(client, search, encoded)
     if p.reference_mode == "envelope" and successes:

@@ -49,6 +49,13 @@ class SearchConfig(NamedTuple):
     # exploitation. 0 disables (static weights).
     min_failure_signatures: int = 0
     novelty_floor: float = 0.25
+    # causality guidance (doc/search.md): weight of the predicted
+    # relation-coverage gain in the final candidate pick, added on top
+    # of the surrogate probability (or the normalized fitness when no
+    # surrogate has trained). Only consulted once a CoverageMap is
+    # wired via enable_guidance(); with none wired the search is
+    # bit-identical to pre-guidance behavior.
+    guidance_bonus: float = 0.5
 
 
 class BestSchedule(NamedTuple):
@@ -144,10 +151,84 @@ class SearchBase:
         # exact cold-start window cross-campaign knowledge exists for);
         # None / a None return degrades to the fitness argmax
         self.remote_surrogate = None
+        # causality guidance (doc/search.md): the per-campaign relation
+        # CoverageMap, wired by enable_guidance() (policy/sidecar, only
+        # when the guidance knob AND the obs plane are on). None = the
+        # exact pre-guidance blind search — no extra features, no bias,
+        # no bonus.
+        self.guidance = None
+        # per-archive-slot DAG-shape feature fragment (f32[size, G]),
+        # allocated with the map: the surrogate's feature space becomes
+        # [precedence K | guidance G] and the (scenario, pairs_fp, K')
+        # walling keeps it from ever pooling with unguided campaigns
+        self.guidance_feats = None
         # fault half of the genome is scored only when faults can be
         # non-zero; coin=None keeps the pre-config-4 jit cache entry
         self._coin = (te.fault_coin(cfg.seed, cfg.H)
                       if cfg.ga.max_fault > 0 else None)
+
+    # -- causality guidance (doc/search.md) -------------------------------
+
+    def enable_guidance(self, width: Optional[int] = None,
+                        window: Optional[int] = None,
+                        fresh: bool = False):
+        """Wire the relation-coverage map (idempotent; a changed bitmap
+        space rebuilds it — bit indices are only comparable within one
+        (H, width, window) space). ``fresh`` rebuilds unconditionally:
+        ingest passes it so the map stays a pure function of (stored
+        history + fleet coverage) per ingest — a sidecar-cached search
+        serving repeated requests must not double-observe the same
+        history into one accumulating map. Returns the map."""
+        from namazu_tpu.guidance import (
+            DEFAULT_WIDTH,
+            DEFAULT_WINDOW,
+            GUIDANCE_DIMS,
+            CoverageMap,
+        )
+
+        width = int(width or DEFAULT_WIDTH)
+        window = int(window or DEFAULT_WINDOW)
+        g = self.guidance
+        if (g is None or fresh or g.width != width
+                or g.window != window or g.H != self.cfg.H):
+            self.guidance = CoverageMap(H=self.cfg.H, width=width,
+                                        window=window)
+        if self.guidance_feats is None:
+            self.guidance_feats = np.zeros(
+                (self.cfg.archive_size, GUIDANCE_DIMS), np.float32)
+            # guidance wired onto a LIVE search (obs toggled on between
+            # rounds): the feature space just widened, so a surrogate
+            # trained at the old width and archive rows without aligned
+            # fragments are both stale — same contract as the
+            # checkpoint-restore width guard. The next ingest re-feeds
+            # the full history with fragments attached.
+            if getattr(self, "_surrogate", None) is not None:
+                self._surrogate = None
+            if self._archive_n > 0:
+                self.archive[:] = 0.5
+                self.archive_labels[:] = 0.0
+                self._archive_n = 0
+        return self.guidance
+
+    def _guidance_dims(self) -> int:
+        return (0 if self.guidance_feats is None
+                else self.guidance_feats.shape[1])
+
+    def _guidance_feats_of(self, realized: te.EncodedTrace,
+                           arrival: Optional[te.EncodedTrace]
+                           ) -> np.ndarray:
+        """DAG-shape fragment of one executed run: program order from
+        the arrival view, dispatch order from the realized release
+        times. Without an arrival view (legacy call sites) the realized
+        view anchors both — the ordering fragment is still exact, only
+        the crossing scalars degenerate to zero reordering."""
+        from namazu_tpu.guidance import dag_shape_features
+
+        src = arrival if arrival is not None else realized
+        m = realized.mask
+        return dag_shape_features(
+            realized.hint_ids[m], src.arrival[m], realized.arrival[m],
+            width=self.guidance.width, dims=self._guidance_dims())
 
     def set_occupied_buckets(self, occupied) -> None:
         """Refit the precedence-pair sample to the hint buckets actually
@@ -168,6 +249,8 @@ class SearchBase:
         self.pairs = new
         self.archive[:] = 0.5
         self.archive_labels[:] = 0.0
+        if self.guidance_feats is not None:
+            self.guidance_feats[:] = 0.0  # slot-aligned with archive
         self._archive_n = 0
         self.failures[:] = 0.5
         self._failure_n = 0
@@ -201,12 +284,19 @@ class SearchBase:
         ignore seeds."""
 
     def add_executed_trace(self, encoded: te.EncodedTrace,
-                           reproduced: bool = False) -> None:
+                           reproduced: bool = False,
+                           arrival: Optional[te.EncodedTrace] = None
+                           ) -> None:
         """Record an executed run's interleaving into the novelty archive,
-        labeled with whether it reproduced the bug (surrogate target)."""
+        labeled with whether it reproduced the bug (surrogate target).
+        ``arrival`` (the same run's arrival-anchored view) feeds the
+        guidance plane's DAG-shape features when guidance is wired."""
         slot = self._archive_n % self.cfg.archive_size
         self.archive[slot] = self._feats_of(encoded)
         self.archive_labels[slot] = 1.0 if reproduced else 0.0
+        if self.guidance_feats is not None:
+            self.guidance_feats[slot] = self._guidance_feats_of(
+                encoded, arrival)
         self._archive_n += 1
 
     def add_failure_trace(self, encoded: te.EncodedTrace) -> None:
@@ -265,11 +355,15 @@ class SearchBase:
         )
 
     def labeled_archive(self):
-        """(feats [N,K], labels [N]) of the populated archive slots whose
-        outcome is known (NaN labels — pre-surrogate checkpoints — are
-        excluded)."""
+        """(feats [N,K'], labels [N]) of the populated archive slots
+        whose outcome is known (NaN labels — pre-surrogate checkpoints —
+        are excluded). With guidance wired, K' = K + GUIDANCE_DIMS: the
+        DAG-shape fragment rides along, so the surrogate learns from
+        ordering SHAPE as well as precedence features."""
         n = min(self._archive_n, self.cfg.archive_size)
         feats, labels = self.archive[:n], self.archive_labels[:n]
+        if self.guidance_feats is not None:
+            feats = np.hstack([feats, self.guidance_feats[:n]])
         known = np.isfinite(labels)
         return feats[known], labels[known]
 
@@ -315,6 +409,8 @@ class SearchBase:
             "key": np.asarray(jax.random.key_data(self._key)),
             "generations_run": np.asarray(self.generations_run),
         }
+        if self.guidance_feats is not None:
+            flat["guidance_feats"] = self.guidance_feats
         flat.update(self._state_dict())
         tmp = path + ".tmp.npz"
         np.savez(tmp, **flat)
@@ -363,6 +459,22 @@ class SearchBase:
                 self.archive_labels = np.full(
                     (self.cfg.archive_size,), np.nan, np.float32)
             self._archive_n = int(z["archive_n"])
+            if self.guidance_feats is not None:
+                if "guidance_feats" in z \
+                        and z["guidance_feats"].shape \
+                        == self.guidance_feats.shape:
+                    self.guidance_feats = np.array(z["guidance_feats"])
+                else:
+                    # a pre-guidance (or differently-sized) checkpoint:
+                    # its archive rows have no aligned DAG-shape
+                    # fragment, and training a widened surrogate on
+                    # zero-filled fragments would teach it that shape
+                    # features mean nothing. Drop the archive — the
+                    # very next ingest re-feeds the full stored history
+                    # with fragments attached (models/ingest.py).
+                    self.archive[:] = 0.5
+                    self.archive_labels[:] = 0.0
+                    self._archive_n = 0
             self.failures = z["failures"]
             self._failure_n = int(z["failure_n"])
             if "failure_digests" in z:
@@ -485,12 +597,17 @@ class ScheduleSearch(SearchBase):
 
         coin = None if self._coin is None else jnp.asarray(self._coin)
         nov_scale = jnp.asarray(self.novelty_scale(), jnp.float32)
+        # guided mutation (doc/search.md): buckets participating in
+        # one-sided/uncovered ordering relations mutate more often —
+        # None (no map) keeps the unbiased kernel bit-for-bit
+        bias = (None if self.guidance is None
+                else jnp.asarray(self.guidance.mutation_bias()))
         state = self._state
         t0 = time.perf_counter()
         with obs.search_phase("evolve"):
             for _ in range(generations):
                 state = self._step(state, self._key, trace, pairs, archive,
-                                   failures, coin, nov_scale)
+                                   failures, coin, nov_scale, bias)
             state.best_fitness.block_until_ready()
         elapsed = time.perf_counter() - t0
         self._state = state
@@ -500,7 +617,7 @@ class ScheduleSearch(SearchBase):
                               float(state.best_fitness))
         with obs.search_phase("surrogate"):
             picked = self._surrogate_pick(trace, pairs, archive, failures,
-                                          nov_scale)
+                                          nov_scale, encs=_encs)
         if picked is not None:
             return picked
         with obs.search_phase("extract"):
@@ -550,6 +667,13 @@ class ScheduleSearch(SearchBase):
     #: decisive starve pattern that the argmax carried)
     MIN_CLASS_EXAMPLES = 3
 
+    def _surrogate_input_dims(self) -> int:
+        """Surrogate feature width: precedence K, plus the guidance
+        plane's DAG-shape fragment when a map is wired. The knowledge
+        service keys example stores by this width, so guided and
+        unguided campaigns can never pool training data."""
+        return self.cfg.K + self._guidance_dims()
+
     def _train_surrogate(self):
         """Fit the online MLP on the labeled archive; returns it, or None
         when surrogate use is off or either outcome class is still too
@@ -564,25 +688,59 @@ class ScheduleSearch(SearchBase):
         if self._surrogate is None:
             from namazu_tpu.models.surrogate import RewardSurrogate
 
-            self._surrogate = RewardSurrogate(K=self.cfg.K,
-                                              seed=self.cfg.seed)
+            self._surrogate = RewardSurrogate(
+                K=self._surrogate_input_dims(), seed=self.cfg.seed)
         self._surrogate.train(feats, labels, epochs=4,
                               seed=self.cfg.seed + self.generations_run)
         return self._surrogate
 
+    def _candidate_guidance(self, delays: np.ndarray, encs):
+        """Predicted relation-coverage gain + DAG-shape fragment per
+        candidate delay table, simulated against the most recent
+        reference trace under the delay-mode release rule
+        (``release = arrival + delays[bucket]`` — the same
+        counterfactual the scorer anchors on). Returns
+        ``(gains f32[k], frags f32[k, G])``."""
+        from namazu_tpu.guidance import dag_shape_features
+
+        enc = encs[0]
+        m = enc.mask
+        buckets = enc.hint_ids[m]
+        arrivals = enc.arrival[m]
+        k = delays.shape[0]
+        gains = np.zeros((k,), np.float32)
+        frags = np.zeros((k, self._guidance_dims()), np.float32)
+        for i in range(k):
+            times = arrivals + delays[i][buckets]
+            order = np.argsort(times, kind="stable")
+            gains[i] = self.guidance.predicted_gain(buckets[order])
+            frags[i] = dag_shape_features(
+                buckets, arrivals, times,
+                width=self.guidance.width, dims=self._guidance_dims())
+        return gains, frags
+
     def _surrogate_pick(self, trace, pairs, archive, failures,
-                        nov_scale=None) -> Optional[BestSchedule]:
-        """Re-rank the evolved population's fitness top-k by predicted
-        repro probability; return the winner (None = surrogate inactive).
-        The ranker is the local online MLP once it has enough of both
-        outcome classes; before that — the cold-start window — the
-        shared knowledge-service surrogate (``remote_surrogate``) ranks
-        instead, when one is wired and trained. Either path degrading
-        returns None and the caller falls back to the fitness argmax."""
+                        nov_scale=None, encs=()) -> Optional[BestSchedule]:
+        """Re-rank the evolved population's fitness top-k; return the
+        winner (None = nothing to re-rank with — fitness argmax).
+
+        The base score is predicted P(reproduce): the local online MLP
+        once it has enough of both outcome classes, before that — the
+        cold-start window — the shared knowledge-service surrogate
+        (``remote_surrogate``), and with neither trained, the top-k's
+        min-max-normalized fitness. With a guidance map wired
+        (doc/search.md) the pick becomes COVERAGE-GUIDED:
+        ``cfg.guidance_bonus`` times each candidate's predicted
+        relation-coverage gain is added on top, so among comparably
+        promising schedules the one predicted to exercise untested
+        orderings wins the next wall-clock replay. Without a map the
+        behavior is exactly the pre-guidance surrogate re-rank."""
         surrogate = self._train_surrogate()
         remote = self.remote_surrogate if surrogate is None else None
-        if surrogate is None and (remote is None
-                                  or self.cfg.surrogate_topk <= 0):
+        guided = self.guidance is not None and len(encs) > 0
+        if self.cfg.surrogate_topk <= 0:
+            return None  # explicit knob: raw fitness argmax only
+        if surrogate is None and remote is None and not guided:
             return None
         import jax.numpy as jnp
 
@@ -603,14 +761,25 @@ class ScheduleSearch(SearchBase):
         top = np.asarray(jnp.argsort(-fitness)[:k])
         # features averaged over the reference traces, like the fitness
         cand_feats = np.asarray(feats[top].mean(axis=1))
-        if surrogate is not None:
-            order, _probs = surrogate.rerank(cand_feats, top=1)
-            winner = int(top[order[0]])
-        else:
-            probs = remote(cand_feats)
-            if probs is None:  # outage/untrained: keep the argmax
-                return None
-            winner = int(top[int(np.argmax(probs))])
+        gains = frags = None
+        if guided:
+            gains, frags = self._candidate_guidance(delays_np[top], encs)
+        base = None
+        if surrogate is not None or remote is not None:
+            full = (cand_feats if frags is None
+                    else np.hstack([cand_feats, frags]))
+            base = (surrogate.predict(full) if surrogate is not None
+                    else remote(full))
+        if base is None:
+            if gains is None:
+                return None  # outage/untrained, no guidance: argmax
+            f = np.asarray(fitness)[top]
+            span = float(f.max() - f.min())
+            base = ((f - f.min()) / span if span > 0
+                    else np.zeros_like(f))
+        score = (np.asarray(base) if gains is None
+                 else np.asarray(base) + self.cfg.guidance_bonus * gains)
+        winner = int(top[int(np.argmax(score))])
         return BestSchedule(
             delays=np.asarray(delays[winner]),
             faults=faults[winner],
@@ -666,12 +835,20 @@ class ScheduleSearch(SearchBase):
 
             # deterministic re-init yields the unravel structure; the
             # optimizer restarts (momentum is not worth persisting)
-            self._surrogate = RewardSurrogate(K=self.cfg.K,
-                                              seed=self.cfg.seed)
-            _, unravel = ravel_pytree(self._surrogate.state.params)
-            self._surrogate.state = self._surrogate.state._replace(
-                params=unravel(jnp.asarray(z["surrogate_params"]))
-            )
+            self._surrogate = RewardSurrogate(
+                K=self._surrogate_input_dims(), seed=self.cfg.seed)
+            ref, unravel = ravel_pytree(self._surrogate.state.params)
+            saved = jnp.asarray(z["surrogate_params"])
+            if saved.shape == ref.shape:
+                self._surrogate.state = self._surrogate.state._replace(
+                    params=unravel(saved)
+                )
+            else:
+                # guidance was toggled since this checkpoint was
+                # written: the feature widths differ, so the persisted
+                # weights don't apply — retrain from the labeled
+                # archive instead of failing the whole load
+                self._surrogate = None
 
 
 class MCTSSearch(SearchBase):

@@ -102,6 +102,7 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     mark,
     policy_decision,
     queue_dwell,
+    relation_coverage,
     rest_ack,
     rest_request,
     sched_queue_depth,

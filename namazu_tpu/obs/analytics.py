@@ -52,7 +52,9 @@ log = get_logger("obs.analytics")
 
 __all__ = [
     "DEFAULT_TOP", "DEFAULT_WINDOW", "STALL_WINDOW", "STALL_REL_EPS",
+    "RELATION_H", "RELATION_WIDTH", "RELATION_WINDOW",
     "wilson_interval", "detect_stall", "trace_digest_of",
+    "relation_bits_of",
     "coverage_stats", "reproduction_stats", "entity_stats",
     "convergence_stats", "suspicious_branches", "compute_payload",
     "payload", "set_storage_dir", "storage_dir",
@@ -70,6 +72,16 @@ STALL_WINDOW = 8
 STALL_REL_EPS = 1e-3
 #: per-entity table rows kept before folding into "_other"
 MAX_ENTITY_ROWS = 16
+
+#: the analytics plane's relation-signature space (guidance plane,
+#: doc/search.md): a FIXED measurement space — hint buckets, bitmap
+#: width, pair window — independent of any one policy's configuration,
+#: so relation-coverage curves compare across campaigns. The search
+#: plane's live CoverageMap uses the policy's own H instead (actionable
+#: bias needs the genome's bucket space); both run the same derivation.
+RELATION_H = 256
+RELATION_WIDTH = 4096
+RELATION_WINDOW = 16
 
 
 # -- building blocks -------------------------------------------------------
@@ -120,6 +132,27 @@ def trace_digest_of(trace) -> str:
     return trace_digest(te.encode_trace(trace))
 
 
+def relation_bits_of(trace) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """One stored run's relation-coverage signature in the analytics
+    measurement space (guidance plane): ``(covered bits, reverse
+    bits)`` — the reverse bits are where each exercised relation's
+    FLIP would land, so the campaign-level difference reverse - covered
+    measures the open ordering frontier. Lazy import for the same
+    stdlib-importability reason as the digest."""
+    from namazu_tpu.guidance import (
+        bucket_sequence_from_trace,
+        reverse_signature_bits,
+        signature_bits,
+    )
+
+    seq = bucket_sequence_from_trace(trace, RELATION_H)
+    fwd = signature_bits(seq, width=RELATION_WIDTH,
+                         window=RELATION_WINDOW)
+    rev = reverse_signature_bits(seq, width=RELATION_WIDTH,
+                                 window=RELATION_WINDOW)
+    return (tuple(int(b) for b in fwd), tuple(int(b) for b in rev))
+
+
 # -- per-storage statistics ------------------------------------------------
 
 #: digest memo keyed by (storage dir, run index): a completed run's
@@ -148,6 +181,35 @@ def _run_digest(storage, i: int, trace) -> str:
     return digest
 
 
+#: relation-signature memo, same rationale as the digest memo (a
+#: completed run's trace is immutable); value = (covered, reverse).
+#: Its OWN, much smaller cap: one entry is two bit tuples (up to a few
+#: thousand ints — ~100x a digest string), so the digest cache's 65536
+#: ceiling would let a long-lived /analytics server grow unbounded in
+#: practice before ever clearing
+_relation_cache: Dict[Tuple[str, int],
+                      Tuple[Tuple[int, ...], Tuple[int, ...]]] = {}
+_RELATION_CACHE_MAX = 4096
+
+
+def _run_relation_bits(storage, i: int, trace
+                       ) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    key_dir = getattr(storage, "dir", None)
+    if key_dir is None:
+        return relation_bits_of(trace)
+    key = (key_dir, i)
+    with _digest_cache_lock:
+        hit = _relation_cache.get(key)
+    if hit is not None:
+        return hit
+    bits = relation_bits_of(trace)
+    with _digest_cache_lock:
+        if len(_relation_cache) >= _RELATION_CACHE_MAX:
+            _relation_cache.clear()
+        _relation_cache[key] = bits
+    return bits
+
+
 def _quarantined_count(storage) -> int:
     """How many of the storage's allocated run dirs are crash-
     quarantined (0 for backends without quarantine support)."""
@@ -158,9 +220,17 @@ def _quarantined_count(storage) -> int:
 
 
 def coverage_stats(storage, window: int = DEFAULT_WINDOW) -> Dict[str, Any]:
-    """Distinct-interleaving coverage of a storage's recorded runs."""
+    """Distinct-interleaving coverage of a storage's recorded runs —
+    two curves in one section: the classic unique-``trace_digest``
+    growth curve (whole interleavings) and the relation-coverage curve
+    (guidance plane: which ORDERING RELATIONS the runs exercised,
+    counted in the fixed analytics measurement space). The regime the
+    guidance plane exists for is digests saturating while relations
+    still grow: the schedule source keeps producing "new" runs whose
+    orderings are all old news — flagged explicitly."""
     n = storage.nr_stored_histories()
     digests: List[str] = []
+    run_bits: List[Tuple[int, ...]] = []
     missing = 0
     # counted over ALL allocated run dirs (a quarantined run past the
     # last completed one is outside nr_stored_histories' range)
@@ -179,7 +249,11 @@ def coverage_stats(storage, window: int = DEFAULT_WINDOW) -> Dict[str, Any]:
             missing += 1  # crashed run: no trace.json on disk
             continue
         try:
-            digests.append(_run_digest(storage, i, trace))
+            # both derivations BEFORE either append: a failure in the
+            # second must exclude the run from every count, not leave
+            # it half-counted with the two curves desynced
+            digest = _run_digest(storage, i, trace)
+            bits = _run_relation_bits(storage, i, trace)
         except Exception:
             # an environment problem (featurizer import, numpy), NOT
             # empty data — report it as its own bucket so a broken
@@ -188,6 +262,9 @@ def coverage_stats(storage, window: int = DEFAULT_WINDOW) -> Dict[str, Any]:
                 log.exception("trace digest failed for run %d; coverage "
                               "will undercount", i)
             digest_errors += 1
+            continue
+        digests.append(digest)
+        run_bits.append(bits)
     seen: set = set()
     curve: List[int] = []
     for d in digests:
@@ -201,6 +278,28 @@ def coverage_stats(storage, window: int = DEFAULT_WINDOW) -> Dict[str, Any]:
         novelty.append(round(fresh / len(chunk), 3))
         prior.update(chunk)
     unique = len(seen)
+    # relation-coverage curve: cumulative covered bits, and per window
+    # the fraction of runs that FIRST-COVERED at least one relation —
+    # the guidance plane's novelty rule (coverage.py), mirrored here.
+    # Reverse bits accumulate in parallel: their uncovered remainder is
+    # the campaign's open ordering frontier (relations exercised in one
+    # direction whose flip was never seen).
+    rel_seen: set = set()
+    rev_seen: set = set()
+    rel_curve: List[int] = []
+    rel_added: List[bool] = []
+    for fwd, rev in run_bits:
+        rel_added.append(any(b not in rel_seen for b in fwd))
+        rel_seen.update(fwd)
+        rev_seen.update(rev)
+        rel_curve.append(len(rel_seen))
+    rel_novelty: List[float] = []
+    for start in range(0, len(rel_added), window):
+        chunk = rel_added[start:start + window]
+        rel_novelty.append(round(sum(chunk) / len(chunk), 3))
+    rel_saturated = len(rel_novelty) >= 2 and rel_novelty[-1] == 0.0
+    frontier = len(rev_seen - rel_seen)
+    saturated = len(novelty) >= 2 and novelty[-1] == 0.0
     return {
         "runs": len(digests),
         "runs_without_trace": missing,
@@ -211,7 +310,25 @@ def coverage_stats(storage, window: int = DEFAULT_WINDOW) -> Dict[str, Any]:
         "curve": curve,
         "window": window,
         "novelty_per_window": novelty,
-        "saturated": len(novelty) >= 2 and novelty[-1] == 0.0,
+        "saturated": saturated,
+        "relation_width": RELATION_WIDTH,
+        "relation_bits": len(rel_seen),
+        "relation_coverage": round(len(rel_seen) / RELATION_WIDTH, 4),
+        "relation_curve": rel_curve,
+        "relation_novelty_per_window": rel_novelty,
+        "relation_saturated": rel_saturated,
+        # relations exercised in one direction whose flip was never
+        # observed — where relation coverage can still grow even after
+        # every digest window reads stale
+        "relation_frontier_bits": frontier,
+        # the motivating regime (doc/search.md): digest novelty reads
+        # saturated — the schedule source is replaying known
+        # interleavings — while the ordering frontier is still open
+        # (either relations grew in the last window, or one-sided
+        # relations remain to flip). Exactly when digest-guided search
+        # has nothing left to chase and relation-guided search does.
+        "digests_saturated_relations_growing": (
+            saturated and (not rel_saturated or frontier > 0)),
     }
 
 
@@ -387,7 +504,14 @@ def compute_payload(storage=None, recorder_runs=None,
                     "digest_errors": 0,
                     "unique_interleavings": 0, "coverage": 0.0,
                     "curve": [], "window": window,
-                    "novelty_per_window": [], "saturated": False}
+                    "novelty_per_window": [], "saturated": False,
+                    "relation_width": RELATION_WIDTH,
+                    "relation_bits": 0, "relation_coverage": 0.0,
+                    "relation_curve": [],
+                    "relation_novelty_per_window": [],
+                    "relation_saturated": False,
+                    "relation_frontier_bits": 0,
+                    "digests_saturated_relations_growing": False}
         repro = reproduction_stats(_EmptyStorage())
         entities = []
         suspicious = []
@@ -407,6 +531,12 @@ def compute_payload(storage=None, recorder_runs=None,
         "suspicious": suspicious,
     }
     if publish:
+        # the relation-coverage gauge's storage-derived face; the live
+        # per-campaign face is published by the ingest path with the
+        # knowledge scenario label (models/ingest.py)
+        spans.relation_coverage(
+            "storage", coverage.get("relation_bits", 0),
+            coverage.get("relation_width", RELATION_WIDTH))
         spans.experiment_stats(
             runs=repro["runs"],
             failures=repro["failures"],

@@ -101,6 +101,28 @@ def render_markdown(payload: Dict[str, Any]) -> str:
         f"`{sparkline(cov.get('novelty_per_window', []))}` "
         f"{cov.get('novelty_per_window', [])}")
     out(f"- saturated: {_num(cov.get('saturated', False))}")
+    if "relation_curve" in cov:
+        # relation coverage (guidance plane, doc/search.md): the second
+        # curve — ordering relations exercised, not whole interleavings
+        out(f"- relation coverage: {_num(cov.get('relation_bits'))} "
+            f"/ {_num(cov.get('relation_width'))} bits "
+            f"({_num(cov.get('relation_coverage'))})")
+        out(f"- relation-coverage growth: "
+            f"`{sparkline(cov.get('relation_curve', []))}` "
+            f"{cov.get('relation_curve', [])}")
+        out(f"- relation novelty per window: "
+            f"`{sparkline(cov.get('relation_novelty_per_window', []))}` "
+            f"{cov.get('relation_novelty_per_window', [])}")
+        out(f"- relation saturated: "
+            f"{_num(cov.get('relation_saturated', False))} "
+            f"(open frontier: "
+            f"{_num(cov.get('relation_frontier_bits'))} one-sided "
+            "relation bits)")
+        if cov.get("digests_saturated_relations_growing"):
+            out("- NOTE: digests have saturated while relations still "
+                "grow — the schedule source is replaying known "
+                "interleavings; relation-guided search still has a "
+                "frontier (enable `guidance`)")
     out("")
 
     out("## Reproduction")

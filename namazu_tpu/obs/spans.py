@@ -140,6 +140,13 @@ KNOWLEDGE_OUTAGES = "nmz_knowledge_outages_total"
 # the millisecond go" is a histogram query, not a bench run.
 EVENT_STAGE = "nmz_event_stage_seconds"
 
+# guidance plane (doc/search.md): relation-coverage occupancy of the
+# campaign's CoverageMap (covered bits / bitmap width) and the size of
+# its one-sided frontier — the live face of the relation-coverage curve
+# /analytics serves post-hoc
+RELATION_COVERAGE = "nmz_relation_coverage"
+RELATION_ONE_SIDED = "nmz_relation_one_sided"
+
 # experiment plane (cross-run aggregates, set by obs/analytics.py when a
 # payload is computed — GET /analytics, nmz-tpu tools report)
 EXPERIMENT_RUNS = "nmz_experiment_runs"
@@ -723,6 +730,32 @@ def experiment_stats(runs: int, failures: int, failure_rate: float,
         reg.gauge(EXPERIMENT_RUNS_TO_REPRO,
                   "runs per reproduction (inverse failure rate)",
                   ).set(mean_runs_to_reproduce)
+
+
+def relation_coverage(scenario: str, covered: int, width: int,
+                      one_sided: Optional[int] = None) -> None:
+    """Publish one campaign's relation-coverage frontier (guidance
+    plane, doc/search.md): bitmap occupancy in [0, 1] plus the count of
+    one-sided relations still waiting for their flip (None = the
+    caller's derivation doesn't track pair identities — leave that
+    gauge untouched rather than faking a 0)."""
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.gauge(
+        RELATION_COVERAGE,
+        "relation-coverage bitmap occupancy of the campaign's "
+        "guidance CoverageMap",
+        ("scenario",),
+    ).labels(scenario=scenario).set(
+        covered / float(width) if width > 0 else 0.0)
+    if one_sided is not None:
+        reg.gauge(
+            RELATION_ONE_SIDED,
+            "directed ordering relations observed in one direction "
+            "only (the guided search's mutation frontier)",
+            ("scenario",),
+        ).labels(scenario=scenario).set(one_sided)
 
 
 def schedule_install(source: str) -> None:

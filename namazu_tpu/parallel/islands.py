@@ -84,7 +84,7 @@ def make_multiaxis_island_step(
     axes = tuple(mesh.axis_names)
 
     def _local_step(key, pop, trace, pairs, archive, failure_feats,
-                    novelty_scale, coin=None):
+                    novelty_scale, mutation_bias, coin=None):
         # named scopes mark the per-phase op regions in any captured
         # device profile (xprof/perfetto) — the in-jit counterpart of the
         # host-side obs.search_phase timers (obs/spans.py): host timers
@@ -105,7 +105,8 @@ def make_multiaxis_island_step(
         local_best_f = pop.faults[best_i]
 
         with jax.named_scope("nmz_mutate"):
-            new_pop = ga_generation(key, pop, fitness, cfg)
+            new_pop = ga_generation(key, pop, fitness, cfg,
+                                    delay_bias=mutation_bias)
 
         # Migration: after ga_generation the island's elites occupy rows
         # [0:n_elite) of new_pop (sorted best-first), so migrants are the
@@ -161,6 +162,7 @@ def make_multiaxis_island_step(
             P(),  # archive
             P(),  # failure feats
             P(),  # novelty anneal scale (replicated scalar)
+            P(),  # mutation bias f32[H] (replicated; guidance plane)
         )
 
     sharded_fault = compat_shard_map(
@@ -181,7 +183,7 @@ def make_multiaxis_island_step(
     @jax.jit
     def step(state: IslandState, base_key, trace: TraceArrays, pairs,
              archive, failure_feats, coin=None,
-             novelty_scale=None) -> IslandState:
+             novelty_scale=None, mutation_bias=None) -> IslandState:
         if trace.hint_ids.ndim == 1:  # single trace -> batch of one
             trace = jax.tree.map(lambda x: x[None], trace)
         trace = normalize_fault_trace(trace, coin)
@@ -198,17 +200,25 @@ def make_multiaxis_island_step(
             novelty_scale = jnp.ones((), jnp.float32)
         else:
             novelty_scale = jnp.asarray(novelty_scale, jnp.float32)
+        if mutation_bias is None:
+            # all-ones bias == the unbiased kernel bit-for-bit (the
+            # bernoulli threshold values are identical), so guidance-off
+            # callers keep the pre-guidance populations exactly
+            mutation_bias = jnp.ones(
+                (state.pop.delays.shape[1],), jnp.float32)
+        else:
+            mutation_bias = jnp.asarray(mutation_bias, jnp.float32)
         if coin is None:
             # static no-fault variant: the drop-mask/penalty branch is
             # never compiled into the hot loop when faults are off
             new_pop, fit, bd, bf = sharded_nofault(
                 key, state.pop, trace, pairs, archive, failure_feats,
-                novelty_scale
+                novelty_scale, mutation_bias
             )
         else:
             new_pop, fit, bd, bf = sharded_fault(
                 key, state.pop, trace, pairs, archive, failure_feats,
-                novelty_scale, coin
+                novelty_scale, mutation_bias, coin
             )
         improved = fit > state.best_fitness
         return IslandState(

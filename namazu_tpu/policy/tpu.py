@@ -141,6 +141,17 @@ class TPUSearchPolicy(QueueBackedPolicy):
         # 0 = static weights (pre-anneal behavior).
         self.min_failure_signatures = 0
         self.novelty_floor = 0.25
+        # causality guidance (doc/search.md): make relation coverage —
+        # which happens-before orderings the campaign has exercised —
+        # a search objective. Off by default, and active only while the
+        # obs plane is on (obs_enabled = false degrades to the exact
+        # pre-guidance blind search — the guidance plane consumes
+        # recorded structure, and with recording off it must cost and
+        # change nothing).
+        self.guidance_enabled = False
+        self.guidance_bonus = 0.5
+        self.guidance_width = 0  # 0 = guidance.DEFAULT_WIDTH
+        self.guidance_window = 0  # 0 = guidance.DEFAULT_WINDOW
         # fitness weights (ops/schedule.py ScoreWeights). For pure
         # repro-rate maximization set w_novelty=0 so the search chases
         # the failure signature alone; the defaults balance exploration
@@ -248,6 +259,13 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.min_failure_signatures = int(
             p("min_failure_signatures", self.min_failure_signatures))
         self.novelty_floor = float(p("novelty_floor", self.novelty_floor))
+        self.guidance_enabled = bool(p("guidance", self.guidance_enabled))
+        self.guidance_bonus = float(
+            p("guidance_bonus", self.guidance_bonus))
+        self.guidance_width = int(
+            p("guidance_bitmap_width", self.guidance_width))
+        self.guidance_window = int(
+            p("guidance_window", self.guidance_window))
         if (self.min_failure_signatures > 0
                 and self.search_backend == "mcts"):
             log.warning(
@@ -651,6 +669,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
             surrogate_topk=self.surrogate_topk,
             min_failure_signatures=self.min_failure_signatures,
             novelty_floor=self.novelty_floor,
+            guidance_bonus=self.guidance_bonus,
         )
         mesh = None
         if self.dcn_hosts > 1:
@@ -701,6 +720,11 @@ class TPUSearchPolicy(QueueBackedPolicy):
                     "surrogate re-ranking (surrogate_topk=%d) applies to "
                     "the GA backend only; the mcts backend returns its "
                     "fitness argmax", self.surrogate_topk)
+            if self._guidance_active():
+                log.warning(
+                    "causality guidance (guidance=true) biases the GA "
+                    "backend's pick/mutation only; the mcts backend "
+                    "still feeds the coverage map and metrics")
             from namazu_tpu.models.mcts import MCTSConfig
 
             mcts_cfg = MCTSConfig(
@@ -711,9 +735,24 @@ class TPUSearchPolicy(QueueBackedPolicy):
                 max_delay=self.max_interval,
                 max_fault=self.max_fault,
             )
-            return MCTSSearch(cfg, mcts_cfg=mcts_cfg, mesh=mesh,
-                              n_devices=self.n_devices)
-        return ScheduleSearch(cfg, mesh=mesh, n_devices=self.n_devices)
+            search = MCTSSearch(cfg, mcts_cfg=mcts_cfg, mesh=mesh,
+                                n_devices=self.n_devices)
+        else:
+            search = ScheduleSearch(cfg, mesh=mesh,
+                                    n_devices=self.n_devices)
+        if self._guidance_active():
+            # wired BEFORE any checkpoint load/ingest so the archive's
+            # DAG-shape feature fragments stay slot-aligned
+            search.enable_guidance(self.guidance_width or None,
+                                   self.guidance_window or None)
+        return search
+
+    def _guidance_active(self) -> bool:
+        """Guidance runs only when asked for AND the obs plane is on:
+        the coverage signature is derived from recorded structure, so
+        ``obs_enabled = false`` degrades to the exact pre-guidance
+        blind search instead of guiding on phantom data."""
+        return self.guidance_enabled and obs.metrics.enabled()
 
     def _checkpoint(self) -> str:
         """Checkpoint path; a relative path anchors to the experiment's
@@ -886,6 +925,10 @@ class TPUSearchPolicy(QueueBackedPolicy):
             "min_failure_signatures": self.min_failure_signatures,
             "novelty_floor": self.novelty_floor,
             "search_backend": self.search_backend,
+            "guidance": self._guidance_active(),
+            "guidance_bonus": self.guidance_bonus,
+            "guidance_width": self.guidance_width,
+            "guidance_window": self.guidance_window,
             "mcts_tree_depth": self.mcts_tree_depth,
             "mcts_levels": self.mcts_levels,
             "mcts_simulations": self.mcts_simulations,
@@ -1056,6 +1099,9 @@ class TPUSearchPolicy(QueueBackedPolicy):
             knowledge=self.knowledge,
             knowledge_tenant=self._knowledge_tenant(),
             knowledge_scenario=self.scenario,
+            guidance=self._guidance_active(),
+            guidance_width=self.guidance_width,
+            guidance_window=self.guidance_window,
         )
     # order mode scores dense (a windowed permutation needs the whole
     # trace in one lexsort — ops/schedule.py), so uncapped encoding would

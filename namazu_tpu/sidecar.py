@@ -90,6 +90,7 @@ def build_search_from_params(p: dict):
         surrogate_topk=p.get("surrogate_topk", 16),
         min_failure_signatures=p.get("min_failure_signatures", 0),
         novelty_floor=p.get("novelty_floor", 0.25),
+        guidance_bonus=p.get("guidance_bonus", 0.5),
     )
     n_devices = p.get("devices")
     if p.get("search_backend", "ga") == "mcts":
@@ -103,8 +104,16 @@ def build_search_from_params(p: dict):
             max_delay=p.get("max_interval", 0.1),
             max_fault=p.get("max_fault", 0.0),
         )
-        return MCTSSearch(cfg, mcts_cfg=mcts_cfg, n_devices=n_devices)
-    return ScheduleSearch(cfg, n_devices=n_devices)
+        search = MCTSSearch(cfg, mcts_cfg=mcts_cfg, n_devices=n_devices)
+    else:
+        search = ScheduleSearch(cfg, n_devices=n_devices)
+    if p.get("guidance"):
+        # wired before any checkpoint load (SearchService._get_search)
+        # so archive rows and DAG-shape fragments stay slot-aligned —
+        # same ordering contract as policy/tpu.py _build_search
+        search.enable_guidance(p.get("guidance_width") or None,
+                               p.get("guidance_window") or None)
+    return search
 
 
 class SearchService:

@@ -226,7 +226,7 @@ def test_quarantined_runs_invisible_to_history_ingest(tmp_path):
         def has_failure_signature(self, digest):
             return False
 
-        def add_executed_trace(self, enc, reproduced):
+        def add_executed_trace(self, enc, reproduced, arrival=None):
             self.executed.append(reproduced)
 
         def add_failure_trace(self, enc):

@@ -144,7 +144,7 @@ class _RecordingSearch:
     def set_occupied_buckets(self, occupied):
         self.occupied = list(occupied)
 
-    def add_executed_trace(self, enc, reproduced=False):
+    def add_executed_trace(self, enc, reproduced=False, arrival=None):
         self.executed.append((enc, reproduced))
 
     def add_failure_trace(self, enc):
