@@ -26,6 +26,13 @@ it. Kinds:
   rollover; invariant: exactly-once dispatch, one unambiguous
   ``table_version`` per record, and a complete backhaul-reconciled
   trace.
+* ``edge_sharded`` — the sharded serving plane under worker death
+  (doc/performance.md "Binary wire + sharded edge"): edge
+  transceivers share an EdgeShardPool with nonzero delays so events
+  park in shard heaps, while ``edge.shard.die`` kills shard workers
+  mid-run; invariant: the respawned workers drain the surviving
+  shard state — exactly-once dispatch, a complete backhauled trace,
+  fsck-clean storage.
 * ``telemetry`` — fleet-telemetry relay outage
   (doc/observability.md "Fleet telemetry"): ``telemetry.push.drop``
   kills the producer's pushes; invariant: never an exception into
@@ -119,6 +126,23 @@ SCENARIOS: Dict[str, dict] = {
                 "backhaul must reconcile a complete trace",
         "faults": {"table.publish.stale": {"prob": 1.0, "max_fires": 3}},
     },
+    "edge_sharded": {
+        "kind": "edge_sharded",
+        "desc": "a shard's release/backhaul worker dies mid-run "
+                "(edge.shard.die); the surviving shard state must be "
+                "drained by the respawned worker — dispatch stays "
+                "exactly-once, the backhauled trace complete, the "
+                "storage fsck-clean",
+        "faults": {"edge.shard.die": {"prob": 0.6, "max_fires": 2}},
+    },
+    "wire_garble": {
+        "kind": "pipeline",
+        "desc": "negotiated-binary payloads are corrupted in flight; "
+                "the server must answer (never sever the keep-alive), "
+                "the bounded retry must resend clean copies, and "
+                "dispatch stays exactly-once",
+        "faults": {"wire.binary.garble": {"prob": 0.3, "max_fires": 4}},
+    },
     "relay_outage": {
         "kind": "telemetry",
         "desc": "the fleet-telemetry collector goes dark; the relay "
@@ -136,7 +160,7 @@ SCENARIOS: Dict[str, dict] = {
 DEFAULT_MATRIX: List[str] = [
     "wire_drop", "wire_dup", "wire_lost_reply", "wire_sever",
     "ingress_429", "storage_torn", "knowledge_outage", "crash_restart",
-    "edge_stale", "relay_outage",
+    "edge_stale", "edge_sharded", "wire_garble", "relay_outage",
 ]
 
 

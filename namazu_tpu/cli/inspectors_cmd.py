@@ -85,6 +85,18 @@ def _common_flags(p) -> None:
                         "orchestrator's published delay table, with "
                         "asynchronous trace backhaul; falls back to "
                         "the central wire until a table is published")
+    p.add_argument("--edge-shards", type=int, default=0, metavar="K",
+                   help="with --edge: hash this process's entities "
+                        "across K shared shard engines (per-shard "
+                        "release + backhaul workers; "
+                        "doc/performance.md \"Binary wire + sharded "
+                        "edge\"); 0 = one dispatcher per entity")
+    p.add_argument("--codec", default="auto",
+                   choices=("auto", "json", "binary"),
+                   help="wire codec preference: auto negotiates the "
+                        "binary signal codec per connection (JSON "
+                        "stays the default for pre-binary peers), "
+                        "json pins the legacy wire")
 
 
 def _make_transceiver(args, default_entity: str):
@@ -114,14 +126,21 @@ def _make_transceiver(args, default_entity: str):
     from namazu_tpu.obs import federation
 
     push_url = os.environ.get("NMZ_TELEMETRY_URL", "") or url
+    if push_url.startswith("shm://"):
+        # the shm ring is one-way; telemetry rides the uds control
+        # wire of the same endpoint
+        push_url = "uds://" + push_url[len("shm://"):]
     if not push_url.startswith(("http://", "https://", "uds://",
                                 "tcp://")):
         push_url = ""  # e.g. agent:// — no telemetry wire; stay local
     federation.ensure_self_relay(
         "inspector", push_url=push_url,
         instance=federation.default_instance(entity))
-    return new_transceiver(url, entity,
-                           edge=bool(getattr(args, "edge", False))), None
+    return new_transceiver(
+        url, entity,
+        edge=bool(getattr(args, "edge", False)),
+        edge_shards=int(getattr(args, "edge_shards", 0) or 0),
+        codec=str(getattr(args, "codec", "auto") or "auto")), None
 
 
 def run_proc(args) -> int:

@@ -362,6 +362,16 @@ def _fmt_cell(value, unit: str = "") -> str:
     return f"{value}{unit}"
 
 
+def _fmt_codec(by_codec: dict) -> Optional[str]:
+    """The dominant wire codec of one instance (most payload bytes
+    moved), from the federated ``nmz_wire_bytes_total{codec}`` ledger;
+    a ``+`` suffix marks mixed-codec traffic."""
+    if not isinstance(by_codec, dict) or not by_codec:
+        return None
+    top = max(by_codec, key=by_codec.get)
+    return f"{top}+" if len(by_codec) > 1 else top
+
+
 def _fmt_hot_stage(stage_p99: dict) -> Optional[str]:
     """The dominant lifecycle segment of one instance — the stage with
     the largest federated p99 from ``nmz_event_stage_seconds``
@@ -380,6 +390,7 @@ def render_top(payload: dict) -> str:
         ("queue_dwell_p99_s", "DWELL99", "s"),
         ("dispatch_p99_s", "E2E99", "s"),
         ("hot_stage", "HOTSTAGE", ""),
+        ("codec", "CODEC", ""),
         ("backhaul_lag_p99_s", "BACKHL99", "s"),
         ("table_version", "TBLV", ""), ("table_skew", "SKEW", ""),
         ("edge_parked", "PARKED", ""),
@@ -388,7 +399,8 @@ def render_top(payload: dict) -> str:
     rows = [[header for _, header, _ in cols]]
     for inst in payload.get("instances", []):
         inst = dict(inst,
-                    hot_stage=_fmt_hot_stage(inst.get("stage_p99_s")))
+                    hot_stage=_fmt_hot_stage(inst.get("stage_p99_s")),
+                    codec=_fmt_codec(inst.get("wire_bytes_by_codec")))
         rows.append([_fmt_cell(inst.get(key), unit)
                      for key, _, unit in cols])
     widths = [max(len(r[i]) for r in rows) for i in range(len(cols))]

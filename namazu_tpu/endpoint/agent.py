@@ -22,6 +22,7 @@ import threading
 from typing import Dict, Optional
 
 from namazu_tpu.endpoint.hub import Endpoint
+from namazu_tpu.signal import binary as _binary
 from namazu_tpu.signal.action import Action
 from namazu_tpu.signal.base import SignalError, signal_from_jsonable
 from namazu_tpu.signal.event import Event
@@ -31,23 +32,72 @@ log = get_logger("endpoint.agent")
 
 MAX_FRAME = 16 * 1024 * 1024
 
+#: high bit of the length prefix marks a binary-codec frame body
+#: (signal/binary.py). A pre-binary reader sees a length far past
+#: MAX_FRAME and drops the connection — which is why clients never
+#: send binary before the per-connection ``codec`` negotiation
+#: succeeded (doc/performance.md "Binary wire + sharded edge").
+BINARY_FRAME_FLAG = 0x80000000
 
-def write_frame(sock: socket.socket, payload: dict) -> None:
-    data = json.dumps(payload).encode()
-    sock.sendall(struct.pack("<I", len(data)) + data)
+
+class FramePayloadError(ValueError):
+    """The frame's LENGTH prefix was intact and its body fully read,
+    but the payload failed to decode (garbled binary, malformed JSON).
+    The stream is still in sync — a server answers this per frame
+    instead of severing the keep-alive connection."""
+
+
+def write_frame(sock: socket.socket, payload: dict,
+                codec: str = "json") -> int:
+    """Write one frame; returns the payload byte count."""
+    if codec == _binary.CODEC_BINARY:
+        data = _binary.dumps(payload)
+        header = struct.pack("<I", len(data) | BINARY_FRAME_FLAG)
+    else:
+        data = json.dumps(payload).encode()
+        header = struct.pack("<I", len(data))
+    sock.sendall(header + data)
+    return len(data)
+
+
+def write_raw_frame(sock: socket.socket, data: bytes,
+                    binary: bool = False) -> None:
+    """Ship pre-encoded (possibly deliberately corrupted — the
+    ``wire.binary.garble`` chaos seam) frame bytes under a well-formed
+    length prefix."""
+    length = len(data) | (BINARY_FRAME_FLAG if binary else 0)
+    sock.sendall(struct.pack("<I", length) + data)
 
 
 def read_frame(sock: socket.socket) -> Optional[dict]:
+    payload, _, _ = read_frame_ex(sock)
+    return payload
+
+
+def read_frame_ex(sock: socket.socket):
+    """One frame -> ``(payload, codec, nbytes)``; ``(None, "json", 0)``
+    on EOF. Raises :class:`FramePayloadError` for an in-sync garbled
+    payload, :class:`SignalError` for a broken framing layer."""
     header = _read_exact(sock, 4)
     if header is None:
-        return None
+        return None, _binary.CODEC_JSON, 0
     (length,) = struct.unpack("<I", header)
+    codec = _binary.CODEC_JSON
+    if length & BINARY_FRAME_FLAG:
+        codec = _binary.CODEC_BINARY
+        length &= ~BINARY_FRAME_FLAG
     if length > MAX_FRAME:
         raise SignalError(f"frame too large: {length}")
     body = _read_exact(sock, length)
     if body is None:
-        return None
-    return json.loads(body)
+        return None, codec, 0
+    try:
+        if codec == _binary.CODEC_BINARY:
+            return _binary.loads(body), codec, length
+        return json.loads(body), codec, length
+    except ValueError as e:
+        raise FramePayloadError(f"undecodable {codec} frame: {e}") \
+            from None
 
 
 def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:

@@ -45,9 +45,12 @@ import stat
 import threading
 from typing import Callable, Dict, Optional
 
-from namazu_tpu.endpoint.agent import read_frame, write_frame
+from namazu_tpu.endpoint.agent import (FramePayloadError,
+                                       read_frame_ex, write_frame)
 from namazu_tpu.obs import context as _context
 from namazu_tpu.obs import metrics as _metrics
+from namazu_tpu.obs import spans as _spans
+from namazu_tpu.signal import binary as _binary
 from namazu_tpu.signal.base import SignalError
 from namazu_tpu.utils.log import get_logger
 
@@ -201,10 +204,23 @@ class FramedServer:
         try:
             while not self._stop.is_set():
                 try:
-                    req = read_frame(conn)
+                    req, codec, n_in = read_frame_ex(conn)
+                except FramePayloadError as e:
+                    # the frame's length prefix was intact, only the
+                    # payload was garbled: the stream is still in sync
+                    # — answer it (transient: the client's bounded
+                    # retry resends a clean copy), never sever the
+                    # keep-alive connection (wire.binary.garble)
+                    try:
+                        write_frame(conn, {"ok": False,
+                                           "transient": True,
+                                           "error": str(e)})
+                    except OSError:
+                        break
+                    continue
                 except (SignalError, ValueError, OSError):
-                    # oversized frame, malformed JSON from a desynced
-                    # client, or a socket error: drop the connection
+                    # oversized frame or a socket error: the framing
+                    # layer itself is broken — drop the connection
                     break
                 if req is None:
                     break  # EOF (one-shot clients just close)
@@ -214,7 +230,27 @@ class FramedServer:
                     try:
                         write_frame(conn, {"ok": False,
                                            "error": "frame must be a "
-                                                    "JSON object"})
+                                                    "JSON object"},
+                                    codec=codec)
+                    except OSError:
+                        break
+                    continue
+                if req.get("op") == "codec":
+                    # per-connection codec negotiation: answered by the
+                    # serve loop itself so EVERY framed wire (uds
+                    # endpoint, sidecar, telemetry collector) speaks it
+                    # uniformly. A pre-binary server answers this op
+                    # with its handler's unknown-op error — the client
+                    # then stays on JSON, loss-free.
+                    offered = req.get("codecs")
+                    picked = (_binary.CODEC_BINARY
+                              if isinstance(offered, (list, tuple))
+                              and _binary.CODEC_BINARY in offered
+                              else _binary.CODEC_JSON)
+                    _spans.codec_negotiated(picked)
+                    try:
+                        write_frame(conn, {"ok": True, "codec": picked},
+                                    codec=codec)
                     except OSError:
                         break
                     continue
@@ -238,9 +274,21 @@ class FramedServer:
                     resp.setdefault(_context.CTX_KEY,
                                     _context.wire_stamp())
                 try:
-                    write_frame(conn, resp)
+                    # answer in the codec the request arrived in —
+                    # per-frame, stateless, so mixed-codec clients on
+                    # one endpoint just work
+                    n_out = write_frame(conn, resp, codec=codec)
+                except TypeError:
+                    # a handler value the binary codec cannot carry:
+                    # degrade THIS response to JSON rather than desync
+                    try:
+                        n_out = write_frame(conn, resp)
+                    except OSError:
+                        break
                 except OSError:
                     break
+                _spans.wire_bytes(codec, str(req.get("op") or "frame"),
+                                  n_in + n_out)
         finally:
             with self._conns_lock:
                 self._conns.discard(conn)

@@ -60,6 +60,7 @@ from urllib.parse import urlparse, parse_qs
 
 from namazu_tpu import chaos, obs
 from namazu_tpu.endpoint.hub import Endpoint
+from namazu_tpu.signal import binary as _binary
 from namazu_tpu.signal.action import Action
 from namazu_tpu.signal.base import SignalError, signal_from_jsonable
 from namazu_tpu.signal.control import Control, ControlOp
@@ -179,6 +180,18 @@ class ActionQueue:
                         break
                     self._cond.wait(remaining)
             return list(itertools.islice(self._items.values(), max_n))
+
+    def supersede(self) -> None:
+        """Unpark every waiting peek NOW (they return empty): the
+        simulated-crash path — a kill -9'd process has no parked
+        handler threads, so ``sever()`` must not leave pollers parked
+        on a dead endpoint's queues for a full poll window. Found as
+        the root of the documented crash-restart flake: a transceiver
+        whose transparent reconnect raced into the dying listener's
+        last milliseconds parked 30s against a zombie handler."""
+        with self._cond:
+            self._peek_gen += 1
+            self._cond.notify_all()
 
     def delete(self, uuid: str) -> Optional[Action]:
         """Remove and return the action with ``uuid``, or None."""
@@ -390,11 +403,17 @@ class RestEndpoint(QueuedEndpoint):
 
     def __init__(self, port: int = 10080, host: str = "127.0.0.1",
                  poll_timeout: float = 30.0, ingress_cap: int = 0,
-                 retry_after_s: float = 1.0):
+                 retry_after_s: float = 1.0,
+                 advertise_codec: bool = True):
         super().__init__()
         self._host = host
         self._port = port
         self.poll_timeout = poll_timeout
+        # the binary-codec negotiation piggyback (doc/performance.md
+        # "Binary wire + sharded edge"): advertise X-Nmz-Codec-Accept
+        # on every API reply so auto-codec clients upgrade; False
+        # simulates a pre-binary server (interop tests)
+        self.advertise_codec = bool(advertise_codec)
         # bounded ingress (doc/robustness.md): when more than this many
         # events sit undrained in the hub's queue, new POSTs are refused
         # with 429 + Retry-After instead of growing the queue without
@@ -431,11 +450,52 @@ class RestEndpoint(QueuedEndpoint):
             def log_message(self, fmt, *args):  # route to our logger
                 log.debug("http: " + fmt, *args)
 
+            def _req_codec(self) -> str:
+                """The request's negotiated codec (the X-Nmz-Codec
+                header names the body's codec AND asks for the reply
+                in kind; absent = the JSON default wire)."""
+                raw = self.headers.get(_binary.CODEC_HEADER)
+                if raw is None:
+                    return _binary.CODEC_JSON
+                return raw.strip()
+
+            def _decode_body(self, raw: bytes):
+                """Body -> value tree by the request's codec. Raises
+                ValueError; a garbled BINARY payload is tagged so the
+                client retries in place instead of downgrading (the
+                codec is fine, the bytes were damaged in flight)."""
+                if self._req_codec() == _binary.CODEC_BINARY:
+                    obs.wire_bytes(_binary.CODEC_BINARY, "ingress",
+                                   len(raw))
+                    return _binary.loads(raw)
+                obs.wire_bytes(_binary.CODEC_JSON, "ingress", len(raw))
+                return json.loads(raw)
+
             def _reply(self, code: int, body: Optional[dict] = None,
-                       headers: Optional[Dict[str, str]] = None) -> None:
-                data = json.dumps(body).encode() if body is not None else b""
-                self._reply_raw(code, data, "application/json",
-                                headers=headers)
+                       headers: Optional[Dict[str, str]] = None,
+                       codec: Optional[str] = None) -> None:
+                """``codec`` (or the request's) picks the body
+                serialization; anything binary-incapable degrades to
+                JSON per response (the X-Nmz-Codec reply header names
+                what was actually used)."""
+                codec = self._req_codec() if codec is None else codec
+                if body is None:
+                    return self._reply_raw(code, b"", "application/json",
+                                           headers=headers)
+                if codec == _binary.CODEC_BINARY:
+                    try:
+                        data = _binary.dumps(body)
+                    except TypeError:
+                        codec = _binary.CODEC_JSON
+                    else:
+                        headers = dict(headers or {})
+                        headers[_binary.CODEC_HEADER] = \
+                            _binary.CODEC_BINARY
+                        return self._reply_raw(
+                            code, data, _binary.CONTENT_TYPE_BINARY,
+                            headers=headers)
+                self._reply_raw(code, json.dumps(body).encode(),
+                                "application/json", headers=headers)
 
             def _reply_raw(self, code: int, data: bytes,
                            content_type: str,
@@ -445,11 +505,29 @@ class RestEndpoint(QueuedEndpoint):
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(data)))
+                if endpoint.advertise_codec \
+                        and self.path.startswith(API_ROOT):
+                    # the negotiation piggyback: every API reply tells
+                    # the client this server accepts the binary codec
+                    self.send_header(_binary.CODEC_ACCEPT_HEADER,
+                                     _binary.CODEC_BINARY)
                 for name, value in (headers or {}).items():
                     self.send_header(name, value)
                 self.end_headers()
                 if data:
                     self.wfile.write(data)
+
+            def _reply_badbody(self, e: Exception) -> None:
+                """400 for an undecodable body. A garbled BINARY
+                payload is tagged retry-in-place: the codec agreement
+                is intact, the bytes were damaged in flight — the
+                client must NOT downgrade to JSON over it
+                (wire.binary.garble chaos contract)."""
+                headers = {}
+                if self._req_codec() == _binary.CODEC_BINARY:
+                    headers["X-Nmz-Codec-Error"] = "garbled"
+                self._reply(400, {"error": str(e)}, headers=headers,
+                            codec=_binary.CODEC_JSON)
 
             def _reject_ingress(self, reason: str, status: int = 429,
                                 retry_after: Optional[float] = None
@@ -545,9 +623,11 @@ class RestEndpoint(QueuedEndpoint):
                 if self._ingress_refused():
                     return
                 try:
-                    sig = signal_from_jsonable(json.loads(raw))
-                except (SignalError, ValueError) as e:
+                    sig = signal_from_jsonable(self._decode_body(raw))
+                except SignalError as e:
                     return self._reply(400, {"error": str(e)})
+                except ValueError as e:
+                    return self._reply_badbody(e)
                 if not isinstance(sig, Event):
                     return self._reply(400, {"error": "signal is not an event"})
                 if sig.entity_id != entity or sig.uuid != uuid:
@@ -576,9 +656,9 @@ class RestEndpoint(QueuedEndpoint):
                 if self._ingress_refused():
                     return
                 try:
-                    body = json.loads(raw)
+                    body = self._decode_body(raw)
                 except ValueError as e:
-                    return self._reply(400, {"error": str(e)})
+                    return self._reply_badbody(e)
                 if isinstance(body, dict):
                     body = body.get("events")
                 if not isinstance(body, list) or not body:
@@ -626,8 +706,12 @@ class RestEndpoint(QueuedEndpoint):
                 if self._ingress_refused():
                     return
                 try:
+                    doc = self._decode_body(raw)
+                except ValueError as e:
+                    return self._reply_badbody(e)
+                try:
                     accepted, duplicates = endpoint.ingest_backhaul(
-                        json.loads(raw), entity)
+                        doc, entity)
                 except ValueError as e:
                     return self._reply(400, {"error": str(e)})
                 self._reply(200, {
@@ -871,9 +955,9 @@ class RestEndpoint(QueuedEndpoint):
                 replayed ack (the 200 was lost in flight) is a normal
                 retry, not a client error."""
                 try:
-                    body = json.loads(self._read_body())
+                    body = self._decode_body(self._read_body())
                 except ValueError as e:
-                    return self._reply(400, {"error": str(e)})
+                    return self._reply_badbody(e)
                 uuids = body.get("uuids") if isinstance(body, dict) else None
                 if (not isinstance(uuids, list) or not uuids
                         or not all(isinstance(u, str) for u in uuids)):
@@ -902,8 +986,26 @@ class RestEndpoint(QueuedEndpoint):
             self._server = None
 
     def sever(self) -> int:
-        """Tear every open connection (simulated crash — see
-        :class:`_TrackingHTTPServer`); returns how many were cut."""
-        if self._server is None:
+        """Simulated process death (see :class:`_TrackingHTTPServer`):
+        close the LISTENER first (a dead process accepts nothing — a
+        client whose transparent reconnect races into the last
+        milliseconds must get a refusal, not a fresh socket into the
+        corpse), then cut every open connection, then supersede parked
+        pollers so their handlers answer into the severed sockets and
+        die NOW instead of parking a zombie poll for a full window
+        against queues nobody will ever fill. Returns how many
+        connections were cut."""
+        srv = self._server
+        if srv is None:
             return 0
-        return self._server.sever_connections()
+        try:
+            srv.shutdown()
+            srv.server_close()
+        except OSError:  # pragma: no cover - defensive
+            pass
+        n = srv.sever_connections()
+        with self._queues_lock:
+            queues = list(self._queues.values())
+        for q in queues:
+            q.supersede()
+        return n

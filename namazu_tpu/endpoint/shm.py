@@ -1,0 +1,250 @@
+"""Shared-memory ring: the same-host data plane beside ``uds://``.
+
+The million-events/s serving plane's third wire (doc/performance.md
+"Binary wire + sharded edge"). A same-host inspector that already
+speaks ``uds://`` can ask the endpoint for a **shared-memory ring**
+(the ``shm_open`` op) and push its event bursts through it: one
+binary-codec frame memcpy'd into a mmap'd tmpfs file, no syscall, no
+socket, no wakeup on the posting path. The ring carries the HIGH-RATE
+direction only (event batches); polls, acks, table fetches, and
+backhaul stay on the uds control connection — they need per-request
+acknowledgement semantics the one-way ring deliberately does not have.
+
+Durability/exactly-once: a frame written to the ring is in the server
+process's address space — the only loss mode is server death before
+the drain, exactly the crash window the transceiver's unacked-replay
+ring already covers (the receive loop's reconnect replays deferred
+events over the uds op wire, and the endpoint's dedupe ring absorbs
+any double). A FULL ring falls back to the acked uds op, loss-free.
+The ``wire.shm.drop`` chaos seam drops a burst pre-write (the
+accounted-loss case the invariant harness ledgers).
+
+Layout of a ring file (little-endian, offsets monotonic u64, index =
+offset % capacity)::
+
+    0..3    magic  b"NMZR"
+    4..7    capacity u32
+    8..15   head   u64  (read offset  — only the reader writes it)
+    16..23  tail   u64  (write offset — only the writer writes it)
+    24..    data[capacity]
+
+Frames inside the ring reuse the framed-wire convention: ``u32 length``
+with the high bit marking a binary-codec body (endpoint/agent.py).
+SPSC by construction: one writer process, one reader thread. The
+head/tail stores are 8-byte aligned single-word writes — published
+AFTER their data on the strongly-ordered platforms this same-host
+transport targets; this is a loopback data plane, not a portable IPC
+library.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import threading
+import time
+from typing import Optional, Tuple
+
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("endpoint.shm")
+
+MAGIC = b"NMZR"
+HDR = 24
+_BINARY_FLAG = 0x80000000
+_pack_u64 = struct.Struct("<Q").pack_into
+_unpack_u64 = struct.Struct("<Q").unpack_from
+_pack_u32 = struct.Struct("<I").pack_into
+_unpack_u32 = struct.Struct("<I").unpack_from
+
+DEFAULT_CAPACITY = 1 << 20
+
+
+class ShmRing:
+    """One SPSC byte ring over a mmap'd file (tmpfs path — the caller
+    picks something under /dev/shm or next to its uds socket)."""
+
+    def __init__(self, path: str, capacity: int = DEFAULT_CAPACITY,
+                 create: bool = False):
+        self.path = path
+        if create:
+            fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_TRUNC,
+                         0o600)
+            try:
+                os.ftruncate(fd, HDR + capacity)
+                self._mm = mmap.mmap(fd, HDR + capacity)
+            finally:
+                os.close(fd)
+            self._mm[0:4] = MAGIC
+            _pack_u32(self._mm, 4, capacity)
+            _pack_u64(self._mm, 8, 0)
+            _pack_u64(self._mm, 16, 0)
+            self.capacity = capacity
+        else:
+            fd = os.open(path, os.O_RDWR)
+            try:
+                size = os.fstat(fd).st_size
+                self._mm = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+            if bytes(self._mm[0:4]) != MAGIC:
+                self._mm.close()
+                raise ValueError(f"{path}: not a shm ring")
+            (self.capacity,) = _unpack_u32(self._mm, 4)
+            if HDR + self.capacity != size:
+                self._mm.close()
+                raise ValueError(f"{path}: truncated ring")
+        self._view = memoryview(self._mm)
+
+    # -- offsets -----------------------------------------------------------
+
+    @property
+    def head(self) -> int:
+        return _unpack_u64(self._mm, 8)[0]
+
+    @property
+    def tail(self) -> int:
+        return _unpack_u64(self._mm, 16)[0]
+
+    def pending(self) -> int:
+        return self.tail - self.head
+
+    # -- writer side -------------------------------------------------------
+
+    def _copy_in(self, off: int, data) -> None:
+        cap = self.capacity
+        idx = off % cap
+        first = min(len(data), cap - idx)
+        base = HDR + idx
+        self._view[base:base + first] = data[:first]
+        if first < len(data):
+            self._view[HDR:HDR + len(data) - first] = data[first:]
+
+    def try_write_frame(self, payload: bytes,
+                        binary: bool = True) -> bool:
+        """One frame into the ring; False when it does not fit (the
+        caller falls back to the acked op wire). Non-blocking by
+        design — the zero-RTT path never waits on a slow reader."""
+        need = 4 + len(payload)
+        if need > self.capacity:
+            return False
+        tail = self.tail
+        if tail - self.head + need > self.capacity:
+            return False
+        header = bytearray(4)
+        _pack_u32(header, 0,
+                  len(payload) | (_BINARY_FLAG if binary else 0))
+        self._copy_in(tail, header)
+        self._copy_in(tail + 4, payload)
+        # publish AFTER the data: the reader only advances on tail
+        _pack_u64(self._mm, 16, tail + need)
+        return True
+
+    # -- reader side -------------------------------------------------------
+
+    def _copy_out(self, off: int, n: int) -> bytes:
+        cap = self.capacity
+        idx = off % cap
+        first = min(n, cap - idx)
+        base = HDR + idx
+        out = bytes(self._view[base:base + first])
+        if first < n:
+            out += bytes(self._view[HDR:HDR + n - first])
+        return out
+
+    def try_read_frame(self) -> Optional[Tuple[bytes, bool]]:
+        """One ``(payload, is_binary)`` off the ring, or None when
+        empty. Raises ValueError on a corrupt length (the reader drops
+        the ring — framing inside shared memory cannot resync)."""
+        head = self.head
+        if self.tail - head < 4:
+            return None
+        (length,) = _unpack_u32(self._copy_out(head, 4), 0)
+        binary = bool(length & _BINARY_FLAG)
+        length &= ~_BINARY_FLAG
+        if length > self.capacity - 4:
+            raise ValueError(f"corrupt shm frame length {length}")
+        if self.tail - head < 4 + length:
+            return None  # frame still being written
+        payload = self._copy_out(head + 4, length)
+        _pack_u64(self._mm, 8, head + 4 + length)
+        return payload, binary
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        try:
+            self._view.release()
+        except (BufferError, AttributeError):  # pragma: no cover
+            pass
+        try:
+            self._mm.close()
+        except (BufferError, ValueError):  # pragma: no cover
+            pass
+
+    def unlink(self) -> None:
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class ShmIngressThread:
+    """The endpoint-side drain of one client's ring: decode each frame
+    and hand the doc to ``handle`` (the uds endpoint routes it through
+    the SAME post_batch handler the op wire uses — dedupe ring, hub
+    fan-in, bounded ingress all included). Adaptive poll: spin briefly
+    at high rate, back off to a millisecond sleep when idle."""
+
+    def __init__(self, ring: ShmRing, handle, name: str = "shm-ingress"):
+        self.ring = ring
+        self._handle = handle
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        import json as _json
+
+        from namazu_tpu.signal import binary as _binary
+
+        idle_spins = 0
+        while not self._stop.is_set():
+            try:
+                frame = self.ring.try_read_frame()
+            except ValueError as e:
+                log.warning("shm ring corrupt (%s); abandoning it", e)
+                return
+            if frame is None:
+                idle_spins += 1
+                if idle_spins > 64:
+                    time.sleep(0.001)
+                continue
+            idle_spins = 0
+            payload, is_binary = frame
+            try:
+                doc = (_binary.loads(payload) if is_binary
+                       else _json.loads(payload))
+            except ValueError as e:
+                # one garbled frame costs itself, never the ring: the
+                # length prefix still delimited it correctly
+                log.warning("undecodable shm frame dropped: %s", e)
+                continue
+            try:
+                self._handle(doc)
+            except Exception:
+                log.exception("shm ingress handler failed")
+
+    def shutdown(self, drain_s: float = 1.0) -> None:
+        """Stop after draining what is already in the ring (bounded):
+        frames the client wrote before its shutdown must reach the
+        hub."""
+        deadline = time.monotonic() + drain_s
+        while self.ring.pending() > 0 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self.ring.close()
+        self.ring.unlink()

@@ -44,9 +44,13 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+import threading
+
 from namazu_tpu import obs
 from namazu_tpu.endpoint.framed import FramedServer
 from namazu_tpu.endpoint.rest import QueuedEndpoint
+from namazu_tpu.endpoint.shm import (DEFAULT_CAPACITY, ShmIngressThread,
+                                     ShmRing)
 from namazu_tpu.signal.base import SignalError, signal_from_jsonable
 from namazu_tpu.signal.event import Event
 from namazu_tpu.utils.log import get_logger
@@ -72,6 +76,11 @@ class UdsEndpoint(QueuedEndpoint):
         # hygiene, error answering, span-context merge/echo, severable
         # connections — one implementation across the framed wires
         self._server: Optional[FramedServer] = None
+        # shared-memory ingress rings handed out by the shm_open op
+        # (endpoint/shm.py): one drain thread per ring
+        self._shm_threads: List[ShmIngressThread] = []
+        self._shm_lock = threading.Lock()
+        self._shm_seq = 0
 
     # -- lifecycle -------------------------------------------------------
 
@@ -98,13 +107,23 @@ class UdsEndpoint(QueuedEndpoint):
         srv, self._server = self._server, None
         if srv is not None:
             srv.shutdown()
+        with self._shm_lock:
+            threads, self._shm_threads = self._shm_threads, []
+        for t in threads:
+            t.shutdown()
 
     def sever(self) -> int:
         """Cut every live connection (simulated crash, like
-        RestEndpoint.sever): a parked client poll must error and
-        reconnect, not keep talking to a dead orchestrator."""
+        RestEndpoint.sever) and supersede parked pollers — a parked
+        client poll must error and reconnect, not keep talking to a
+        dead orchestrator's handler thread."""
         srv = self._server
-        return srv.sever() if srv is not None else 0
+        n = srv.sever() if srv is not None else 0
+        with self._queues_lock:
+            queues = list(self._queues.values())
+        for q in queues:
+            q.supersede()
+        return n
 
     # -- ops --------------------------------------------------------------
 
@@ -120,6 +139,8 @@ class UdsEndpoint(QueuedEndpoint):
             return self._op_backhaul(req)
         if op == "table":
             return self._op_table()
+        if op == "shm_open":
+            return self._op_shm_open(req)
         # observability ops (telemetry push / fleet view / local
         # metrics dump — obs/federation.py): the uds wire serves the
         # same fleet surface as the REST routes, so a same-host fleet
@@ -156,6 +177,24 @@ class UdsEndpoint(QueuedEndpoint):
                              f"after {self.retry_after_s:g}s"}
         return None
 
+    def _decode_batch(self, entity: str, body):
+        """``(events, None)`` or ``(None, error string)`` for one
+        post_batch body — shared by the op wire and the shm ingress."""
+        events: List[Event] = []
+        for i, item in enumerate(body):
+            try:
+                sig = signal_from_jsonable(item)
+            except (SignalError, ValueError, TypeError) as e:
+                return None, f"batch item {i}: {e}"
+            if not isinstance(sig, Event):
+                return None, f"batch item {i} is not an event"
+            if sig.entity_id != entity:
+                return None, (f"batch item {i} entity "
+                              f"{sig.entity_id!r} does not match "
+                              f"{entity!r}")
+            events.append(sig)
+        return events, None
+
     def _op_post_batch(self, req: dict) -> dict:
         entity = str(req.get("entity") or "")
         body = req.get("events")
@@ -166,26 +205,66 @@ class UdsEndpoint(QueuedEndpoint):
         refusal = self._ingress_refusal()
         if refusal is not None:
             return refusal
-        events: List[Event] = []
-        for i, item in enumerate(body):
-            try:
-                sig = signal_from_jsonable(item)
-            except (SignalError, ValueError, TypeError) as e:
-                return {"ok": False, "error": f"batch item {i}: {e}"}
-            if not isinstance(sig, Event):
-                return {"ok": False,
-                        "error": f"batch item {i} is not an event"}
-            if sig.entity_id != entity:
-                return {"ok": False,
-                        "error": f"batch item {i} entity "
-                                 f"{sig.entity_id!r} does not match "
-                                 f"{entity!r}"}
-            events.append(sig)
+        events, err = self._decode_batch(entity, body)
+        if err is not None:
+            return {"ok": False, "error": err}
         fresh = [ev for ev in events if not self.note_event_uuid(ev.uuid)]
         if fresh:
             self.hub.post_events(fresh, self.NAME)
         return {"ok": True, "accepted": len(fresh),
                 "duplicates": len(events) - len(fresh)}
+
+    # -- shared-memory ingress (endpoint/shm.py) --------------------------
+
+    def _op_shm_open(self, req: dict) -> dict:
+        """Create one ingress ring + drain thread for this client.
+        The ring carries post_batch frames only (the acked ops stay on
+        this connection); its CAPACITY is the backpressure — a full
+        ring makes the client fall back to the acked op wire, where
+        the bounded-ingress 429 contract applies as usual."""
+        entity = str(req.get("entity") or "")
+        try:
+            capacity = int(req.get("capacity") or DEFAULT_CAPACITY)
+        except (TypeError, ValueError):
+            return {"ok": False, "error": "bad shm capacity"}
+        capacity = min(max(capacity, 1 << 16), 1 << 26)
+        with self._shm_lock:
+            self._shm_seq += 1
+            path = f"{self.path}.shm{self._shm_seq}"
+        try:
+            ring = ShmRing(path, capacity, create=True)
+        except OSError as e:
+            return {"ok": False, "error": f"shm ring: {e}"}
+        thread = ShmIngressThread(
+            ring, self._shm_handle,
+            name=f"shm-ingress-{entity or self._shm_seq}")
+        with self._shm_lock:
+            self._shm_threads.append(thread)
+        log.info("shm ingress ring %s (%d bytes) for %s", path,
+                 capacity, entity or "<any>")
+        return {"ok": True, "path": path, "capacity": capacity}
+
+    def _shm_handle(self, doc) -> None:
+        """One decoded ring frame -> the hub, through the same dedupe
+        ring as the op wire. Malformed frames cost themselves (logged),
+        never the ring."""
+        if not isinstance(doc, dict) or doc.get("op") != "post_batch":
+            log.warning("shm frame is not a post_batch op: %r",
+                        type(doc))
+            return
+        entity = str(doc.get("entity") or "")
+        body = doc.get("events")
+        if not entity or not isinstance(body, list) or not body:
+            log.warning("malformed shm post_batch frame dropped")
+            return
+        events, err = self._decode_batch(entity, body)
+        if err is not None:
+            log.warning("shm post_batch frame dropped: %s", err)
+            return
+        fresh = [ev for ev in events
+                 if not self.note_event_uuid(ev.uuid)]
+        if fresh:
+            self.hub.post_events(fresh, self.NAME)
 
     def _op_poll(self, req: dict) -> dict:
         entity = str(req.get("entity") or "")

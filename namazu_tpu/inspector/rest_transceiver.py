@@ -60,6 +60,8 @@ from urllib.parse import urlsplit
 
 from namazu_tpu import chaos, obs
 from namazu_tpu.endpoint.rest import API_ROOT, TABLE_VERSION_HEADER
+from namazu_tpu.signal import binary as _binary
+from namazu_tpu.inspector import edge as _edge_mod
 from namazu_tpu.inspector.edge import EdgeDispatcher
 from namazu_tpu.inspector.transceiver import (Transceiver,
                                               UnackedReplayMixin)
@@ -145,12 +147,37 @@ class _KeepAliveConn:
         #: response (doc/performance.md), None when the server has no
         #: table plane — routed to the edge dispatcher's staleness check
         self.last_table_version: Optional[int] = None
+        #: codec negotiation state (doc/performance.md "Binary wire"):
+        #: True once any response advertised X-Nmz-Codec-Accept; reset
+        #: on close/reconnect so a restarted (possibly older) server is
+        #: re-probed with JSON first — negotiation is per connection
+        self.accepts_binary = False
+        self._binary_counted = False
+        #: the codec of the most recent response BODY (X-Nmz-Codec)
+        self.last_codec: str = _binary.CODEC_JSON
+        #: X-Nmz-Codec-Error of the most recent response ("garbled" =
+        #: damaged in flight, retry in place; anything else on a binary
+        #: 400 = downgrade)
+        self.last_codec_error: Optional[str] = None
+        #: bumped every time a fresh socket is established — how the
+        #: receive loop notices a TRANSPARENT mid-call reconnect (the
+        #: peer may be a RESTARTED orchestrator that never saw our
+        #: in-flight events, and the reconnect-and-replay window must
+        #: arm even when no error escaped this wrapper)
+        self.generation = 0
 
     def request(self, method: str, path: str,
-                body: Optional[bytes] = None):
-        """Issue one request; returns ``(status, body_bytes)``."""
+                body: Optional[bytes] = None,
+                codec: str = _binary.CODEC_JSON):
+        """Issue one request; returns ``(status, body_bytes)``.
+        ``codec`` names the body's encoding and asks for the response
+        in kind (the X-Nmz-Codec header)."""
         headers = {"Connection": "keep-alive"}
-        if body is not None:
+        if codec == _binary.CODEC_BINARY:
+            headers[_binary.CODEC_HEADER] = _binary.CODEC_BINARY
+            if body is not None:
+                headers["Content-Type"] = _binary.CONTENT_TYPE_BINARY
+        elif body is not None:
             headers["Content-Type"] = "application/json"
         last_exc: Optional[BaseException] = None
         for attempt in (0, 1):
@@ -169,6 +196,7 @@ class _KeepAliveConn:
                        else http.client.HTTPConnection)
                 conn = self._conn = cls(self._host, self._port,
                                         timeout=self._timeout)
+                self.generation += 1
                 try:
                     conn.connect()
                     # disable Nagle: the wire pattern here is small
@@ -194,6 +222,19 @@ class _KeepAliveConn:
                                                else int(raw_tv))
                 except ValueError:
                     self.last_table_version = None
+                if resp.getheader(_binary.CODEC_ACCEPT_HEADER) \
+                        == _binary.CODEC_BINARY:
+                    if not self.accepts_binary \
+                            and not self._binary_counted:
+                        # one negotiation per connection settles on
+                        # binary the moment the server advertises it
+                        obs.codec_negotiated(_binary.CODEC_BINARY)
+                        self._binary_counted = True
+                    self.accepts_binary = True
+                self.last_codec = (resp.getheader(_binary.CODEC_HEADER)
+                                   or _binary.CODEC_JSON)
+                self.last_codec_error = resp.getheader(
+                    "X-Nmz-Codec-Error")
                 if resp.will_close:
                     self.close()
                 return resp.status, data
@@ -209,6 +250,10 @@ class _KeepAliveConn:
 
     def close(self) -> None:
         conn, self._conn = self._conn, None
+        # a reconnect re-learns the peer's codec from its adverts (the
+        # successor on this address may predate the binary wire)
+        self.accepts_binary = False
+        self._binary_counted = False
         if conn is not None:
             sock = getattr(conn, "sock", None)
             if sock is not None:
@@ -234,8 +279,20 @@ class RestTransceiver(UnackedReplayMixin, Transceiver):
                  poll_batch: Optional[int] = None,
                  poll_linger: float = 0.0,
                  edge: bool = False,
-                 backhaul_window: float = 0.05):
+                 backhaul_window: float = 0.05,
+                 codec: str = "auto",
+                 edge_shards: int = 0,
+                 shard_pool=None):
         super().__init__(entity_id)
+        # the wire codec preference (doc/performance.md "Binary wire +
+        # sharded edge"): "auto" upgrades to the binary codec once the
+        # server advertises it (JSON until then — pre-binary peers are
+        # untouched), "json" pins the legacy wire, "binary" sends
+        # binary from the first request (known-capable server). A
+        # binary 400 that is NOT a garbled-in-flight reply downgrades
+        # this transceiver to JSON permanently, loss-free.
+        self.codec_pref = codec
+        self._codec_down = False
         self.base = orchestrator_url.rstrip("/") + API_ROOT
         self.backoff_step = backoff_step
         self.backoff_max = backoff_max
@@ -282,15 +339,32 @@ class RestTransceiver(UnackedReplayMixin, Transceiver):
         # piggyback on any batch/poll response activates it), so
         # non-table policies and cold-start windows run the exact
         # central wire above
-        self._edge: Optional[EdgeDispatcher] = None
+        self._edge = None
         if edge:
-            self._edge = EdgeDispatcher(
-                entity_id,
-                deliver=self.dispatch_action,
-                deliver_many=self.dispatch_actions,
-                fetch_table=self._fetch_table_once,
-                send_backhaul=self._post_backhaul_once,
-                backhaul_window=backhaul_window)
+            if shard_pool is not None or edge_shards >= 1:
+                # per-core shards: entities hashed across the pool's N
+                # engines (doc/performance.md "Binary wire + sharded
+                # edge"); edge_shards >= 1 joins the process-global
+                # pool (1 = a single shared shard, the bench's
+                # edge_shards=1 semantics), 0 = one dispatcher per
+                # entity (rounds 7/8)
+                pool = (shard_pool if shard_pool is not None
+                        else _edge_mod.shared_pool(
+                            edge_shards, backhaul_window))
+                self._edge = pool.register(
+                    entity_id,
+                    deliver=self.dispatch_action,
+                    deliver_many=self.dispatch_actions,
+                    fetch_table=self._fetch_table_once,
+                    send_backhaul=self._post_backhaul_once)
+            else:
+                self._edge = EdgeDispatcher(
+                    entity_id,
+                    deliver=self.dispatch_action,
+                    deliver_many=self.dispatch_actions,
+                    fetch_table=self._fetch_table_once,
+                    send_backhaul=self._post_backhaul_once,
+                    backhaul_window=backhaul_window)
 
     # -- outbound --------------------------------------------------------
 
@@ -391,6 +465,55 @@ class RestTransceiver(UnackedReplayMixin, Transceiver):
             return True
         return False
 
+    def _wire_codec(self, conn: _KeepAliveConn) -> str:
+        """The codec for the next request on ``conn``."""
+        if self._codec_down or self.codec_pref == _binary.CODEC_JSON \
+                or self.codec_pref == "json":
+            return _binary.CODEC_JSON
+        if self.codec_pref == "binary" \
+                or self.codec_pref == _binary.CODEC_BINARY \
+                or conn.accepts_binary:
+            return _binary.CODEC_BINARY
+        return _binary.CODEC_JSON
+
+    @staticmethod
+    def _encode_body(value, codec: str) -> bytes:
+        if codec == _binary.CODEC_BINARY:
+            data = _binary.dumps(value)
+            if chaos.decide("wire.binary.garble") is not None:
+                # corrupt the payload in flight: the server must 400 it
+                # tagged "garbled" and the bounded retry resends clean
+                data = bytearray(data)
+                data[len(data) // 2] ^= 0xFF
+                data = bytes(data)
+            return data
+        return json.dumps(value).encode()
+
+    @staticmethod
+    def _decode_body(conn: _KeepAliveConn, body: bytes):
+        if conn.last_codec == _binary.CODEC_BINARY:
+            return _binary.loads(body)
+        return json.loads(body)
+
+    def _binary_400(self, conn: _KeepAliveConn, codec: str,
+                    what: str) -> bool:
+        """Handle a 400 answered to a binary request: garbled-in-flight
+        raises the retryable class (stay binary); anything else means
+        the peer cannot take this codec — downgrade to JSON for the
+        rest of this transceiver's life and tell the caller to resend.
+        Returns True when the caller should retry the request in JSON,
+        False when this was not a binary-codec 400 at all."""
+        if codec != _binary.CODEC_BINARY:
+            return False
+        if conn.last_codec_error == "garbled":
+            raise TransientHTTPStatus(
+                f"{what}: binary payload damaged in flight")
+        if not self._codec_down:
+            self._codec_down = True
+            log.warning("server refused the binary codec (%s); "
+                        "downgrading to JSON", what)
+        return True
+
     def _ensure_flusher(self) -> None:
         if self._flush_thread is not None or self._stop.is_set():
             return
@@ -462,17 +585,26 @@ class RestTransceiver(UnackedReplayMixin, Transceiver):
         if self._wire_fault(chunk):
             return
         entity = self.entity_id if entity is None else entity
-        body = json.dumps([ev.to_jsonable() for ev in chunk]).encode()
+        codec = self._wire_codec(self._post_conn)
+        body = self._encode_body([ev.to_jsonable() for ev in chunk],
+                                 codec)
         path = f"{self._path}/events/{entity}/batch"
         with self._conn_lock:
             t0 = time.perf_counter()
-            status, _ = self._post_conn.request("POST", path, body=body)
+            status, resp_body = self._post_conn.request(
+                "POST", path, body=body, codec=codec)
             obs.transport_rtt("post_batch", time.perf_counter() - t0)
             retry_after = self._post_conn.last_retry_after
             table_version = self._post_conn.last_table_version
             if status == 200 \
                     and chaos.decide("wire.post.dup") is not None:
-                self._post_conn.request("POST", path, body=body)
+                self._post_conn.request("POST", path, body=body,
+                                        codec=codec)
+        obs.wire_bytes(codec, "post_batch",
+                       len(body) + len(resp_body or b""))
+        if status == 400 and self._binary_400(
+                self._post_conn, codec, f"POST {path}"):
+            return self._post_batch_once(chunk, entity)
         if status in (400, 404):
             # a pre-batch orchestrator has no .../batch route (its
             # per-event route reads "batch" as a uuid and 400s the list
@@ -534,11 +666,14 @@ class RestTransceiver(UnackedReplayMixin, Transceiver):
     def _fetch_table_once(self):
         """One ``GET /policy/table``: ``(version, doc_or_None)``."""
         path = f"{self._path}/policy/table"
+        codec = self._wire_codec(self._post_conn)
         with self._conn_lock:
-            status, body = self._post_conn.request("GET", path)
+            status, body = self._post_conn.request("GET", path,
+                                                   codec=codec)
             version = self._post_conn.last_table_version
+        obs.wire_bytes(codec, "table", len(body or b""))
         if status == 200:
-            doc = json.loads(body)
+            doc = self._decode_body(self._post_conn, body)
             return int(doc.get("version", version or 0)), doc
         if status in (204, 404):
             # 204 = no publishable table at this version; 404 = a
@@ -552,16 +687,22 @@ class RestTransceiver(UnackedReplayMixin, Transceiver):
         version from the reply (the edge's staleness signal). Raises on
         failure — the dispatcher re-queues and retries, and a replayed
         chunk whose 200 was lost dedupes server-side."""
-        body = json.dumps({"items": items}).encode()
+        codec = self._wire_codec(self._post_conn)
+        body = self._encode_body({"items": items}, codec)
         path = f"{self._path}/events/{entity}/backhaul"
         with self._conn_lock:
             t0 = time.perf_counter()
-            status, raw = self._post_conn.request("POST", path, body=body)
+            status, raw = self._post_conn.request("POST", path,
+                                                  body=body, codec=codec)
             obs.transport_rtt("backhaul", time.perf_counter() - t0)
             retry_after = self._post_conn.last_retry_after
+        obs.wire_bytes(codec, "backhaul", len(body) + len(raw or b""))
+        if status == 400 and self._binary_400(
+                self._post_conn, codec, f"POST {path}"):
+            return self._post_backhaul_once(entity, items)
         _check_post_status(status, f"POST {path}", retry_after=retry_after)
         try:
-            doc = json.loads(raw)
+            doc = self._decode_body(self._post_conn, raw)
             return int(doc.get("table_version"))
         except (TypeError, ValueError):
             return None
@@ -618,6 +759,7 @@ class RestTransceiver(UnackedReplayMixin, Transceiver):
 
     def _receive_loop(self) -> None:
         backoff = 0.0
+        last_gen: Optional[int] = None
         while not self._stop.is_set():
             try:
                 actions = self._poll_once()
@@ -634,6 +776,20 @@ class RestTransceiver(UnackedReplayMixin, Transceiver):
                 self._replay_armed = True
                 self._stop.wait(backoff)
                 continue
+            # a TRANSPARENT reconnect inside the keep-alive wrapper is
+            # the same restart signature with no error escaping — a
+            # poll that raced into a dying listener's last moments and
+            # retried onto the successor must still trigger the replay,
+            # or that successor never learns of our in-flight events
+            gen = self._recv_conn.generation
+            if gen != last_gen:
+                # generation 1 on the FIRST success is the one clean
+                # connect of a fresh transceiver; anything else means
+                # a reconnect preceded this success — even one that
+                # never surfaced as a poll error
+                if last_gen is not None or gen > 1:
+                    self._replay_armed = True
+                last_gen = gen
             if self._replay_armed:
                 self._replay_armed = False
                 self._replay_unacked()
@@ -700,16 +856,18 @@ class RestTransceiver(UnackedReplayMixin, Transceiver):
         path = f"{self._path}/actions/{self.entity_id}"
         t0 = time.perf_counter()
         linger_ms = int(self.poll_linger * 1000)
+        codec = self._wire_codec(self._recv_conn)
         status, body = self._recv_conn.request(
             "GET", f"{path}?batch={self.poll_batch}"
-                   f"&linger_ms={linger_ms}")
+                   f"&linger_ms={linger_ms}", codec=codec)
         obs.transport_rtt("poll", time.perf_counter() - t0)
         self._note_table_version(self._recv_conn.last_table_version)
+        obs.wire_bytes(codec, "poll", len(body or b""))
         if status == 204:
             return []
         if status != 200:
             raise RuntimeError(f"GET {path}?batch -> {status}")
-        doc = json.loads(body)
+        doc = self._decode_body(self._recv_conn, body)
         if not (isinstance(doc, dict)
                 and isinstance(doc.get("actions"), list)):
             # a pre-batch orchestrator ignores the query and answers the
@@ -737,11 +895,23 @@ class RestTransceiver(UnackedReplayMixin, Transceiver):
             actions.append(action)
         if not actions:
             return []
-        del_body = json.dumps(
-            {"uuids": [a.uuid for a in actions]}).encode()
+        return self._ack_batch(path, actions)
+
+    def _ack_batch(self, path: str, actions: List[Action]
+                   ) -> List[Action]:
+        """One multi-uuid DELETE for a polled batch (re-entered in
+        JSON after a binary-codec downgrade)."""
+        codec = self._wire_codec(self._recv_conn)
+        del_body = self._encode_body(
+            {"uuids": [a.uuid for a in actions]}, codec)
         t0 = time.perf_counter()
-        status, _ = self._recv_conn.request("DELETE", path, body=del_body)
+        status, _ = self._recv_conn.request("DELETE", path,
+                                            body=del_body, codec=codec)
         obs.transport_rtt("ack", time.perf_counter() - t0)
+        obs.wire_bytes(codec, "ack", len(del_body))
+        if status == 400 and self._binary_400(
+                self._recv_conn, codec, f"DELETE {path}"):
+            return self._ack_batch(path, actions)
         if status != 200:
             raise RuntimeError(f"DELETE {path} (batch) -> {status}")
         return actions

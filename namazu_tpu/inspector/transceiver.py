@@ -108,6 +108,69 @@ class Transceiver:
         for event in events:
             self._post(event)
 
+    def send_events_burst(self, events) -> "BurstHandle":
+        """The serving-plane burst API (doc/performance.md "Binary
+        wire + sharded edge"): like :meth:`send_events`, but the whole
+        burst shares ONE channel and the edge's ripe group is answered
+        with a single :class:`~namazu_tpu.inspector.edge.BurstAccept`
+        verdict instead of per-event actions — the per-event waiter
+        queue, registry insert, and action mint disappear from the
+        zero-RTT path. Central-wire and parked events still arrive on
+        the channel as individual actions. For burst inspectors
+        (rawpacket GSO bursts, the bench) that release the whole group
+        on its verdict; per-event consumers keep :meth:`send_events`.
+        Same contract: deferred events only."""
+        events = list(events)
+        _context.mint_many(events)
+        chan: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._post_burst(events, chan)
+        return BurstHandle(chan, len(events))
+
+    def _post_burst(self, events, chan) -> None:
+        """Transport hook for :meth:`send_events_burst`. The default
+        (and the central subset of edge transports) registers the
+        shared channel per uuid so wire actions route to it; edge
+        transports hand the eligible subset to
+        ``EdgeDispatcher.try_dispatch_burst``, which delivers grouped
+        verdicts straight to the channel."""
+        edge = getattr(self, "_edge", None)
+        if edge is not None:
+            eligible, central = edge.partition(events)
+        else:
+            eligible, central = [], events
+        if central:
+            self._register_chan(central, chan)
+            try:
+                self._post_many(central)
+            except Exception:
+                self._unregister_chan(central)
+                raise
+        if eligible:
+            leftover = edge.try_dispatch_burst(
+                eligible, chan,
+                lambda parked: self._register_chan(parked, chan))
+            if leftover:
+                # the table was withdrawn between partition and
+                # dispatch: central wire, loss-free
+                self._register_chan(leftover, chan)
+                try:
+                    self._post_many(leftover)
+                except Exception:
+                    self._unregister_chan(leftover)
+                    raise
+
+    def _register_chan(self, events, chan) -> None:
+        with self._lock:
+            w = self._waiters
+            for event in events:
+                w[event.uuid] = chan
+
+    def _unregister_chan(self, events) -> None:
+        with self._lock:
+            pop = self._waiters.pop
+            for event in events:
+                pop(event.uuid, None)
+
     def send_notification(self, event: Event) -> None:
         """Send an observation-only event without awaiting any action."""
         _context.ensure(event)
@@ -149,6 +212,41 @@ class Transceiver:
                 )
             else:
                 ch.put(action)
+
+
+class BurstHandle:
+    """The join side of :meth:`Transceiver.send_events_burst`: one
+    channel receiving grouped :class:`BurstAccept` verdicts (counting
+    ``count`` events each) and individual actions (counting 1) until
+    the whole burst is answered."""
+
+    __slots__ = ("chan", "expected")
+
+    def __init__(self, chan, expected: int) -> None:
+        self.chan = chan
+        self.expected = expected
+
+    def get_all(self, timeout: Optional[float] = None) -> list:
+        """Every verdict for the burst, blocking up to ``timeout``
+        (``queue.Empty`` on expiry). The list holds BurstAccept groups
+        and/or per-event actions; the counts always total
+        ``expected``."""
+        import time as _time
+
+        out: list = []
+        answered = 0
+        deadline = (None if timeout is None
+                    else _time.monotonic() + timeout)
+        get = self.chan.get
+        while answered < self.expected:
+            if deadline is None:
+                item = get()
+            else:
+                item = get(timeout=max(0.0,
+                                       deadline - _time.monotonic()))
+            out.append(item)
+            answered += getattr(item, "count", 1)
+        return out
 
 
 class UnackedReplayMixin:
@@ -256,8 +354,13 @@ def new_transceiver(
     entity_id: str,
     local_endpoint: Optional[LocalEndpoint] = None,
     edge: bool = False,
+    edge_shards: int = 0,
+    codec: str = "auto",
 ) -> Transceiver:
-    """Factory, parity transceiver.go:21-31."""
+    """Factory, parity transceiver.go:21-31. ``edge_shards`` > 1 joins
+    the process-global shard pool; ``codec`` is the per-connection
+    wire-codec preference (doc/performance.md "Binary wire + sharded
+    edge")."""
     if url.startswith("local://"):
         if local_endpoint is None:
             raise ValueError("local:// requires a LocalEndpoint instance")
@@ -265,11 +368,21 @@ def new_transceiver(
     if url.startswith(("http://", "https://")):
         from namazu_tpu.inspector.rest_transceiver import RestTransceiver
 
-        return RestTransceiver(entity_id, url, edge=edge)
+        return RestTransceiver(entity_id, url, edge=edge,
+                               edge_shards=edge_shards, codec=codec)
     if url.startswith("uds://"):
         from namazu_tpu.inspector.uds_transceiver import UdsTransceiver
 
-        return UdsTransceiver(entity_id, url[len("uds://"):], edge=edge)
+        return UdsTransceiver(entity_id, url[len("uds://"):], edge=edge,
+                              edge_shards=edge_shards, codec=codec)
+    if url.startswith("shm://"):
+        # the uds control wire + a shared-memory ring for the event
+        # direction (endpoint/shm.py): the path names the uds socket
+        from namazu_tpu.inspector.uds_transceiver import UdsTransceiver
+
+        return UdsTransceiver(entity_id, url[len("shm://"):],
+                              edge=edge, edge_shards=edge_shards,
+                              codec=codec, shm=True)
     if url.startswith("agent://"):
         from namazu_tpu.inspector.agent_transceiver import AgentTransceiver
 

@@ -34,8 +34,11 @@ import time
 from typing import List, Optional
 
 from namazu_tpu import chaos, obs
-from namazu_tpu.endpoint.agent import read_frame, write_frame
+from namazu_tpu.endpoint.agent import (read_frame, read_frame_ex,
+                                       write_frame, write_raw_frame)
+from namazu_tpu.inspector import edge as _edge_mod
 from namazu_tpu.inspector.edge import EdgeDispatcher
+from namazu_tpu.signal import binary as _binary
 from namazu_tpu.inspector.rest_transceiver import (
     TransientHTTPStatus,
     _retry_after_hint,
@@ -69,19 +72,51 @@ def _check_resp(resp: dict, what: str) -> None:
 
 
 class _FramedConn:
-    """One persistent framed-JSON connection to the UDS endpoint.
+    """One persistent framed connection to the UDS endpoint.
 
     NOT thread-safe — each owner holds its own instance (the post path
     under its lock, the receive thread exclusively). A request on a
     stale socket gets ONE transparent reconnect+replay; every op here
     is idempotent by construction (post_batch dedupes server-side, poll
-    peeks, ack reports already-gone uuids as ``missing``)."""
+    peeks, ack reports already-gone uuids as ``missing``).
 
-    def __init__(self, path: str, timeout: float, abort=None):
+    Codec: with ``codec="auto"`` each (re)connect negotiates the
+    binary codec with one JSON ``codec`` op (doc/performance.md
+    "Binary wire + sharded edge"); a pre-binary server answers it with
+    an unknown-op error and the connection stays on JSON, loss-free.
+    Responses are decoded per frame (the server answers in the
+    request's codec), so negotiation never races an in-flight reply."""
+
+    def __init__(self, path: str, timeout: float, abort=None,
+                 codec: str = "auto"):
         self._path = path
         self._timeout = timeout
         self._abort = abort
         self._sock: Optional[socket.socket] = None
+        self._codec_pref = codec
+        #: the codec THIS connection negotiated ("json" until proven)
+        self.codec = _binary.CODEC_JSON
+        #: bumped per fresh socket (see the REST twin): the receive
+        #: loop arms the unacked replay on any transparent reconnect
+        self.generation = 0
+
+    def _negotiate(self, sock: socket.socket) -> None:
+        """One JSON round trip deciding this connection's codec; any
+        failure (old server, odd answer) leaves it on JSON."""
+        self.codec = _binary.CODEC_JSON
+        if self._codec_pref not in ("auto", "binary",
+                                    _binary.CODEC_BINARY):
+            return
+        try:
+            write_frame(sock, {"op": "codec",
+                               "codecs": [_binary.CODEC_BINARY]})
+            resp = read_frame(sock)
+        except (OSError, SignalError, ValueError):
+            return
+        if isinstance(resp, dict) and resp.get("ok") \
+                and resp.get("codec") == _binary.CODEC_BINARY:
+            self.codec = _binary.CODEC_BINARY
+            obs.codec_negotiated(_binary.CODEC_BINARY)
 
     def request(self, doc: dict) -> dict:
         last_exc: Optional[BaseException] = None
@@ -102,11 +137,15 @@ class _FramedConn:
                     last_exc = e
                     continue
                 self._sock = sock
+                self.generation += 1
+                self._negotiate(sock)
             try:
-                write_frame(sock, doc)
-                resp = read_frame(sock)
+                n_out = self._write(sock, doc)
+                resp, resp_codec, n_in = read_frame_ex(sock)
                 if resp is None:
                     raise OSError("connection closed mid-request")
+                obs.wire_bytes(self.codec, str(doc.get("op") or "frame"),
+                               n_out + n_in)
                 return resp
             except (OSError, SignalError, ValueError) as e:
                 self.close()
@@ -114,6 +153,24 @@ class _FramedConn:
                 if self._abort is not None and self._abort():
                     raise
         raise last_exc  # type: ignore[misc]
+
+    def _write(self, sock: socket.socket, doc: dict) -> int:
+        if self.codec == _binary.CODEC_BINARY:
+            if chaos.decide("wire.binary.garble") is not None:
+                # corrupt the payload under an intact length prefix:
+                # the server must ANSWER (transient) without severing,
+                # and the bounded retry resends a clean copy
+                data = bytearray(_binary.dumps(doc))
+                data[len(data) // 2] ^= 0xFF
+                write_raw_frame(sock, bytes(data), binary=True)
+                return len(data)
+            try:
+                return write_frame(sock, doc, codec=self.codec)
+            except TypeError:
+                # a value the binary codec cannot carry: this frame
+                # rides JSON (the server answers per frame)
+                return write_frame(sock, doc)
+        return write_frame(sock, doc)
 
     def close(self) -> None:
         sock, self._sock = self._sock, None
@@ -138,8 +195,20 @@ class UdsTransceiver(UnackedReplayMixin, Transceiver):
                  poll_batch: Optional[int] = None,
                  poll_linger: float = 0.0,
                  edge: bool = False,
-                 backhaul_window: float = 0.05):
+                 backhaul_window: float = 0.05,
+                 codec: str = "auto",
+                 edge_shards: int = 0,
+                 shard_pool=None,
+                 shm: bool = False,
+                 shm_capacity: int = 0):
         super().__init__(entity_id)
+        # shared-memory fast lane (endpoint/shm.py): opened with the
+        # shm_open op at start(); event batches ride the ring, acked
+        # ops (poll/ack/backhaul/table) stay on this connection. An
+        # old server answers the op with an error -> uds-only.
+        self._shm_want = bool(shm)
+        self._shm_capacity = int(shm_capacity)
+        self._shm_ring = None
         self.path = path
         self.backoff_step = backoff_step
         self.backoff_max = backoff_max
@@ -150,21 +219,33 @@ class UdsTransceiver(UnackedReplayMixin, Transceiver):
         self.poll_linger = max(0.0, float(poll_linger))
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._post_conn = _FramedConn(path, timeout=30.0)
+        self._post_conn = _FramedConn(path, timeout=30.0, codec=codec)
         self._recv_conn = _FramedConn(path, timeout=65.0,
-                                      abort=self._stop.is_set)
+                                      abort=self._stop.is_set,
+                                      codec=codec)
         self._conn_lock = threading.Lock()
         self._init_unacked()
         self._replay_armed = False
-        self._edge: Optional[EdgeDispatcher] = None
+        self._edge = None
         if edge:
-            self._edge = EdgeDispatcher(
-                entity_id,
-                deliver=self.dispatch_action,
-                deliver_many=self.dispatch_actions,
-                fetch_table=self._fetch_table_once,
-                send_backhaul=self._post_backhaul_once,
-                backhaul_window=backhaul_window)
+            if shard_pool is not None or edge_shards >= 1:
+                pool = (shard_pool if shard_pool is not None
+                        else _edge_mod.shared_pool(
+                            edge_shards, backhaul_window))
+                self._edge = pool.register(
+                    entity_id,
+                    deliver=self.dispatch_action,
+                    deliver_many=self.dispatch_actions,
+                    fetch_table=self._fetch_table_once,
+                    send_backhaul=self._post_backhaul_once)
+            else:
+                self._edge = EdgeDispatcher(
+                    entity_id,
+                    deliver=self.dispatch_action,
+                    deliver_many=self.dispatch_actions,
+                    fetch_table=self._fetch_table_once,
+                    send_backhaul=self._post_backhaul_once,
+                    backhaul_window=backhaul_window)
 
     # -- outbound ---------------------------------------------------------
 
@@ -189,6 +270,35 @@ class UdsTransceiver(UnackedReplayMixin, Transceiver):
             log.debug("chaos: dropped %d event(s) pre-wire (uds)",
                       len(chunk))
             return
+        if self._shm_ring is not None:
+            if chaos.decide("wire.shm.drop") is not None:
+                # the accounted-loss seam: the burst vanishes pre-ring
+                log.debug("chaos: dropped %d event(s) pre-shm",
+                          len(chunk))
+                return
+            payload = _binary.dumps(
+                {"op": "post_batch", "entity": entity,
+                 "events": [ev.to_jsonable() for ev in chunk]})
+            # the ring is SPSC: every writer thread (callers, the
+            # flush thread, the receive loop's unacked replay) must
+            # serialize — the op wire's _conn_lock is that writer lock
+            with self._conn_lock:
+                ring = self._shm_ring
+                wrote = (ring is not None
+                         and ring.try_write_frame(payload, binary=True))
+            if wrote:
+                # in the server's address space: tracked in the
+                # unacked-replay ring like any posted event (a server
+                # crash is recovered by the uds-op replay + dedupe)
+                self._note_posted(chunk)
+                obs.event_batch("flush", len(chunk))
+                obs.wire_bytes(_binary.CODEC_BINARY, "shm_post",
+                               len(payload))
+                return
+            if ring is not None:
+                # ring full: the acked op wire below IS the
+                # backpressure
+                obs.shm_ring_full(entity)
         req = {"op": "post_batch", "entity": entity,
                "events": [ev.to_jsonable() for ev in chunk]}
         with self._conn_lock:
@@ -270,14 +380,68 @@ class UdsTransceiver(UnackedReplayMixin, Transceiver):
     # -- inbound ----------------------------------------------------------
 
     def start(self) -> None:
+        if self._shm_want and self._shm_ring is None:
+            self._open_shm()
         if self._thread is None:
             self._thread = threading.Thread(
                 target=self._receive_loop,
                 name=f"uds-recv-{self.entity_id}", daemon=True)
             self._thread.start()
 
+    def _reset_shm(self) -> None:
+        """Drop + renegotiate the shm ring after a server restart: the
+        old mapping is an orphan nobody drains — writes into it would
+        be note_posted but never delivered. Runs on the receive thread
+        when the reconnect-replay arms; the writer lock makes the swap
+        safe against in-flight posts."""
+        if not self._shm_want:
+            return
+        with self._conn_lock:
+            ring, self._shm_ring = self._shm_ring, None
+        if ring is not None:
+            try:
+                ring.close()
+            except Exception:  # pragma: no cover - defensive
+                pass
+        if not self._stop.is_set():
+            self._open_shm()
+
+    def _open_shm(self) -> None:
+        from namazu_tpu.endpoint.shm import ShmRing
+
+        req = {"op": "shm_open", "entity": self.entity_id}
+        if self._shm_capacity > 0:
+            req["capacity"] = self._shm_capacity
+        try:
+            with self._conn_lock:
+                resp = self._post_conn.request(req)
+        except (*_TRANSPORT_ERRORS, RuntimeError) as e:
+            log.warning("shm_open failed (%s); staying on the uds "
+                        "op wire", e)
+            return
+        if not resp.get("ok") or not resp.get("path"):
+            log.warning("server declined shm ring (%s); staying on "
+                        "the uds op wire", resp.get("error"))
+            return
+        try:
+            ring = ShmRing(str(resp["path"]))
+        except (OSError, ValueError) as e:
+            log.warning("cannot map shm ring %s (%s); staying on the "
+                        "uds op wire", resp.get("path"), e)
+            return
+        with self._conn_lock:
+            self._shm_ring = ring
+
     def shutdown(self, join_timeout: float = 5.0) -> None:
         self._stop.set()
+        ring, self._shm_ring = self._shm_ring, None
+        if ring is not None:
+            # wait briefly for the server to drain what we wrote, then
+            # unmap (the server owns the file's lifecycle)
+            deadline = time.monotonic() + 2.0
+            while ring.pending() > 0 and time.monotonic() < deadline:
+                time.sleep(0.002)
+            ring.close()
         if self._edge is not None:
             # flush pending backhaul while the post connection is still
             # usable — edge-decided trace records are never dropped at
@@ -298,6 +462,7 @@ class UdsTransceiver(UnackedReplayMixin, Transceiver):
 
     def _receive_loop(self) -> None:
         backoff = 0.0
+        last_gen = None
         while not self._stop.is_set():
             try:
                 actions = self._poll_once()
@@ -311,6 +476,18 @@ class UdsTransceiver(UnackedReplayMixin, Transceiver):
                 self._replay_armed = True
                 self._stop.wait(backoff)
                 continue
+            # transparent reconnect = restart signature with no error
+            # escaping (see the REST receive loop): arm the replay
+            gen = self._recv_conn.generation
+            if gen != last_gen:
+                # generation 1 on the FIRST success is the one clean
+                # connect of a fresh transceiver; anything else means
+                # a reconnect preceded this success — even one that
+                # never surfaced as a poll error
+                if last_gen is not None or gen > 1:
+                    self._replay_armed = True
+                    self._reset_shm()
+                last_gen = gen
             if self._replay_armed:
                 self._replay_armed = False
                 self._replay_unacked()

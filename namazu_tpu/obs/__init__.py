@@ -77,6 +77,7 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     campaign_slot,
     carry,
     chaos_fault_injected,
+    codec_negotiated,
     edge_backhaul_lag,
     edge_decision,
     edge_parked,
@@ -107,6 +108,7 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     rest_request,
     sched_queue_depth,
     sched_queue_wait,
+    shm_ring_full,
     schedule_install,
     scorer_throughput,
     scorer_throughput_value,
@@ -123,6 +125,7 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     telemetry_push,
     transport_retry_after,
     transport_rtt,
+    wire_bytes,
 )
 
 

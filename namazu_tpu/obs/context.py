@@ -133,17 +133,23 @@ def mint_many(events: List[Any], parent: str = "") -> None:
     """Batch mint for a burst (``Transceiver.send_events``): ONE clock
     tick for the whole burst — the intra-burst order is already carried
     by entity program order, and a per-event tick under the clock lock
-    would tax the zero-RTT path for nothing."""
+    would tax the zero-RTT path for nothing.
+
+    The burst shares ONE context dict (not per-event copies of equal
+    value): every field the receive side ever writes into it is
+    burst-invariant — the hub fills the same run id, clock merges read
+    it — so aliasing is unobservable in meaning, it saves a dict mint
+    per event on the million-events/s path, and it is what lets the
+    binary batch codec carry the context ONCE per frame
+    (signal/binary.py tag 0x11)."""
     if not metrics.enabled() or not events:
         return
-    lc = _clock.tick()
-    org = origin()
+    ctx: Dict[str, Any] = {"lc": _clock.tick(), "o": origin()}
+    if parent:
+        ctx["p"] = parent
     for ev in events:
-        if getattr(ev, CTX_ATTR, None) is None:
-            ctx: Dict[str, Any] = {"lc": lc, "o": org}
-            if parent:
-                ctx["p"] = parent
-            setattr(ev, CTX_ATTR, ctx)
+        if ev.__dict__.get(CTX_ATTR) is None:
+            ev.__dict__[CTX_ATTR] = ctx
 
 
 def attach(sig: Any, ctx: Optional[Dict[str, Any]]) -> None:

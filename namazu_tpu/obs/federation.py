@@ -630,6 +630,24 @@ class FleetAggregator:
         return sum(v for v in fs.samples.values()
                    if isinstance(v, float))
 
+    def _counter_by(self, st: _InstanceState, name: str,
+                    label: str) -> Dict[str, float]:
+        """Per-label-value totals of one counter family (the codec
+        byte ledger's ``nmz_wire_bytes_total{codec}`` read), merged
+        across the family's other labels."""
+        fs = st.families.get(name)
+        if fs is None:
+            return {}
+        try:
+            idx = fs.labelnames.index(label)
+        except ValueError:
+            return {}
+        out: Dict[str, float] = {}
+        for key, v in fs.samples.items():
+            if isinstance(v, float):
+                out[key[idx]] = out.get(key[idx], 0.0) + v
+        return out
+
     def _gauge_max(self, st: _InstanceState,
                    name: str) -> Optional[float]:
         fs = st.families.get(name)
@@ -788,6 +806,14 @@ class FleetAggregator:
                     # millisecond go", federated (obs/causality.py)
                     "stage_p99_s": self._hist_quantile_by(
                         st, spans.EVENT_STAGE, "stage", 0.99),
+                    # the negotiated-codec byte ledger
+                    # (nmz_wire_bytes_total{codec}): what this
+                    # instance's wires actually moved, by codec — the
+                    # tools-top CODEC column and the /fleet face of the
+                    # JSON-vs-binary savings (doc/performance.md)
+                    "wire_bytes_by_codec": {
+                        k: round(v) for k, v in self._counter_by(
+                            st, spans.WIRE_BYTES, "codec").items()},
                     "table_version": held,
                     "table_skew": (round(fleet_version - held)
                                    if held is not None else None),

@@ -68,6 +68,13 @@ ENTITY_LABEL_OVERFLOW = "nmz_entity_label_overflow_total"
 EVENT_BATCH = "nmz_event_batch_size"
 TRANSPORT_RTT = "nmz_transport_rtt_seconds"
 
+# the negotiated wire codec (doc/performance.md "Binary wire + sharded
+# edge"): payload bytes by codec + op — the JSON-vs-binary byte savings
+# made visible on /fleet — and how many connections negotiated what
+WIRE_BYTES = "nmz_wire_bytes_total"
+CODEC_NEGOTIATIONS = "nmz_codec_negotiations_total"
+SHM_RING_FULL = "nmz_shm_ring_full_total"
+
 #: power-of-two batch-occupancy buckets — the interesting question is
 #: "are batches amortizing anything" (1 vs 2-8 vs full), not sub-unit
 #: latency resolution
@@ -585,6 +592,45 @@ def event_stage_many(stage: str, values) -> None:
     ).labels(stage=stage)
     for v in values:
         child.observe(max(0.0, v))
+
+
+def wire_bytes(codec: str, op: str, n: int) -> None:
+    """``n`` payload bytes moved over a signal-carrying wire under
+    ``codec`` ("json"/"nmzb1") for ``op`` (post_batch/poll/ack/
+    backhaul/table/frame). Counted once per message at the side that
+    built/parsed it — the byte-savings ledger of the negotiated
+    binary codec."""
+    if not metrics.enabled() or n <= 0:
+        return
+    metrics.get().counter(
+        WIRE_BYTES,
+        "wire payload bytes by codec and operation",
+        ("codec", "op"),
+    ).labels(codec=codec, op=op).inc(n)
+
+
+def shm_ring_full(entity: str) -> None:
+    """One burst that could not fit the shm ring and fell back to the
+    acked uds op wire — the ring-sizing backpressure signal."""
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.counter(
+        SHM_RING_FULL,
+        "shm-ring-full fallbacks onto the acked op wire",
+        ("entity",),
+    ).labels(entity=_entity_label(reg, entity)).inc()
+
+
+def codec_negotiated(codec: str) -> None:
+    """One per-connection codec negotiation settled on ``codec``."""
+    if not metrics.enabled():
+        return
+    metrics.get().counter(
+        CODEC_NEGOTIATIONS,
+        "per-connection codec negotiations by outcome",
+        ("codec",),
+    ).labels(codec=codec).inc()
 
 
 def transport_rtt(op: str, seconds: float) -> None:

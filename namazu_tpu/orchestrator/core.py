@@ -122,6 +122,11 @@ class Orchestrator:
 
             hub.add_endpoint(RestEndpoint(
                 port=rest_port,
+                # the long-poll window; configurable pre-start so a
+                # successor orchestrator's first parked poll cannot
+                # ride a 30s default before a test/operator shrinks it
+                poll_timeout=float(
+                    config.get("rest_poll_timeout", 30.0) or 30.0),
                 # bounded ingress (doc/robustness.md): 0 = unbounded
                 ingress_cap=int(config.get("rest_ingress_cap", 0) or 0)))
         uds_path = str(config.get("uds_path", "") or "")

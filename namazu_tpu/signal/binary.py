@@ -1,0 +1,457 @@
+"""Binary signal codec: length-prefixed, tagged, IEEE-double-exact.
+
+The negotiated fast wire (doc/performance.md "Binary wire + sharded
+edge"). PR 10 deliberately single-sourced signal serialization at
+``Signal.to_jsonable`` / ``signal_from_jsonable``, so a codec change is
+one seam: this module encodes and decodes exactly those **wire dicts**
+— it never touches signal objects, option schemas, or the span-context
+representation. A signal decoded from a binary frame is
+``signal_from_jsonable(binary.loads(frame))``, byte-for-byte equal *in
+meaning* to its JSON twin (pinned by the round-trip property tests over
+every registered signal class).
+
+Frame layout (everything little-endian)::
+
+    +----+----+----+----+------------------------------------+
+    | A6 | 4E |ver |flag|  tagged value tree ...             |
+    +----+----+----+----+------------------------------------+
+
+a fixed 4-byte header (magic ``0xA6 'N'``, version, flags) followed by
+one tagged value. Value tags:
+
+    00 None   01 True   02 False
+    03 int8   04 int32  05 int64  06 bigint (u32 len + signed LE bytes)
+    07 float64 (IEEE 754 binary64, bit-exact — a published delay table
+       crosses this wire without ever passing through decimal text, so
+       edge decisions stay bit-identical to central ones by
+       construction, not by repr round-trip luck)
+    08 str8 (u8 len + utf8)      09 str32 (u32 len + utf8)
+    0A list (u32 count + items)  0B dict (u32 count + key/value pairs;
+                                     keys are u8-length utf8 — wire
+                                     dicts never carry non-str keys)
+    0C bytes (u32 len)
+    10 signal record: type code (u8: 0 event / 1 action / 2 other),
+       class, entity, uuid (str8 each), option value, extras count (u8)
+       + (key, value) pairs — the fixed signal fields ride tag slots
+       instead of repeated key strings
+    11 signal batch: u32 count + a TEMPLATE (type code, class, entity,
+       shared ctx value-or-None) + per item (uuid, option, extras).
+       Event bursts share type/class/entity and — since the burst mint
+       (obs/context.mint_many) stamps ONE context per burst — usually
+       the ctx too, so the per-event wire cost collapses to uuid +
+       option values: ~2.4x fewer bytes than the JSON batch. CPU: the
+       pure-Python encoder runs near C-json parity (string-encode
+       caches), the decoder costs ~2x C-json — both OFF the zero-RTT
+       decision path (flush/handler threads), so the codec trades a
+       little handler-thread CPU for wire bytes and float exactness.
+
+Negotiation is the transports' job (per connection, JSON remains the
+default — doc/performance.md): this module only defines the names. A
+decoder failure raises :class:`ValueError` with the offset — the framed
+server answers it without severing the connection (the frame LENGTH was
+intact, so the stream is still in sync), and the REST routes 400 it.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List
+
+__all__ = [
+    "CODEC_BINARY", "CODEC_JSON", "CODEC_HEADER", "CODEC_ACCEPT_HEADER",
+    "CONTENT_TYPE_BINARY", "MAGIC", "VERSION", "dumps", "loads",
+]
+
+#: negotiated codec names (the values of the REST headers and the
+#: framed ``codec`` op)
+CODEC_BINARY = "nmzb1"
+CODEC_JSON = "json"
+#: REST: the codec of THIS message's body (request and response)
+CODEC_HEADER = "X-Nmz-Codec"
+#: REST: piggybacked on every API response — how a client discovers a
+#: binary-capable server (the table-version piggyback pattern)
+CODEC_ACCEPT_HEADER = "X-Nmz-Codec-Accept"
+CONTENT_TYPE_BINARY = "application/x-nmz-binary"
+
+MAGIC = b"\xa6N"
+VERSION = 1
+_HEADER = MAGIC + bytes((VERSION, 0))
+
+_pack_d = struct.Struct("<d").pack
+_pack_i = struct.Struct("<i").pack
+_pack_q = struct.Struct("<q").pack
+_pack_I = struct.Struct("<I").pack
+_unpack_d = struct.Struct("<d").unpack_from
+_unpack_i = struct.Struct("<i").unpack_from
+_unpack_q = struct.Struct("<q").unpack_from
+_unpack_I = struct.Struct("<I").unpack_from
+
+#: the signal-record fixed slots (never re-encoded as extras)
+_SIG_KEYS = frozenset(("type", "class", "entity", "uuid", "option"))
+_TYPE_CODES = {"event": 0, "action": 1}
+_TYPE_NAMES = {0: "event", 1: "action"}
+
+#: bounded encode caches: wire strings repeat heavily — option keys,
+#: entity ids, replay hints, class names are drawn from tiny sets while
+#: uuids are unique. Caching the ENCODED bytes turns most of a batch's
+#: string work into dict gets (cleared whole at the cap, the EdgeTable
+#: memo convention — eviction bookkeeping would cost more than the
+#: encodes it saves).
+_CACHE_CAP = 4096
+_rawstr_cache: Dict[str, bytes] = {}
+_str_cache: Dict[str, bytes] = {}
+
+
+def _is_signal_dict(v: Any) -> bool:
+    return (type(v) is dict and "class" in v and "uuid" in v
+            and "entity" in v)
+
+
+def _enc_str(s: str, out: List[bytes]) -> None:
+    enc = _str_cache.get(s)
+    if enc is None:
+        b = s.encode()
+        n = len(b)
+        enc = (b"\x08" + bytes((n,)) + b if n < 256
+               else b"\x09" + _pack_I(n) + b)
+        if n <= 128:
+            if len(_str_cache) >= _CACHE_CAP:
+                _str_cache.clear()
+            _str_cache[s] = enc
+    out.append(enc)
+
+
+def _enc_rawstr(s: str, out: List[bytes]) -> None:
+    """Tagless string (dict keys, the signal record's fixed slots):
+    u8 length, with 255 escaping to a u32 length."""
+    enc = _rawstr_cache.get(s)
+    if enc is None:
+        if type(s) is not str:
+            raise TypeError(f"wire dict key must be str, got {type(s)}")
+        b = s.encode()
+        n = len(b)
+        enc = (bytes((n,)) + b if n < 255
+               else b"\xff" + _pack_I(n) + b)
+        if n <= 128:
+            if len(_rawstr_cache) >= _CACHE_CAP:
+                _rawstr_cache.clear()
+            _rawstr_cache[s] = enc
+        else:
+            out.append(enc)
+            return
+    out.append(enc)
+
+
+def _enc_rawstr_nc(s: str, out: List[bytes]) -> None:
+    """Uncached raw string (uuids: unique by construction, caching
+    them would only churn the bounded caches)."""
+    b = s.encode()
+    n = len(b)
+    if n < 255:
+        out.append(bytes((n,)) + b)
+    else:
+        out.append(b"\xff" + _pack_I(n) + b)
+
+
+def _enc_sig_tail(d: Dict[str, Any], out: List[bytes],
+                  skip_ctx: bool = False) -> None:
+    """uuid + option + extras of one signal record (the per-item part
+    shared by the scalar record and the batch row). The flat
+    string-valued option dict — every built-in event class — is
+    encoded inline off the caches; anything else takes the generic
+    path."""
+    append = out.append
+    u = d["uuid"].encode()
+    append(bytes((len(u),)) + u if len(u) < 255
+           else b"\xff" + _pack_I(len(u)) + u)
+    option = d.get("option")
+    if type(option) is dict:
+        append(b"\x0b" + _pack_I(len(option)))
+        raw_get = _rawstr_cache.get
+        str_get = _str_cache.get
+        for k, v in option.items():
+            enc = raw_get(k)
+            if enc is None:
+                _enc_rawstr(k, out)
+            else:
+                append(enc)
+            if type(v) is str:
+                enc = str_get(v)
+                if enc is None:
+                    _enc_str(v, out)
+                else:
+                    append(enc)
+            else:
+                _enc_value(v, out)
+    else:
+        _enc_value(option, out)
+    n_extras = 0
+    for k in d:
+        if k not in _SIG_KEYS and not (skip_ctx and k == "ctx"):
+            n_extras += 1
+    if n_extras > 255:
+        raise TypeError("signal dict has too many extra fields")
+    append(bytes((n_extras,)))
+    if n_extras:
+        for k, v in d.items():
+            if k in _SIG_KEYS or (skip_ctx and k == "ctx"):
+                continue
+            _enc_rawstr(k, out)
+            _enc_value(v, out)
+
+
+def _enc_value(v: Any, out: List[bytes]) -> None:
+    t = type(v)
+    if t is str:
+        _enc_str(v, out)
+    elif t is dict:
+        if "class" in v and "uuid" in v and "entity" in v:
+            # one signal record: fixed slots instead of key strings.
+            # A non-standard/absent "type" gets code 2 and rides the
+            # extras (lossless; code 2 alone means "no type key").
+            standard = v.get("type") in _TYPE_CODES and "type" in v
+            out.append(b"\x10" + bytes(
+                (_TYPE_CODES[v["type"]] if standard else 2,)))
+            _enc_rawstr(str(v["class"]), out)
+            _enc_rawstr(str(v["entity"]), out)
+            if standard:
+                _enc_sig_tail(v, out)
+            else:
+                _enc_sig_tail_odd_type(v, out)
+        else:
+            out.append(b"\x0b" + _pack_I(len(v)))
+            for k, val in v.items():
+                if type(k) is not str:
+                    raise TypeError(
+                        f"wire dict key must be str, got {type(k)}")
+                _enc_rawstr(k, out)
+                _enc_value(val, out)
+    elif t is list:
+        if len(v) > 1 and all(map(_is_signal_dict, v)):
+            first = v[0]
+            f_type = first.get("type")
+            f_cls, f_ent = first["class"], first["entity"]
+            f_ctx = first.get("ctx")
+            if (f_type in _TYPE_CODES
+                    and all(d.get("type") == f_type
+                            and d["class"] == f_cls
+                            and d["entity"] == f_ent for d in v)):
+                # signal batch: template + rows (the burst fast path).
+                # The template carries the shared ctx ONLY when every
+                # row has that exact ctx — decode attaches the
+                # template ctx to every row, so a mixed batch (one
+                # ctx-less event coalesced with stamped ones) must
+                # fall back to per-row ctx extras or decode would
+                # FABRICATE a span context that was never minted.
+                shared_ctx = (f_ctx if f_ctx is not None
+                              and all(d.get("ctx") == f_ctx for d in v)
+                              else None)
+                out.append(b"\x11" + _pack_I(len(v))
+                           + bytes((_TYPE_CODES[f_type],)))
+                _enc_rawstr(str(f_cls), out)
+                _enc_rawstr(str(f_ent), out)
+                _enc_value(shared_ctx, out)
+                skip = shared_ctx is not None
+                for d in v:
+                    _enc_sig_tail(d, out, skip_ctx=skip)
+                return
+        out.append(b"\x0a" + _pack_I(len(v)))
+        for item in v:
+            _enc_value(item, out)
+    elif t is float:
+        out.append(b"\x07" + _pack_d(v))
+    elif t is bool:
+        out.append(b"\x01" if v else b"\x02")
+    elif t is int:
+        if -128 <= v < 128:
+            out.append(b"\x03" + v.to_bytes(1, "little", signed=True))
+        elif -2147483648 <= v < 2147483648:
+            out.append(b"\x04" + _pack_i(v))
+        elif -(1 << 63) <= v < (1 << 63):
+            out.append(b"\x05" + _pack_q(v))
+        else:
+            b = v.to_bytes((v.bit_length() + 8) // 8, "little",
+                           signed=True)
+            out.append(b"\x06" + _pack_I(len(b)) + b)
+    elif v is None:
+        out.append(b"\x00")
+    elif t is bytes:
+        out.append(b"\x0c" + _pack_I(len(v)) + v)
+    elif t is tuple:
+        _enc_value(list(v), out)
+    elif isinstance(v, (str, dict, list, float, bool, int)):
+        # subclasses (Enum strs, OrderedDict, numpy-ish floats that
+        # passed a float() somewhere upstream) — re-dispatch on the
+        # base type so the wire form matches what json.dumps would emit
+        for base, conv in ((str, str), (dict, dict), (list, list),
+                           (bool, bool), (int, int), (float, float)):
+            if isinstance(v, base):
+                _enc_value(conv(v), out)
+                return
+    else:
+        raise TypeError(f"cannot binary-encode {type(v)}")
+
+
+def _enc_sig_tail_odd_type(d: Dict[str, Any], out: List[bytes]) -> None:
+    """Tail for a record whose ``type`` is absent or non-standard: the
+    raw type value rides as an extra so decode reproduces the dict
+    exactly (decode adds no "type" key for code 2)."""
+    _enc_rawstr_nc(d["uuid"], out)
+    _enc_value(d.get("option"), out)
+    extras = [(k, v) for k, v in d.items() if k not in _SIG_KEYS]
+    if "type" in d:
+        extras.append(("type", d["type"]))
+    if len(extras) > 255:
+        raise TypeError("signal dict has too many extra fields")
+    out.append(bytes((len(extras),)))
+    for k, v in extras:
+        _enc_rawstr(k, out)
+        _enc_value(v, out)
+
+
+def dumps(obj: Any) -> bytes:
+    """Encode one value tree into a binary frame body."""
+    out: List[bytes] = [_HEADER]
+    _enc_value(obj, out)
+    return b"".join(out)
+
+
+# -- decode ----------------------------------------------------------------
+
+_dec_cache: Dict[bytes, str] = {}
+
+
+def _dec_rawstr(b: bytes, o: int):
+    n = b[o]
+    o += 1
+    if n == 255:
+        (n,) = _unpack_I(b, o)
+        o += 4
+    end = o + n
+    raw = b[o:end]
+    if n <= 32:
+        # keys / class / entity names repeat across a batch; uuids
+        # (36 bytes) deliberately sit above the cap
+        s = _dec_cache.get(raw)
+        if s is None:
+            s = raw.decode()
+            if len(_dec_cache) >= _CACHE_CAP:
+                _dec_cache.clear()
+            _dec_cache[raw] = s
+        return s, end
+    return raw.decode(), end
+
+
+def _dec_sig_tail(b: bytes, o: int, type_name, cls: str, ent: str,
+                  ctx):
+    """One signal record's uuid/option/extras -> (dict, offset)."""
+    uuid, o = _dec_rawstr(b, o)
+    option, o = _dec_value(b, o)
+    d: Dict[str, Any] = {"class": cls, "entity": ent, "uuid": uuid,
+                         "option": option}
+    if type_name is not None:
+        d["type"] = type_name
+    if ctx is not None:
+        d["ctx"] = ctx
+    n_extras = b[o]
+    o += 1
+    for _ in range(n_extras):
+        k, o = _dec_rawstr(b, o)
+        d[k], o = _dec_value(b, o)
+    return d, o
+
+
+def _dec_value(b: bytes, o: int):
+    t = b[o]
+    o += 1
+    if t == 0x08:
+        n = b[o]
+        o += 1
+        end = o + n
+        return b[o:end].decode(), end
+    if t == 0x10:
+        code = b[o]
+        o += 1
+        cls, o = _dec_rawstr(b, o)
+        ent, o = _dec_rawstr(b, o)
+        return _dec_sig_tail(b, o, _TYPE_NAMES.get(code), cls, ent,
+                             None)
+    if t == 0x11:
+        (n,) = _unpack_I(b, o)
+        o += 4
+        code = b[o]
+        o += 1
+        cls, o = _dec_rawstr(b, o)
+        ent, o = _dec_rawstr(b, o)
+        ctx, o = _dec_value(b, o)
+        type_name = _TYPE_NAMES.get(code)
+        items = []
+        for _ in range(n):
+            d, o = _dec_sig_tail(b, o, type_name, cls, ent, ctx)
+            items.append(d)
+        return items, o
+    if t == 0x0b:
+        (n,) = _unpack_I(b, o)
+        o += 4
+        d = {}
+        for _ in range(n):
+            k, o = _dec_rawstr(b, o)
+            d[k], o = _dec_value(b, o)
+        return d, o
+    if t == 0x0a:
+        (n,) = _unpack_I(b, o)
+        o += 4
+        items = []
+        append = items.append
+        for _ in range(n):
+            v, o = _dec_value(b, o)
+            append(v)
+        return items, o
+    if t == 0x07:
+        return _unpack_d(b, o)[0], o + 8
+    if t == 0x03:
+        return int.from_bytes(b[o:o + 1], "little", signed=True), o + 1
+    if t == 0x04:
+        return _unpack_i(b, o)[0], o + 4
+    if t == 0x05:
+        return _unpack_q(b, o)[0], o + 8
+    if t == 0x06:
+        (n,) = _unpack_I(b, o)
+        o += 4
+        return int.from_bytes(b[o:o + n], "little", signed=True), o + n
+    if t == 0x09:
+        (n,) = _unpack_I(b, o)
+        o += 4
+        end = o + n
+        return b[o:end].decode(), end
+    if t == 0x00:
+        return None, o
+    if t == 0x01:
+        return True, o
+    if t == 0x02:
+        return False, o
+    if t == 0x0c:
+        (n,) = _unpack_I(b, o)
+        o += 4
+        return b[o:o + n], o + n
+    raise ValueError(f"unknown binary tag 0x{t:02x} at offset {o - 1}")
+
+
+def loads(data: bytes) -> Any:
+    """Decode one binary frame body; raises ValueError on anything
+    malformed — wrong magic, truncation, garbled tags. The error is a
+    per-FRAME condition: the transports answer/400 it and keep the
+    connection, because the length prefix that delimited this frame
+    was intact."""
+    if len(data) < 4 or data[:2] != MAGIC:
+        raise ValueError("not a binary frame (bad magic)")
+    if data[2] != VERSION:
+        raise ValueError(f"unsupported binary codec version {data[2]}")
+    try:
+        value, end = _dec_value(data, 4)
+    except (IndexError, struct.error, UnicodeDecodeError) as e:
+        raise ValueError(f"garbled binary frame: {e}") from None
+    if end != len(data):
+        raise ValueError(
+            f"garbled binary frame: {len(data) - end} trailing byte(s)")
+    return value

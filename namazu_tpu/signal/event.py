@@ -121,7 +121,15 @@ class PacketEvent(Event):
             opt["payload_b64"] = base64.b64encode(payload).decode("ascii")
         if hint:
             opt["replay_hint"] = hint
-        return cls(entity_id=entity_id, option=opt)
+        event = cls(entity_id=entity_id, option=opt)
+        # derive the replay hint eagerly — the flow parts are in hand
+        # as locals, and the serving plane would otherwise pay the
+        # option-dict lookups + f-string on its decision path
+        # (replay_hint() memoizes into the same slot for events built
+        # off the wire)
+        event._rh = (f"{src_entity}->{dst_entity}:{hint}" if hint
+                     else f"packet:{src_entity}->{dst_entity}")
+        return event
 
     @property
     def payload(self) -> bytes:
@@ -138,12 +146,23 @@ class PacketEvent(Event):
         # content half; the flow is prefixed here so every packet hint is
         # destination-resolved, and the searched delay table can delay
         # src->A independently of src->B.
+        #
+        # Memoized per instance (``_rh``): the hint is a pure function
+        # of the immutable option dict, and the serving plane resolves
+        # it on every decision — the edge burst path reads the memo
+        # slot directly (inspector/edge.py), so this f-string work runs
+        # once per event, not once per lookup.
+        memo = self.__dict__.get("_rh")
+        if memo is not None:
+            return memo
         flow = (f"{self.option['src_entity']}->"
                 f"{self.option['dst_entity']}")
         explicit = self.option.get("replay_hint")
         if explicit:
-            return f"{flow}:{explicit}"
-        return f"packet:{flow}"
+            self._rh = hint = f"{flow}:{explicit}"
+            return hint
+        self._rh = hint = f"packet:{flow}"
+        return hint
 
     def default_fault_action(self):
         from namazu_tpu.signal.action import PacketFaultAction

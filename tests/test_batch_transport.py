@@ -565,7 +565,7 @@ def test_batch_poll_downgrades_on_single_action_body():
     action = _act(entity="lg0")
     calls = []
 
-    def fake(method, path, body=None):
+    def fake(method, path, body=None, codec="json"):
         calls.append((method, path))
         if method == "GET":
             return 200, action.to_json().encode()
@@ -585,7 +585,7 @@ def test_batch_post_downgrades_on_missing_route():
                          flush_window=0.0)
     posted = []
 
-    def fake(method, path, body=None):
+    def fake(method, path, body=None, codec="json"):
         if path.endswith("/batch"):
             return 400, b'{"error": "url entity/uuid do not match"}'
         posted.append(path)

@@ -403,17 +403,26 @@ def test_why_reports_injected_flip(tmp_path, capsys):
 def test_context_survives_rest_restart_replay(tmp_path):
     """Orchestrator A dies (simulated kill -9) with events parked; the
     transceiver's reconnect replay re-posts them to successor B — whose
-    recorder must see the ORIGINAL span contexts, not re-mints."""
-    from namazu_tpu.chaos.harness import _Pipeline
+    recorder must see the ORIGINAL span contexts, not re-mints.
 
+    Deflaked (measured_grace pattern): the liveness window is
+    load-scaled so sequential posting over real HTTP on a contended
+    host cannot trip the watchdog mid-post, and the successor's
+    long-poll window is set via CONFIG before its endpoint opens — the
+    old post-start attribute write raced the receive thread's first
+    reconnect, which could park a 30s empty poll before the shrink
+    landed and push the replay past the collect deadline."""
+    from namazu_tpu.chaos.harness import _Pipeline, measured_grace
+
+    grace = measured_grace(0.5)
     pipe = _Pipeline(str(tmp_path / "wd"), "ctx-a", seed=1, entities=2,
-                     events=2, delay_ms=30_000.0, liveness_s=0.5,
+                     events=2, delay_ms=30_000.0, liveness_s=grace,
                      journal=False, post_attempts=12)
     pipe.start_orchestrator()
     port = pipe.port
     pipe.start_transceivers()
     pipe.post_all()
-    deadline = time.monotonic() + 20
+    deadline = time.monotonic() + 20 + 10 * grace
     while time.monotonic() < deadline \
             and len(pipe.policy._queue) < len(pipe.posted):
         time.sleep(0.02)
@@ -427,11 +436,11 @@ def test_context_survives_rest_restart_replay(tmp_path):
     pipe.orc.abandon()
     pipe.run_id = "ctx-b"
     pipe.cfg.set("run_id", "ctx-b")
-    pipe.start_orchestrator(rest_port=port)
     # the reconnect replay fires after the first successful poll round
-    # trip against the successor; shrink its long-poll window so the
-    # test doesn't ride out a full 30s empty poll first
-    pipe.orc.hub.endpoint("rest").poll_timeout = 0.3
+    # trip against the successor; shrink its long-poll window BEFORE
+    # the endpoint opens so no poll can park on the 30s default
+    pipe.cfg.set("rest_poll_timeout", 0.3)
+    pipe.start_orchestrator(rest_port=port)
     pipe.settle_s = 60.0
     pipe.collect()  # watchdog frees the replayed events
     pipe.await_quiescent()

@@ -170,7 +170,7 @@ def test_transceiver_honors_retry_after_on_429(monkeypatch):
                          use_batch=True, flush_window=0.0)
     calls = []
 
-    def overloaded(method, path, body=None):
+    def overloaded(method, path, body=None, codec="json"):
         calls.append(path)
         if len(calls) < 2:
             tx._post_conn.last_retry_after = 0.05

@@ -936,12 +936,14 @@ def test_uds_endpoint_refuses_to_steal_a_live_socket(tmp_path):
 
 
 def test_uds_endpoint_survives_malformed_json_frame(tmp_path):
-    """A desynced client sending a valid length prefix over garbage
-    bytes must cost only its own connection — the handler drops it
-    cleanly and the endpoint keeps serving new connections."""
+    """A client sending a valid length prefix over garbage bytes is
+    ANSWERED (transient error), not severed — the frame boundary was
+    intact, so the keep-alive stream is still in sync and the same
+    connection keeps working (doc/performance.md "Binary wire")."""
     import socket as _socket
     import struct
 
+    from namazu_tpu.endpoint.agent import read_frame, write_frame
     from namazu_tpu.endpoint.hub import EndpointHub
     from namazu_tpu.endpoint.uds import UdsEndpoint
     from namazu_tpu.utils.mock_orchestrator import MockOrchestrator
@@ -955,10 +957,16 @@ def test_uds_endpoint_survives_malformed_json_frame(tmp_path):
     try:
         bad = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
         bad.connect(path)
+        bad.settimeout(5.0)
         payload = b"not json at all"
         bad.sendall(struct.pack("<I", len(payload)) + payload)
-        bad.settimeout(5.0)
-        assert bad.recv(1) == b""  # server dropped the connection
+        resp = read_frame(bad)
+        assert resp is not None and resp.get("ok") is False
+        assert resp.get("transient") is True
+        # the SAME connection still serves a well-formed frame
+        write_frame(bad, {"op": "table"})
+        resp = read_frame(bad)
+        assert resp is not None and resp.get("ok") is True
         bad.close()
         # the endpoint still serves a well-behaved client
         tx = UdsTransceiver("e0", path, poll_linger=0.005)

@@ -49,41 +49,16 @@ def run_experiment(tmp_path, config_name, n_runs, name):
 
 def measured_grace(base: float = 0.025, samples: int = 40) -> float:
     """A reader grace period scaled to THIS host's scheduler jitter
-    UNDER LOAD. The baseline test's only claim is "the dumb
-    passthrough's interception latency stays under the reader's
-    grace" — but the run itself is a 5-process pile-up (orchestrator,
-    agent endpoint, interposed writer, reader, run script), so the
-    sampling must emulate that contention or an idle pre-test probe
-    undershoots what the run will actually see on a small CI host.
-    Idle many-core hosts get the calibrated default back unchanged."""
-    import threading as _threading
-    import time as _time
+    UNDER LOAD — the shared under-load calibration now lives in
+    chaos/harness.py (the crash scenarios use it too); this wrapper
+    keeps the WAL baseline's calibrated base and its 1s cap (12
+    epochs x grace must stay well inside the reader's 30s deadline).
+    The run itself is a 5-process pile-up, so an idle probe would
+    undershoot what the run actually sees on a small CI host; idle
+    many-core hosts get the calibrated default back unchanged."""
+    from namazu_tpu.chaos.harness import measured_grace as _mg
 
-    stop = _time.monotonic() + 1.0
-
-    def _burn():
-        while _time.monotonic() < stop:
-            sum(range(2000))
-
-    burners = [_threading.Thread(target=_burn, daemon=True)
-               for _ in range(max(2, (os.cpu_count() or 2)))]
-    for t in burners:
-        t.start()
-    overshoots = []
-    for _ in range(samples):
-        t0 = _time.perf_counter()
-        _time.sleep(0.001)
-        overshoots.append(_time.perf_counter() - t0 - 0.001)
-    for t in burners:
-        t.join()
-    overshoots.sort()
-    p95 = overshoots[int(0.95 * (len(overshoots) - 1))]
-    # the race window stacks several sleep/wakeup hops (writer, agent
-    # wire, orchestrator loops, reader poll): budget a generous
-    # multiple of the single-hop p95 on top of the calibrated base,
-    # capped so a pathological host still finishes inside the reader's
-    # deadline (12 epochs x grace << 30s)
-    return min(1.0, max(base, 20.0 * p95 + 0.010))
+    return _mg(base, samples=samples, mult=20.0, cap=1.0, burn_s=1.0)
 
 
 def test_wal_commit_baseline_near_zero(tmp_path, monkeypatch):

@@ -441,7 +441,7 @@ def test_rest_post_retries_transients(monkeypatch):
                          use_batch=False)
     calls = []
 
-    def flaky(method, path, body=None):
+    def flaky(method, path, body=None, codec="json"):
         calls.append(path)
         if len(calls) < 3:
             raise ConnectionRefusedError("connection refused")
@@ -481,7 +481,7 @@ def test_rest_batch_flush_retries_and_dedupes_serverside(monkeypatch):
                          use_batch=True, flush_window=0.0)
     calls = []
 
-    def flaky(method, path, body=None):
+    def flaky(method, path, body=None, codec="json"):
         calls.append((method, path))
         if len(calls) < 3:
             raise ConnectionResetError("peer vanished mid-response")

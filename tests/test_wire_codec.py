@@ -1,0 +1,921 @@
+"""Binary wire codec + sharded edge: the round-9 serving plane.
+
+Pins (doc/performance.md "Binary wire + sharded edge"):
+
+* the binary codec round-trips EVERY registered signal class's wire
+  dict losslessly (span context, option payloads, action fields), with
+  IEEE-754 bit-exact doubles;
+* negotiation is per connection and loss-free for pre-binary peers
+  (JSON stays the default; a binary 400 downgrades and resends; a
+  garbled-in-flight payload retries in place WITHOUT downgrading);
+* garbage/truncated frames are rejected per frame, never severing the
+  keep-alive stream;
+* mixed-codec clients share one endpoint;
+* trace-differ equivalence (order AND delays) holds binary-vs-JSON and
+  sharded-vs-single-dispatcher;
+* the shared-memory ring moves event batches exactly-once with the
+  ``wire.shm.drop`` losses accounted;
+* the burst API delivers grouped verdicts for ripe groups and real
+  actions for parked events, with the backhaul reconciling a complete
+  trace.
+"""
+
+import json
+import math
+import os
+import random
+import struct
+import time
+
+import pytest
+
+from namazu_tpu import chaos, obs
+from namazu_tpu.chaos.plan import FaultPlan
+from namazu_tpu.obs import export, metrics, recorder as recorder_mod
+from namazu_tpu.obs.metrics import MetricsRegistry
+from namazu_tpu.obs.recorder import FlightRecorder
+from namazu_tpu.signal import PacketEvent, binary
+from namazu_tpu.signal.base import (get_signal_class,
+                                    known_signal_classes,
+                                    signal_from_jsonable)
+from namazu_tpu.signal.event import Event
+from namazu_tpu.utils.config import Config
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    reg = metrics.set_registry(MetricsRegistry())
+    was = metrics.enabled()
+    metrics.configure(True)
+    rec = recorder_mod.set_recorder(
+        FlightRecorder(max_runs=8, max_records=1 << 14))
+    chaos.clear()
+    yield
+    chaos.clear()
+    metrics.set_registry(reg)
+    metrics.configure(was)
+    recorder_mod.set_recorder(rec)
+
+
+# -- codec properties ------------------------------------------------------
+
+
+def _instance_of(cls):
+    """A minimally-valid instance of one registered signal class."""
+    option = {field: f"v-{field}" for field, required
+              in cls.OPTION_FIELDS.items() if required}
+    try:
+        return cls(entity_id="ent-x", option=option)
+    except Exception:
+        return None
+
+
+def test_every_registered_signal_roundtrips_binary():
+    """THE codec seam property: for every registered class, the binary
+    round trip of ``to_jsonable()`` is the identical wire dict — so
+    ``signal_from_jsonable`` reconstructs the identical signal, span
+    context included."""
+    covered = 0
+    for name in known_signal_classes():
+        sig = _instance_of(get_signal_class(name))
+        if sig is None:
+            continue
+        sig._obs_ctx = {"lc": 987654321, "o": "77@host", "r": "run-9"}
+        d = sig.to_jsonable()
+        got = binary.loads(binary.dumps(d))
+        assert got == d, f"{name}: binary round trip diverged"
+        # and through the one decode seam both ways
+        twin = signal_from_jsonable(got)
+        assert twin.equals(sig), f"{name}: decoded twin differs"
+        assert twin._obs_ctx == sig._obs_ctx
+        covered += 1
+    assert covered >= 10, f"only {covered} classes constructible"
+
+
+def test_binary_doubles_are_bit_exact():
+    rng = random.Random(17)
+    doubles = [struct.unpack("<d", struct.pack(
+        "<Q", rng.getrandbits(62)))[0] for _ in range(512)]
+    doc = {"version": 3, "mode": "delay", "H": 512,
+           "max_interval": 1e-9, "delays": doubles}
+    got = binary.loads(binary.dumps(doc))
+    for a, b in zip(got["delays"], doubles):
+        assert struct.pack("<d", a) == struct.pack("<d", b)
+    assert math.isnan(binary.loads(binary.dumps(float("nan"))))
+    assert binary.loads(binary.dumps(float("inf"))) == float("inf")
+
+
+def test_binary_value_fuzz_roundtrip():
+    rng = random.Random(23)
+
+    def rand_val(depth=0):
+        kinds = ["int", "float", "str", "bool", "none"] + (
+            ["list", "dict", "sig"] if depth < 3 else [])
+        k = rng.choice(kinds)
+        if k == "int":
+            return rng.choice([0, 1, -1, 127, -128, 2 ** 31 - 1,
+                               -2 ** 31, 2 ** 63 - 1, -2 ** 63,
+                               2 ** 90, rng.randint(-10 ** 9, 10 ** 9)])
+        if k == "float":
+            return rng.choice([0.0, -0.0, 1e-300, float("inf"),
+                               rng.random() * 1e9])
+        if k == "str":
+            return "".join(chr(rng.randint(32, 0x2FFF))
+                           for _ in range(rng.randint(0, 300)))
+        if k == "bool":
+            return rng.random() < 0.5
+        if k == "none":
+            return None
+        if k == "list":
+            return [rand_val(depth + 1)
+                    for _ in range(rng.randint(0, 6))]
+        if k == "sig":
+            d = {"class": "X", "entity": "e",
+                 "uuid": "u" * rng.randint(1, 300),
+                 "option": rand_val(depth + 1)}
+            if rng.random() < 0.7:
+                d["type"] = rng.choice(["event", "action", "weird"])
+            if rng.random() < 0.5:
+                d["ctx"] = {"lc": rng.randint(0, 2 ** 40), "o": "p@h"}
+            return d
+        return {f"k{i}": rand_val(depth + 1)
+                for i in range(rng.randint(0, 6))}
+
+    for i in range(400):
+        v = rand_val()
+        assert binary.loads(binary.dumps(v)) == v, f"case {i}"
+
+
+def test_signal_batch_encoding_is_smaller_and_shares_ctx():
+    evs = [PacketEvent.create("e0", "e0", "peer", hint=f"h{i % 32}")
+           for i in range(64)]
+    shared = {"lc": 5, "o": "p@h"}
+    for ev in evs:
+        ev._obs_ctx = shared  # the mint_many contract: ONE dict/burst
+    batch = [ev.to_jsonable() for ev in evs]
+    bb = binary.dumps(batch)
+    jb = json.dumps(batch).encode()
+    assert binary.loads(bb) == json.loads(jb)
+    # the template batch must beat JSON by a wide margin (ctx once,
+    # no per-event key strings)
+    assert len(bb) < 0.55 * len(jb), (len(bb), len(jb))
+
+
+def test_garbled_and_truncated_frames_raise_valueerror():
+    evs = [PacketEvent.create("e0", "e0", "p", hint=f"h{i}")
+           for i in range(16)]
+    data = binary.dumps([e.to_jsonable() for e in evs])
+    rng = random.Random(5)
+    buf = bytearray(data)
+    for _ in range(1500):
+        i = rng.randrange(len(buf))
+        old = buf[i]
+        buf[i] ^= rng.randrange(1, 256)
+        try:
+            binary.loads(bytes(buf))
+        except ValueError:
+            pass  # the only acceptable failure mode
+        buf[i] = old
+    for cut in range(0, len(data), 97):
+        try:
+            binary.loads(data[:cut])
+        except ValueError:
+            pass
+
+
+# -- negotiation + interop -------------------------------------------------
+
+
+def _uds_stack(tmp_path, name, **tx_kw):
+    from namazu_tpu.endpoint.hub import EndpointHub
+    from namazu_tpu.endpoint.uds import UdsEndpoint
+    from namazu_tpu.inspector.uds_transceiver import UdsTransceiver
+    from namazu_tpu.utils.mock_orchestrator import MockOrchestrator
+
+    path = str(tmp_path / f"{name}.sock")
+    hub = EndpointHub()
+    uds = UdsEndpoint(path, poll_timeout=2.0)
+    hub.add_endpoint(uds)
+    mock = MockOrchestrator(hub)
+    mock.start()
+    tx = UdsTransceiver("e0", path, poll_linger=0.005, **tx_kw)
+    tx.start()
+    return hub, uds, mock, tx
+
+
+def test_uds_negotiates_binary_and_json_client_stays_json(tmp_path):
+    hub, uds, mock, tx = _uds_stack(tmp_path, "nego")
+    try:
+        ch = tx.send_event(PacketEvent.create("e0", "e0", "p", hint="a"))
+        assert ch.get(timeout=10) is not None
+        assert tx._post_conn.codec == binary.CODEC_BINARY
+        assert metrics.registry().value(
+            "nmz_codec_negotiations_total",
+            codec=binary.CODEC_BINARY) >= 1.0
+        # byte ledger: the negotiated wire counted under its codec
+        doc = metrics.registry().to_jsonable()
+        codecs = {(s["labels"].get("codec"))
+                  for m in doc["metrics"]
+                  if m["name"] == "nmz_wire_bytes_total"
+                  for s in m["samples"]}
+        assert binary.CODEC_BINARY in codecs
+    finally:
+        tx.shutdown()
+        mock.shutdown()
+        hub.shutdown()
+
+    # a json-pinned client on the same endpoint never upgrades
+    hub, uds, mock, tx = _uds_stack(tmp_path, "nego2", codec="json")
+    try:
+        ch = tx.send_event(PacketEvent.create("e0", "e0", "p", hint="b"))
+        assert ch.get(timeout=10) is not None
+        assert tx._post_conn.codec == binary.CODEC_JSON
+    finally:
+        tx.shutdown()
+        mock.shutdown()
+        hub.shutdown()
+
+
+def test_mixed_codec_clients_share_one_endpoint(tmp_path):
+    from namazu_tpu.endpoint.hub import EndpointHub
+    from namazu_tpu.endpoint.uds import UdsEndpoint
+    from namazu_tpu.inspector.uds_transceiver import UdsTransceiver
+    from namazu_tpu.utils.mock_orchestrator import MockOrchestrator
+
+    path = str(tmp_path / "mixed.sock")
+    hub = EndpointHub()
+    hub.add_endpoint(UdsEndpoint(path, poll_timeout=2.0))
+    mock = MockOrchestrator(hub)
+    mock.start()
+    txs = {
+        "jent": UdsTransceiver("jent", path, codec="json",
+                               poll_linger=0.005),
+        "bent": UdsTransceiver("bent", path, codec="auto",
+                               poll_linger=0.005),
+    }
+    try:
+        for tx in txs.values():
+            tx.start()
+        chans = []
+        for i in range(12):
+            for ent, tx in txs.items():
+                chans.append(tx.send_event(
+                    PacketEvent.create(ent, ent, "p", hint=f"h{i}")))
+        for ch in chans:
+            assert ch.get(timeout=10) is not None
+        assert txs["jent"]._post_conn.codec == binary.CODEC_JSON
+        assert txs["bent"]._post_conn.codec == binary.CODEC_BINARY
+    finally:
+        for tx in txs.values():
+            tx.shutdown()
+        mock.shutdown()
+        hub.shutdown()
+
+
+def test_pre_binary_rest_server_keeps_auto_client_on_json(tmp_path):
+    """Interop: a server that never advertises the codec (the
+    pre-binary peer) serves an auto client a complete run on pure
+    JSON — negotiation is the piggyback, absence means never
+    upgrade."""
+    from namazu_tpu.orchestrator import Orchestrator
+    from namazu_tpu.inspector.rest_transceiver import RestTransceiver
+    from namazu_tpu.policy import create_policy
+
+    cfg = Config({"rest_port": 0, "run_id": "prebin",
+                  "explore_policy": "random",
+                  "explore_policy_param": {
+                      "seed": 2, "min_interval": "1ms",
+                      "max_interval": "1ms",
+                      "fault_action_probability": 0.0,
+                      "shell_action_interval": 0}})
+    policy = create_policy("random")
+    policy.load_config(cfg)
+    orc = Orchestrator(cfg, policy, collect_trace=True)
+    orc.start()
+    rest = orc.hub.endpoint("rest")
+    rest.advertise_codec = False  # simulate the pre-binary server
+    tx = RestTransceiver("e0", f"http://127.0.0.1:{rest.port}",
+                         use_batch=True, flush_window=0.0,
+                         poll_linger=0.005, codec="auto")
+    tx.start()
+    try:
+        chans = [tx.send_event(PacketEvent.create("e0", "e0", "p",
+                                                  hint=f"h{i}"))
+                 for i in range(8)]
+        for ch in chans:
+            assert ch.get(timeout=15) is not None
+        assert tx._post_conn.accepts_binary is False
+        assert tx._codec_down is False  # never upgraded, never burned
+    finally:
+        tx.shutdown()
+        orc.shutdown()
+    assert len(orc.trace) == 8  # loss-free on the legacy wire
+
+
+def test_binary_400_downgrades_and_resends():
+    """A non-garble 400 answered to a binary request = the peer cannot
+    take this codec: downgrade to JSON permanently, resend the SAME
+    chunk, lose nothing."""
+    from namazu_tpu.inspector.rest_transceiver import RestTransceiver
+
+    tx = RestTransceiver("dg0", "http://127.0.0.1:1", use_batch=True,
+                         flush_window=0.0, codec="binary")
+    sent = []
+
+    def fake(method, path, body=None, codec="json"):
+        sent.append((codec, body[:2]))
+        if codec == binary.CODEC_BINARY:
+            tx._post_conn.last_codec_error = None
+            return 400, b'{"error": "cannot decode"}'
+        return 200, b"{}"
+
+    tx._post_conn.request = fake
+    events = [PacketEvent.create("dg0", "dg0", "p", hint="h")]
+    tx._post_batch_once(events, "dg0")
+    assert tx._codec_down is True
+    assert [c for c, _ in sent] == [binary.CODEC_BINARY,
+                                    binary.CODEC_JSON]
+    assert sent[0][1] == binary.MAGIC  # really was a binary body
+
+
+def test_garbled_binary_retries_in_place_without_downgrade(tmp_path):
+    """The wire.binary.garble chaos contract end to end over REST: the
+    server 400s the damaged payload tagged ``garbled``, the bounded
+    retry resends a clean copy on the SAME codec, dispatch is
+    exactly-once, and the connection was never severed."""
+    from namazu_tpu.orchestrator import Orchestrator
+    from namazu_tpu.inspector.rest_transceiver import RestTransceiver
+    from namazu_tpu.policy import create_policy
+
+    cfg = Config({"rest_port": 0, "run_id": "garble",
+                  "explore_policy": "random",
+                  "explore_policy_param": {
+                      "seed": 3, "min_interval": "1ms",
+                      "max_interval": "1ms",
+                      "fault_action_probability": 0.0,
+                      "shell_action_interval": 0}})
+    policy = create_policy("random")
+    policy.load_config(cfg)
+    orc = Orchestrator(cfg, policy, collect_trace=True)
+    orc.start()
+    port = orc.hub.endpoint("rest").port
+    tx = RestTransceiver("e0", f"http://127.0.0.1:{port}",
+                         use_batch=True, flush_window=0.0,
+                         poll_linger=0.005, codec="auto",
+                         backoff_step=0.02, backoff_max=0.1)
+    tx.start()
+    plan = chaos.install(FaultPlan(1, {"wire.binary.garble":
+                                       {"at": [0]}}))
+    try:
+        chans = [tx.send_event(PacketEvent.create("e0", "e0", "p",
+                                                  hint=f"h{i}"))
+                 for i in range(6)]
+        for ch in chans:
+            assert ch.get(timeout=15) is not None
+        assert plan.fired("wire.binary.garble") == 1
+        assert tx._codec_down is False  # garble never downgrades
+    finally:
+        chaos.clear()
+        tx.shutdown()
+        orc.shutdown()
+    from collections import Counter
+
+    counts = Counter(a.event_uuid for a in orc.trace if a.event_uuid)
+    assert len(counts) == 6 and all(c == 1 for c in counts.values())
+
+
+# -- trace-differ equivalence ---------------------------------------------
+
+ENTITIES = ("eqa", "eqb")
+HINTS = tuple(f"k{i}" for i in range(6))
+
+
+def _run_eq(run_id, *, codec="auto", edge=False, shard_pool=None,
+            delays=None, burst=False):
+    from namazu_tpu.orchestrator import Orchestrator
+    from namazu_tpu.inspector.rest_transceiver import RestTransceiver
+    from namazu_tpu.policy import create_policy
+
+    cfg = Config({"rest_port": 0, "run_id": run_id,
+                  "explore_policy": "tpu_search",
+                  "explore_policy_param": {
+                      "search_on_start": False, "max_interval": 0,
+                      "seed": 7}})
+    policy = create_policy("tpu_search")
+    policy.load_config(cfg)
+    policy.install_table(delays if delays is not None
+                         else [0.0] * policy.H, source="test")
+    orc = Orchestrator(cfg, policy, collect_trace=True)
+    orc.start()
+    port = orc.hub.endpoint("rest").port
+    txs = {e: RestTransceiver(e, f"http://127.0.0.1:{port}",
+                              use_batch=True, flush_window=0.0,
+                              poll_linger=0.005, edge=edge,
+                              codec=codec, shard_pool=shard_pool,
+                              backhaul_window=0.01)
+           for e in ENTITIES}
+    for tx in txs.values():
+        tx.start()
+        if edge:
+            assert tx.sync_table() is not None
+    try:
+        if burst:
+            handles = []
+            for e in ENTITIES:
+                evs = [PacketEvent.create(e, e, "peer", hint=h)
+                       for h in HINTS]
+                handles.append(txs[e].send_events_burst(evs))
+            for h in handles:
+                h.get_all(timeout=15)
+        else:
+            chans = []
+            for h in HINTS:
+                for e in ENTITIES:
+                    ev = PacketEvent.create(e, e, "peer", hint=h)
+                    chans.append(txs[e].send_event(ev))
+            for ch in chans:
+                assert ch.get(timeout=15) is not None
+    finally:
+        for tx in txs.values():
+            tx.shutdown()
+        orc.shutdown()
+    run = obs.trace_run(run_id)
+    assert run is not None
+    return [entry["json"] for entry in run.snapshot()["records"]]
+
+
+def _delays_by_identity(docs):
+    return {(d["entity"], d["hint"]): d["decision"]["delay"]
+            for d in docs if d.get("decision")}
+
+
+def test_binary_vs_json_runs_are_trace_equivalent():
+    """Order AND delays identical across the codec switch — the codec
+    moves bytes, never semantics."""
+    docs_j = _run_eq("eq-json", codec="json")
+    docs_b = _run_eq("eq-binary", codec="binary")
+    diff = export.diff_order(export.order_lines_from_docs(docs_j),
+                             export.order_lines_from_docs(docs_b),
+                             "json", "binary")
+    assert diff == "", f"dispatch order diverged:\n{diff}"
+    assert _delays_by_identity(docs_j) == _delays_by_identity(docs_b)
+
+
+def test_sharded_vs_single_dispatcher_trace_equivalent():
+    """Order AND delays identical between one EdgeDispatcher per
+    transceiver and the EdgeShardPool — sharding moves threads, never
+    decisions."""
+    from namazu_tpu.inspector.edge import EdgeShardPool
+
+    docs_one = _run_eq("eq-edge1", edge=True)
+    pool = EdgeShardPool(2, backhaul_window=0.01)
+    docs_sh = _run_eq("eq-edge2", edge=True, shard_pool=pool)
+    diff = export.diff_order(export.order_lines_from_docs(docs_one),
+                             export.order_lines_from_docs(docs_sh),
+                             "single", "sharded")
+    assert diff == "", f"dispatch order diverged:\n{diff}"
+    assert _delays_by_identity(docs_one) == _delays_by_identity(docs_sh)
+    # both really decided at the edge
+    for docs in (docs_one, docs_sh):
+        assert all((d.get("decision") or {}).get("decision_source")
+                   == "edge" for d in docs if d.get("decision"))
+
+
+def test_sharded_nonzero_delays_decisions_bit_equal():
+    """Nonzero per-hint delays through the parked/release path: the
+    pool's decisions equal the single dispatcher's per identity (the
+    release ORDER across shard threads is timing, the DECISIONS are
+    the contract)."""
+    from namazu_tpu.inspector.edge import EdgeShardPool
+    from namazu_tpu.policy import create_policy
+
+    probe = create_policy("tpu_search")
+    H = probe.H
+    delays = [0.0] * H
+    # give half the hint buckets a small positive delay
+    for i in range(0, H, 2):
+        delays[i] = 0.012
+    docs_one = _run_eq("eqn-edge1", edge=True, delays=delays)
+    pool = EdgeShardPool(2, backhaul_window=0.01)
+    docs_sh = _run_eq("eqn-edge2", edge=True, shard_pool=pool,
+                      delays=delays)
+    d1, d2 = _delays_by_identity(docs_one), _delays_by_identity(docs_sh)
+    assert d1 == d2 and len(d1) == len(ENTITIES) * len(HINTS)
+
+
+# -- burst API -------------------------------------------------------------
+
+
+def test_burst_grouped_verdict_and_parked_actions():
+    from namazu_tpu.inspector.edge import BurstAccept
+    from namazu_tpu.orchestrator import Orchestrator
+    from namazu_tpu.inspector.rest_transceiver import RestTransceiver
+    from namazu_tpu.policy import create_policy
+    from namazu_tpu.policy.replayable import fnv64a
+
+    cfg = Config({"rest_port": 0, "run_id": "burst-mixed",
+                  "explore_policy": "tpu_search",
+                  "explore_policy_param": {
+                      "search_on_start": False, "max_interval": 0,
+                      "seed": 7}})
+    policy = create_policy("tpu_search")
+    policy.load_config(cfg)
+    H = policy.H
+    ripe_hint, parked_hint = "zero-hint", "slow-hint"
+    delays = [0.0] * H
+    parked_bucket = fnv64a(
+        f"bm->peer:{parked_hint}".encode()) % H
+    delays[parked_bucket] = 0.03
+    policy.install_table(delays, source="test")
+    orc = Orchestrator(cfg, policy, collect_trace=True)
+    orc.start()
+    port = orc.hub.endpoint("rest").port
+    tx = RestTransceiver("bm", f"http://127.0.0.1:{port}",
+                         use_batch=True, flush_window=0.0,
+                         poll_linger=0.005, edge=True,
+                         backhaul_window=0.01)
+    tx.start()
+    assert tx.sync_table() is not None
+    try:
+        evs = ([PacketEvent.create("bm", "bm", "peer", hint=ripe_hint)
+                for _ in range(6)]
+               + [PacketEvent.create("bm", "bm", "peer",
+                                     hint=parked_hint)
+                  for _ in range(2)])
+        t0 = time.monotonic()
+        handle = tx.send_events_burst(evs)
+        items = handle.get_all(timeout=15)
+        assert time.monotonic() - t0 >= 0.02  # waited out the parked
+        groups = [i for i in items if isinstance(i, BurstAccept)]
+        actions = [i for i in items if not isinstance(i, BurstAccept)]
+        assert len(groups) == 1
+        assert groups[0].count == 6
+        assert sorted(groups[0].uuids) == sorted(
+            e.uuid for e in evs[:6])
+        assert groups[0].table_version == tx._edge.table_version
+        assert len(actions) == 2  # parked events arrive as actions
+        assert {a.event_uuid for a in actions} == {
+            e.uuid for e in evs[6:]}
+    finally:
+        tx.shutdown()
+        orc.shutdown()
+    run = obs.trace_run("burst-mixed")
+    docs = [e["json"] for e in run.snapshot()["records"]]
+    by_uuid = {d["event"]: d for d in docs}
+    # the backhaul reconciled a complete trace with per-event decisions
+    assert set(by_uuid) == {e.uuid for e in evs}
+    for e in evs:
+        dec = by_uuid[e.uuid]["decision"]
+        assert dec["decision_source"] == "edge"
+        want = 0.03 if e.replay_hint().endswith(parked_hint) else 0.0
+        assert dec["delay"] == want
+
+
+def test_burst_without_table_goes_central():
+    """No published table synced: the whole burst rides the central
+    wire and every event is answered with a real action."""
+    from namazu_tpu.inspector.edge import BurstAccept
+    from namazu_tpu.orchestrator import Orchestrator
+    from namazu_tpu.inspector.rest_transceiver import RestTransceiver
+    from namazu_tpu.policy import create_policy
+
+    cfg = Config({"rest_port": 0, "run_id": "burst-central",
+                  "explore_policy": "random",
+                  "explore_policy_param": {
+                      "seed": 4, "min_interval": "1ms",
+                      "max_interval": "1ms",
+                      "fault_action_probability": 0.0,
+                      "shell_action_interval": 0}})
+    policy = create_policy("random")
+    policy.load_config(cfg)
+    orc = Orchestrator(cfg, policy, collect_trace=True)
+    orc.start()
+    port = orc.hub.endpoint("rest").port
+    tx = RestTransceiver("bc", f"http://127.0.0.1:{port}",
+                         use_batch=True, flush_window=0.0,
+                         poll_linger=0.005, edge=True)
+    tx.start()  # edge armed but dormant: nothing published
+    try:
+        evs = [PacketEvent.create("bc", "bc", "peer", hint=f"h{i}")
+               for i in range(8)]
+        items = tx.send_events_burst(evs).get_all(timeout=15)
+        assert not any(isinstance(i, BurstAccept) for i in items)
+        assert {a.event_uuid for a in items} == {e.uuid for e in evs}
+    finally:
+        tx.shutdown()
+        orc.shutdown()
+
+
+# -- shard pool ------------------------------------------------------------
+
+
+def test_shard_pool_hashing_and_lifecycle():
+    from namazu_tpu.inspector.edge import EdgeShardPool
+    from namazu_tpu.policy.replayable import fnv64a
+
+    pool = EdgeShardPool(3, backhaul_window=0.01)
+    handles = []
+    for i in range(9):
+        ent = f"ent{i}"
+        h = pool.register(ent, deliver=lambda a: None,
+                          deliver_many=None,
+                          fetch_table=lambda: (0, None),
+                          send_backhaul=lambda e, items: None)
+        assert h.shard is pool.shards[
+            fnv64a(ent.encode()) % 3]
+        handles.append(h)
+    assert not pool.closed
+    for h in handles:
+        h.shutdown()
+    assert pool.closed  # last unregister closes the pool
+    # a closed pool refuses registration
+    with pytest.raises(RuntimeError):
+        pool.register("late", deliver=lambda a: None,
+                      deliver_many=None,
+                      fetch_table=lambda: (0, None),
+                      send_backhaul=lambda e, items: None)
+
+
+# -- shared-memory ring ----------------------------------------------------
+
+
+def test_shm_ring_roundtrip_wrap_and_full(tmp_path):
+    from namazu_tpu.endpoint.shm import ShmRing
+
+    path = str(tmp_path / "ring")
+    ring = ShmRing(path, capacity=256, create=True)
+    reader = ShmRing(path)
+    try:
+        payloads = [os.urandom(60) for _ in range(40)]
+        written = 0
+        read_back = []
+        for p in payloads:
+            # drive the ring around its wrap point several times
+            while not ring.try_write_frame(p, binary=True):
+                frame = reader.try_read_frame()
+                assert frame is not None
+                read_back.append(frame)
+            written += 1
+        while len(read_back) < written:
+            frame = reader.try_read_frame()
+            assert frame is not None
+            read_back.append(frame)
+        assert [p for p, _ in read_back] == payloads
+        assert all(b for _, b in read_back)
+        # an oversized frame is refused, not wedged
+        assert ring.try_write_frame(b"x" * 300) is False
+    finally:
+        reader.close()
+        ring.close()
+        ring.unlink()
+
+
+def test_shm_transceiver_exactly_once_with_accounted_drop(tmp_path):
+    """Events ride the ring into the same dedupe + hub path; a
+    ``wire.shm.drop`` burst is the accounted-loss case — lost ==
+    fired, everything else exactly-once."""
+    from namazu_tpu.endpoint.hub import EndpointHub
+    from namazu_tpu.endpoint.uds import UdsEndpoint
+    from namazu_tpu.inspector.uds_transceiver import UdsTransceiver
+    from namazu_tpu.utils.mock_orchestrator import MockOrchestrator
+
+    path = str(tmp_path / "shm.sock")
+    hub = EndpointHub()
+    hub.add_endpoint(UdsEndpoint(path, poll_timeout=2.0))
+    mock = MockOrchestrator(hub)
+    mock.start()
+    tx = UdsTransceiver("e0", path, shm=True, poll_linger=0.005,
+                        post_attempts=1)
+    tx.start()
+    assert tx._shm_ring is not None
+    plan = chaos.install(FaultPlan(9, {"wire.shm.drop": {"at": [2]}}))
+    chans = {}
+    try:
+        for i in range(10):
+            ev = PacketEvent.create("e0", "e0", "p", hint=f"h{i}")
+            chans[ev.uuid] = tx.send_event(ev)
+        dropped = plan.fired("wire.shm.drop")
+        assert dropped == 1
+        answered = 0
+        deadline = time.monotonic() + 15
+        while answered < len(chans) - dropped \
+                and time.monotonic() < deadline:
+            answered = 0
+            for ch in chans.values():
+                if not ch.empty():
+                    answered += 1
+            time.sleep(0.02)
+        assert answered == len(chans) - dropped
+    finally:
+        chaos.clear()
+        tx.shutdown()
+        mock.shutdown()
+        hub.shutdown()
+
+
+def test_shm_full_ring_falls_back_to_acked_op_wire(tmp_path):
+    from namazu_tpu.endpoint.hub import EndpointHub
+    from namazu_tpu.endpoint.uds import UdsEndpoint
+    from namazu_tpu.inspector.uds_transceiver import UdsTransceiver
+    from namazu_tpu.utils.mock_orchestrator import MockOrchestrator
+
+    path = str(tmp_path / "full.sock")
+    hub = EndpointHub()
+    hub.add_endpoint(UdsEndpoint(path, poll_timeout=2.0))
+    mock = MockOrchestrator(hub)
+    mock.start()
+    tx = UdsTransceiver("e0", path, shm=True, poll_linger=0.005)
+    tx.start()
+    try:
+        # shrink the mapped ring to something trivially overflowable:
+        # the transceiver must fall back to the acked uds op, loss-free
+        class _Tiny:
+            def try_write_frame(self, payload, binary=True):
+                return False
+
+            def pending(self):
+                return 0
+
+            def close(self):
+                pass
+
+        tx._shm_ring = _Tiny()
+        chans = [tx.send_event(PacketEvent.create("e0", "e0", "p",
+                                                  hint=f"h{i}"))
+                 for i in range(6)]
+        for ch in chans:
+            assert ch.get(timeout=10) is not None
+    finally:
+        tx.shutdown()
+        mock.shutdown()
+        hub.shutdown()
+
+
+# -- review-hardening regressions ------------------------------------------
+
+
+def test_uds_transceiver_constructs_with_edge_shards(tmp_path):
+    """The uds twin of the sharded-edge knob really constructs (a
+    missing module import made ``edge_shards>1`` a NameError on the
+    uds wire only — no test passed through new_transceiver's kwargs)."""
+    from namazu_tpu.inspector.transceiver import new_transceiver
+
+    tx = new_transceiver(f"uds://{tmp_path}/none.sock", "e0",
+                         edge=True, edge_shards=2, codec="auto")
+    assert tx._edge is not None and tx._edge.shard is not None
+    tx._edge.shutdown()
+
+
+def test_batch_ctx_is_never_fabricated_for_ctxless_rows():
+    """A batch mixing ctx-carrying and ctx-LESS events must decode
+    with the absence preserved — the template-ctx optimization only
+    applies when every row shares the exact ctx (a fabricated clock
+    would invent a happens-before relation in the causality graph)."""
+    evs = [PacketEvent.create("e0", "e0", "p", hint=f"h{i}")
+           for i in range(4)]
+    shared = {"lc": 9, "o": "p@h"}
+    for ev in evs[:3]:
+        ev._obs_ctx = shared
+    batch = [ev.to_jsonable() for ev in evs]
+    assert "ctx" not in batch[3]
+    got = binary.loads(binary.dumps(batch))
+    assert got == batch
+    assert "ctx" not in got[3] and got[0]["ctx"] == shared
+    # and the all-shared batch still rides the template (stays small)
+    for ev in evs:
+        ev._obs_ctx = shared
+    batch = [ev.to_jsonable() for ev in evs]
+    assert binary.loads(binary.dumps(batch)) == batch
+
+
+def test_pool_backhaul_for_departed_entity_drops_not_wedges():
+    """A backhaul record enqueued for an entity whose route is gone
+    (a release that slipped past its unregister drain) must be
+    DROPPED — re-queueing it forever would wedge every other entity's
+    trace records behind it on the shared shard."""
+    from namazu_tpu.inspector.edge import EdgeShardPool
+
+    pool = EdgeShardPool(1, backhaul_window=30.0)
+    delivered = []
+    h_keep = pool.register("keep", deliver=lambda a: None,
+                           deliver_many=None,
+                           fetch_table=lambda: (0, None),
+                           send_backhaul=lambda e, items:
+                               delivered.extend(items) or 0)
+    shard = pool.shards[0]
+    ev_gone = PacketEvent.create("gone", "gone", "p", hint="g")
+    ev_keep = PacketEvent.create("keep", "keep", "p", hint="k")
+    shard._enqueue_backhaul([(ev_gone, 1, 0.0, 0.0, 0.0, 0.0, 0.0),
+                            (ev_keep, 1, 0.0, 0.0, 0.0, 0.0, 0.0)])
+    assert shard._flush_backhaul_once() is True
+    assert [i["event"]["entity"] for i in delivered] == ["keep"]
+    assert shard.pending_backhaul() == 0  # nothing wedged
+    h_keep.shutdown()
+
+
+def test_shm_ring_full_counter_really_counts(tmp_path):
+    from namazu_tpu.endpoint.hub import EndpointHub
+    from namazu_tpu.endpoint.uds import UdsEndpoint
+    from namazu_tpu.inspector.uds_transceiver import UdsTransceiver
+    from namazu_tpu.utils.mock_orchestrator import MockOrchestrator
+
+    path = str(tmp_path / "fullc.sock")
+    hub = EndpointHub()
+    hub.add_endpoint(UdsEndpoint(path, poll_timeout=2.0))
+    mock = MockOrchestrator(hub)
+    mock.start()
+    tx = UdsTransceiver("e0", path, shm=True, poll_linger=0.005)
+    tx.start()
+    try:
+        class _Full:
+            def try_write_frame(self, payload, binary=True):
+                return False
+
+            def pending(self):
+                return 0
+
+            def close(self):
+                pass
+
+        tx._shm_ring = _Full()
+        ch = tx.send_event(PacketEvent.create("e0", "e0", "p",
+                                              hint="h"))
+        assert ch.get(timeout=10) is not None
+        assert metrics.registry().value(
+            "nmz_shm_ring_full_total", entity="e0") == 1.0
+    finally:
+        tx.shutdown()
+        mock.shutdown()
+        hub.shutdown()
+
+
+def test_parked_burst_actions_carry_event_arrived():
+    """Parked burst events must release actions stamped with the
+    decision wall time, like every other edge path (the burst loop
+    used to skip the arrival stamp)."""
+    from namazu_tpu.inspector.edge import EdgeDispatcher
+    import queue as _q
+
+    delivered = []
+    d = EdgeDispatcher("pa0", deliver=delivered.append,
+                       fetch_table=lambda: (0, None),
+                       send_backhaul=lambda e, items: 0,
+                       backhaul_window=30.0)
+    d._table = __import__(
+        "namazu_tpu.inspector.edge", fromlist=["EdgeTable"]).EdgeTable(
+        {"mode": "delay", "version": 1, "H": 4, "max_interval": 0.02,
+         "delays": [0.02, 0.02, 0.02, 0.02]})
+    ev = PacketEvent.create("pa0", "pa0", "p", hint="x")
+    chan = _q.SimpleQueue()
+    assert d.try_dispatch_burst([ev], chan) == []
+    deadline = time.monotonic() + 5
+    while not delivered and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert delivered and delivered[0].event_arrived is not None
+    d.shutdown()
+
+
+def test_factory_edge_shards_one_builds_a_pool(tmp_path):
+    """edge_shards=1 means a real single-shard pool (the bench's
+    semantics), not a silent fallback to per-entity dispatchers."""
+    from namazu_tpu.inspector.edge import ShardedEdge
+    from namazu_tpu.inspector.transceiver import new_transceiver
+
+    tx = new_transceiver(f"uds://{tmp_path}/one.sock", "e0",
+                         edge=True, edge_shards=1)
+    assert isinstance(tx._edge, ShardedEdge)
+    assert tx._edge.pool.n_shards == 1
+    tx._edge.shutdown()
+
+
+def test_shm_ring_reset_renegotiates_after_restart_signature(tmp_path):
+    """A receive-loop reconnect (server-restart signature) must drop
+    the orphan ring and negotiate a fresh one — writes into the dead
+    server's mapping would be note_posted but never drained."""
+    from namazu_tpu.endpoint.hub import EndpointHub
+    from namazu_tpu.endpoint.uds import UdsEndpoint
+    from namazu_tpu.inspector.uds_transceiver import UdsTransceiver
+    from namazu_tpu.utils.mock_orchestrator import MockOrchestrator
+
+    path = str(tmp_path / "reset.sock")
+    hub = EndpointHub()
+    hub.add_endpoint(UdsEndpoint(path, poll_timeout=2.0))
+    mock = MockOrchestrator(hub)
+    mock.start()
+    tx = UdsTransceiver("e0", path, shm=True, poll_linger=0.005)
+    tx.start()
+    try:
+        old_ring = tx._shm_ring
+        assert old_ring is not None
+        tx._reset_shm()
+        assert tx._shm_ring is not None
+        assert tx._shm_ring is not old_ring
+        assert tx._shm_ring.path != old_ring.path
+        # and the fresh ring actually carries traffic
+        ch = tx.send_event(PacketEvent.create("e0", "e0", "p",
+                                              hint="post-reset"))
+        assert ch.get(timeout=10) is not None
+    finally:
+        tx.shutdown()
+        mock.shutdown()
+        hub.shutdown()
