@@ -220,9 +220,12 @@ def gate_record(current: dict, history: list,
     # codec and edge_shards joined in round 9: a JSON-wire figure must
     # never baseline a binary-wire one, nor a 1-shard run an N-shard
     # one — they are different machines
+    # "runs" joined in round 10 (tenancy plane): an 8-tenant aggregate
+    # figure must never baseline against a single-run one
     CONFIG_KEYS = ("n_events", "n_entities", "batch_max",
                    "flush_window", "poll_linger", "gc_disabled",
-                   "telemetry", "codec", "edge_shards", "edge_events")
+                   "telemetry", "codec", "edge_shards", "edge_events",
+                   "runs")
 
     def _mode(rec):
         return rec.get("transport_mode") or rec.get("mode")
@@ -468,6 +471,195 @@ def run_pipeline(n_events: int, n_entities: int, use_batch: bool,
     return n_events / elapsed if elapsed > 0 else float("inf")
 
 
+#: the round-8 single-run batched central-wire figure (BENCH_r08.json)
+#: — the reference the multi-run aggregate criterion is stated against
+#: (ROADMAP item 1: >= 10x aggregate across 8+ runs on one orchestrator)
+R08_BATCHED_BASELINE = 7772.8
+
+
+def run_multi_pipeline(runs: int, n_events: int, n_entities: int,
+                       flush_window: float, batch_max: int,
+                       run_id: str, poll_linger: float = 0.02,
+                       codec: str = "auto", wire: str = "uds",
+                       shm: bool = True, edge: bool = False,
+                       edge_shards: int = 0, extras: dict = None):
+    """N concurrent namespaced pipelines against ONE TenantOrchestrator
+    (doc/tenancy.md): each run leases its own namespace, drives
+    ``n_events`` through the batched REST wire under its X-Nmz-Run
+    header (entity names deliberately IDENTICAL across runs — namespace
+    isolation is the machinery under test), and the aggregate
+    events/s across all runs is the figure. Returns
+    ``(aggregate_rate, per_run_rates)``."""
+    import threading
+
+    from namazu_tpu.policy import create_policy
+    from namazu_tpu.signal import PacketEvent
+    from namazu_tpu.tenancy.host import TenantOrchestrator
+    from namazu_tpu.utils.config import Config
+
+    runs = max(1, int(runs))
+    ns_param = {"search_on_start": False, "max_interval": 0, "seed": 7}
+    uds_path = f"/tmp/nmz-bench-multi-{os.getpid()}.sock"
+    cfg = Config({
+        "rest_port": 0,
+        "run_id": run_id,
+        "explore_policy": "tpu_search",
+        "explore_policy_param": dict(ns_param),
+        # every tenant holds ~2 keep-alive connections per entity; the
+        # bounded pool must not queue the bench's own steady state
+        "rest_max_threads": max(64, 4 * runs * max(1, n_entities)),
+    })
+    if wire == "uds":
+        cfg.set("uds_path", uds_path)
+    policy = create_policy("tpu_search")
+    policy.load_config(cfg)
+    if edge:
+        # the zero-RTT serving plane under tenancy: one published
+        # zero-delay table, per-namespace backhaul reconciliation —
+        # each tenant's records land in its own pinned flight-recorder
+        # run while decisions never touch the central GIL-bound path
+        policy.install_table([0.0] * policy.H, source="bench")
+    host = TenantOrchestrator(cfg, policy, collect_trace=False)
+    host.start()
+    port = host.hub.endpoint("rest").port
+    url = f"http://127.0.0.1:{port}"
+    leases = [host.registry.lease(
+        f"bench-r{j}", ttl_s=600.0, policy="tpu_search",
+        policy_param=dict(ns_param), collect_trace=False)
+        for j in range(runs)]
+    entities = [f"bench-{i}" for i in range(max(1, n_entities))]
+    per_run_elapsed = [0.0] * runs
+    per_run_done = [0.0] * runs
+    errors = []
+    barrier = threading.Barrier(runs + 1)
+
+    pools = {}
+    if edge and edge_shards >= 1:
+        from namazu_tpu.inspector.edge import EdgeShardPool
+
+        # one shard pool per tenant run (a tenant's edge shards are its
+        # own, like its policy); entities hash across each pool's cores
+        pools = {j: EdgeShardPool(edge_shards, backhaul_window=30.0)
+                 for j in range(runs)}
+
+    def make_tx(entity: str, j: int):
+        if wire == "uds":
+            from namazu_tpu.inspector.uds_transceiver import UdsTransceiver
+
+            # the consolidated framed serving plane (endpoint/framed.py
+            # selector core): no HTTP parse on the hot path, the shm
+            # ring for the post side — the wire the tenancy plane is
+            # built to saturate
+            return UdsTransceiver(
+                entity, uds_path, batch_max=batch_max,
+                poll_batch=2 * batch_max, poll_linger=poll_linger,
+                codec=codec, shm=shm and not edge, edge=edge,
+                shard_pool=pools.get(j),
+                backhaul_window=30.0 if edge else 0.05,
+                run_ns=f"bench-r{j}")
+        from namazu_tpu.inspector.rest_transceiver import RestTransceiver
+
+        return RestTransceiver(
+            entity, url, use_batch=True, flush_window=flush_window,
+            batch_max=batch_max, poll_batch=2 * batch_max,
+            poll_linger=poll_linger, codec=codec, edge=edge,
+            shard_pool=pools.get(j),
+            backhaul_window=30.0 if edge else max(flush_window, 0.02),
+            run_ns=f"bench-r{j}")
+
+    def drive(j: int) -> None:
+        txs = {e: make_tx(e, j) for e in entities}
+        try:
+            for tx in txs.values():
+                tx.start()
+                if edge:
+                    version = tx.sync_table()
+                    assert version is not None and tx.edge_active, \
+                        "multi-run edge bench: table sync failed"
+            # pre-minted bursts of batch_max (the batched-wire
+            # workload shape: a burst costs one post_batch op / one
+            # flush, exactly like the single-run batched path under
+            # load)
+            bursts = []
+            for e_idx, e in enumerate(entities):
+                evs = [PacketEvent.create(e, e, "peer",
+                                          hint=f"h{i % 64}")
+                       for i in range(e_idx, n_events, len(entities))]
+                bursts.extend((txs[e], evs[i:i + batch_max])
+                              for i in range(0, len(evs), batch_max))
+            barrier.wait()
+            t0 = time.perf_counter()
+            chans = []
+            handles = []
+            if edge and pools:
+                for tx, burst in bursts:
+                    handles.append(tx.send_events_burst(burst))
+            else:
+                for tx, burst in bursts:
+                    chans.extend(tx.send_events(burst))
+            for h in handles:
+                h.get_all(timeout=240)
+            for ch in chans:
+                ch.get(timeout=240)
+            done = time.perf_counter()
+            per_run_elapsed[j] = done - t0
+            per_run_done[j] = done
+        except Exception as e:  # surface, don't hang the barrier
+            errors.append((j, e))
+            try:
+                barrier.abort()
+            except Exception:
+                pass
+        finally:
+            for tx in txs.values():
+                tx.shutdown()
+
+    threads = [threading.Thread(target=drive, args=(j,),
+                                name=f"bench-run-{j}", daemon=True)
+               for j in range(runs)]
+    gc_was_enabled = gc.isenabled()
+    try:
+        for t in threads:
+            t.start()
+        if gc_was_enabled:
+            gc.disable()
+        barrier.wait()  # all transceivers connected: the timed window
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join(timeout=300)
+        # the aggregate window is first send -> LAST run's final ack;
+        # transceiver shutdown (deferred backhaul flush, by design
+        # asynchronous) stays outside it, same convention as the
+        # single-run epilogue
+        elapsed = (max(per_run_done) - t0) if any(per_run_done) else 0.0
+    finally:
+        if gc_was_enabled:
+            gc.enable()
+        for lease in leases:
+            try:
+                host.registry.release(lease["lease_id"],
+                                      want_trace=False)
+            except Exception:
+                pass
+        host.shutdown()
+    hung = [j for j, t in enumerate(threads) if t.is_alive()]
+    if hung:
+        # a run that never finished must fail the bench loudly — its
+        # events would otherwise inflate the aggregate (and poison the
+        # gate baseline) while contributing no completed dispatches
+        raise RuntimeError(f"multi-run bench: run(s) {hung} did not "
+                           "finish within the join window")
+    if errors:
+        raise RuntimeError(f"multi-run bench failed: {errors[0][1]!r} "
+                           f"(run {errors[0][0]})")
+    per_run = [n_events / e if e > 0 else float("inf")
+               for e in per_run_elapsed]
+    aggregate = runs * n_events / elapsed if elapsed > 0 else float("inf")
+    if extras is not None:
+        extras["per_run_events_per_sec"] = [round(r, 1) for r in per_run]
+    return aggregate, per_run
+
+
 def pipeline_main(args: argparse.Namespace) -> None:
     """The ``--pipeline`` entry point: measure the batched fast path and
     the per-event compatibility wire on the SAME loopback workload, emit
@@ -493,6 +685,10 @@ def pipeline_main(args: argparse.Namespace) -> None:
 
     federation.configure(telemetry_on)
     edge_shards = max(0, int(getattr(args, "edge_shards", 0)))
+    runs = max(1, int(getattr(args, "runs", 1)))
+    if runs > 1:
+        return multi_run_main(args, runs, n_events, n_entities,
+                              telemetry_on)
     out = {
         "metric": PIPELINE_METRIC,
         "unit": "events/s",
@@ -647,6 +843,118 @@ def pipeline_main(args: argparse.Namespace) -> None:
     print(json.dumps(out))
 
 
+def multi_run_main(args: argparse.Namespace, runs: int,
+                   n_events: int, n_entities: int,
+                   telemetry_on: bool) -> None:
+    """``--pipeline --runs N``: the tenancy-plane aggregate — N
+    concurrent namespaced batched pipelines on ONE orchestrator,
+    reported per-run + aggregate and gated under its own ``runs``
+    config key (multi-run figures never baseline single-run ones)."""
+    edge = bool(args.edge or args.pipeline_mode == "edge")
+    edge_shards = max(0, int(getattr(args, "edge_shards", 0)))
+    edge_events = n_events if args.smoke or not args.edge_events \
+        else args.edge_events
+    extras = {}
+    central = central_per_run = None
+    if not edge or args.pipeline_mode in ("both", "batched"):
+        central_extras = {}
+        central, central_per_run = run_multi_pipeline(
+            runs, n_events, n_entities,
+            flush_window=args.flush_window, batch_max=args.batch_max,
+            run_id=f"bench-pipeline-multi-{os.getpid()}",
+            poll_linger=args.poll_linger, codec=args.codec,
+            extras=central_extras)
+        extras = central_extras
+    edge_agg = None
+    if edge:
+        edge_extras = {}
+        edge_agg, _ = run_multi_pipeline(
+            runs, edge_events, n_entities,
+            flush_window=args.flush_window, batch_max=args.batch_max,
+            run_id=f"bench-pipeline-multi-edge-{os.getpid()}",
+            poll_linger=args.poll_linger, codec=args.codec,
+            edge=True, edge_shards=edge_shards, extras=edge_extras)
+        extras = edge_extras
+    aggregate = edge_agg if edge_agg is not None else central
+    out = {
+        "metric": PIPELINE_METRIC,
+        "unit": "events/s",
+        "platform": "loopback",
+        "runs": runs,
+        "n_events": n_events,
+        "n_entities": n_entities,
+        "batch_max": args.batch_max,
+        "flush_window": args.flush_window,
+        "poll_linger": args.poll_linger,
+        "telemetry": telemetry_on,
+        "codec": args.codec,
+        "value": round(aggregate, 1),
+        "transport_mode": "edge" if edge_agg is not None else "batched",
+        "aggregate_events_per_sec": round(aggregate, 1),
+        "per_run_events_per_sec": extras.get("per_run_events_per_sec"),
+        "edge_shards": edge_shards,
+        "edge_events": edge_events if edge_agg is not None else None,
+        # the ROADMAP item-1 acceptance bar: >= 10x the round-8
+        # single-run batched central figure, on one orchestrator
+        "criterion": {
+            "baseline_single_run_batched": R08_BATCHED_BASELINE,
+            "aggregate_events_per_sec_min": round(
+                10 * R08_BATCHED_BASELINE, 1),
+            "met": aggregate >= 10 * R08_BATCHED_BASELINE,
+        },
+    }
+    if central is not None and edge_agg is not None:
+        # the central-path aggregate rides along for transparency: it
+        # is GIL-bound in-process (the tenants and the host share one
+        # interpreter here; production tenants are separate processes)
+        out["central_aggregate_events_per_sec"] = round(central, 1)
+        out["central_per_run_events_per_sec"] = central_per_run and [
+            round(r, 1) for r in central_per_run]
+    if args.smoke:
+        out["smoke"] = True
+    prior = load_history(args.history)
+    record = {
+        "timestamp": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+        "revision": _code_revision(),
+        "metric": PIPELINE_METRIC,
+        "value": out["value"],
+        "transport_mode": out["transport_mode"],
+        "mode": out["transport_mode"],
+        "edge_shards": edge_shards,
+        "edge_events": out.get("edge_events"),
+        "runs": runs,
+        "n_events": n_events,
+        "n_entities": n_entities,
+        "gc_disabled": True,
+        "telemetry": telemetry_on,
+        "batch_max": args.batch_max,
+        "flush_window": args.flush_window,
+        "poll_linger": args.poll_linger,
+        "codec": args.codec,
+        "unit": out["unit"],
+        "platform": out["platform"],
+    }
+    if not args.smoke:
+        try:
+            append_history(record, args.history)
+        except OSError as e:
+            print(f"# could not append bench history: {e}",
+                  file=sys.stderr)
+    if args.gate:
+        ok, reasons, baseline = gate_record(
+            record, prior, threshold_pct=args.gate_threshold)
+        out["gate"] = {"ok": ok, "threshold_pct": args.gate_threshold,
+                       "baseline": baseline, "reasons": reasons}
+        print(json.dumps(out))
+        if not ok:
+            for reason in reasons:
+                print(f"# GATE FAILED: {reason}", file=sys.stderr)
+            raise SystemExit(1)
+        return
+    print(json.dumps(out))
+
+
 def parse_args(argv=None) -> argparse.Namespace:
     ap = argparse.ArgumentParser(
         description="namazu_tpu scorer benchmark (one JSON line)")
@@ -683,6 +991,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                     metavar="K", help="concurrent loopback entities "
                     "(default 2 — on small hosts more entities just "
                     "multiply polling threads and GIL contention)")
+    ap.add_argument("--runs", type=int, default=1, metavar="N",
+                    help="with --pipeline: drive N concurrent "
+                         "NAMESPACED pipelines against one "
+                         "TenantOrchestrator (tenancy plane, "
+                         "doc/tenancy.md) and report per-run + "
+                         "aggregate events/s; a gate config key — "
+                         "multi-run figures never baseline single-run "
+                         "ones (default 1 = the classic single-run "
+                         "modes)")
     ap.add_argument("--pipeline-mode", default="both",
                     choices=("both", "batched", "per-event", "edge"),
                     help="which transport(s) to measure (default both; "

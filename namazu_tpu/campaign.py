@@ -102,6 +102,19 @@ class CampaignSpec:
     # children's federation hop, their inspectors) pushes here —
     # ``tools top --url uds://<path>`` shows the whole campaign.
     telemetry_collector: str = "auto"
+    # tenancy serve mode (doc/tenancy.md): when set, run slots LEASE
+    # namespaced runs on this shared orchestrator (http://... or
+    # uds://...) instead of forking `nmz-tpu run` children — the
+    # supervisor drives each slot's loopback workload through the wire
+    # under its leased namespace, renews the lease at TTL/3, and
+    # records the released trace into the local storage. A slot that
+    # stops renewing (crash) is reclaimed server-side on TTL expiry.
+    serve_url: str = ""
+    serve_ttl_s: float = 15.0
+    serve_events: int = 200
+    serve_entities: int = 2
+    serve_policy: str = "random"
+    serve_policy_param: Dict[str, Any] = field(default_factory=dict)
 
 
 class Campaign:
@@ -293,9 +306,12 @@ class Campaign:
         if server is not None:
             server.shutdown()
 
-    def _one_attempt(self) -> Dict[str, Any]:
-        """Spawn one ``nmz-tpu run`` child in its own session, enforce
-        the supervisor-side wall deadline, classify the outcome."""
+    def _one_attempt(self, slot_index: int = 0) -> Dict[str, Any]:
+        """One attempt: fork mode spawns an ``nmz-tpu run`` child in
+        its own session under the wall deadline; serve mode leases a
+        run slot on the shared orchestrator instead (doc/tenancy.md)."""
+        if self.spec.serve_url:
+            return self._one_serve_attempt(slot_index)
         spec = self.spec
         t0 = time.monotonic()
         child = subprocess.Popen(
@@ -341,6 +357,145 @@ class Campaign:
         return {"class": cls, "exit_status": rc,
                 "wall_s": round(wall_s, 3),
                 "wall_deadline_hit": timed_out}
+
+    # -- tenancy serve mode (doc/tenancy.md) ------------------------------
+
+    def _one_serve_attempt(self, slot_index: int) -> Dict[str, Any]:
+        from namazu_tpu.tenancy.client import TenancyWireError
+
+        t0 = time.monotonic()
+        crashed = False
+        try:
+            crashed = self._drive_serve_slot(slot_index)
+        except (TenancyWireError, OSError, RuntimeError, ValueError) as e:
+            log.warning("serve slot %d failed: %s", slot_index, e)
+            return {"class": CLASS_INFRA, "exit_status": None,
+                    "wall_s": round(time.monotonic() - t0, 3),
+                    "wall_deadline_hit": False, "error": str(e)}
+        wall_s = time.monotonic() - t0
+        if self._abort.is_set():
+            cls = CLASS_INTERRUPTED
+        elif crashed:
+            # the tenancy.slot.crash chaos seam fired: this tenant died
+            # mid-run without releasing; the orchestrator reclaims its
+            # namespace on TTL expiry — classified infra so the slot
+            # retries like any crashed run child
+            cls = CLASS_INFRA
+        else:
+            cls = CLASS_EXPERIMENT
+        return {"class": cls, "exit_status": 0 if cls == CLASS_EXPERIMENT
+                else None,
+                "wall_s": round(wall_s, 3), "wall_deadline_hit": False}
+
+    def _drive_serve_slot(self, slot_index: int) -> bool:
+        """Lease a namespace, drive the slot's loopback workload through
+        the shared orchestrator, release, record the returned trace.
+        Returns True when the ``tenancy.slot.crash`` seam killed the
+        tenant mid-run (lease left to expire server-side)."""
+        import uuid as _uuid
+
+        from namazu_tpu.storage import load_storage
+        from namazu_tpu.tenancy.client import TenancyClient
+        from namazu_tpu.utils.trace import SingleTrace
+
+        spec = self.spec
+        run_name = (f"{os.path.basename(os.path.abspath(spec.storage_dir))}"
+                    f"-s{slot_index}-{_uuid.uuid4().hex[:6]}")
+        client = TenancyClient(spec.serve_url)
+        t0 = time.monotonic()
+        lease = client.lease(
+            run_name, ttl_s=spec.serve_ttl_s,
+            policy=spec.serve_policy or "random",
+            policy_param=dict(spec.serve_policy_param) or None)
+        lease_id = lease["lease_id"]
+        renew_stop = threading.Event()
+
+        def renew_loop() -> None:
+            interval = max(spec.serve_ttl_s / 3.0, 0.05)
+            while not renew_stop.wait(interval):
+                try:
+                    client.renew(lease_id)
+                except Exception:
+                    return  # lease gone (released, expired, or crash)
+
+        renewer = threading.Thread(target=renew_loop,
+                                   name=f"lease-renew-s{slot_index}",
+                                   daemon=True)
+        renewer.start()
+        try:
+            crashed = self._drive_serve_workload(run_name)
+            if crashed:
+                # die like a SIGKILLed tenant: no release — stop
+                # renewing and walk away; TTL expiry reclaims the
+                # namespace server-side (chaos: tenancy.slot.crash)
+                return True
+            released = client.release(lease_id)
+        finally:
+            renew_stop.set()
+            renewer.join(timeout=2)
+            client.close()
+        storage = load_storage(spec.storage_dir)
+        try:
+            storage.create_new_working_dir()
+            storage.record_new_trace(
+                SingleTrace.from_jsonable(released.get("trace") or []))
+            # serve slots run the wire workload, not a validate script:
+            # the outcome is "completed" (successful = no repro claim)
+            storage.record_result(True, time.monotonic() - t0)
+        finally:
+            storage.close()
+        log.info("serve slot %d: run %s released (%s event(s), %s "
+                 "action(s) traced)", slot_index, run_name,
+                 released.get("events"), released.get("dispatched"))
+        return False
+
+    def _drive_serve_workload(self, run_name: str) -> bool:
+        """The slot's loopback workload: post deferred events under the
+        leased namespace, wait for every answering action. Returns True
+        when the ``tenancy.slot.crash`` seam fired mid-drive."""
+        from namazu_tpu import chaos
+        from namazu_tpu.signal import PacketEvent
+
+        spec = self.spec
+        url = spec.serve_url
+        entities = [f"n{i}" for i in range(max(1, spec.serve_entities))]
+        if url.startswith("uds://"):
+            from namazu_tpu.inspector.uds_transceiver import UdsTransceiver
+
+            txs = {e: UdsTransceiver(e, url[len("uds://"):],
+                                     run_ns=run_name)
+                   for e in entities}
+        else:
+            from namazu_tpu.inspector.rest_transceiver import RestTransceiver
+
+            txs = {e: RestTransceiver(e, url, use_batch=True,
+                                      flush_window=0.01,
+                                      run_ns=run_name)
+                   for e in entities}
+        crashed = False
+        try:
+            for tx in txs.values():
+                tx.start()
+            chans = []
+            for i in range(max(1, spec.serve_events)):
+                if i % 64 == 0 \
+                        and chaos.decide("tenancy.slot.crash") is not None:
+                    log.warning("chaos: tenancy.slot.crash fired; "
+                                "abandoning run %s mid-drive", run_name)
+                    crashed = True
+                    break
+                if self._abort.is_set():
+                    break
+                e = entities[i % len(entities)]
+                ev = PacketEvent.create(e, e, "peer", hint=f"h{i % 16}")
+                chans.append(txs[e].send_event(ev))
+            if not crashed:
+                for ch in chans:
+                    ch.get(timeout=60)
+        finally:
+            for tx in txs.values():
+                tx.shutdown()
+        return crashed
 
     # -- the supervised loop ---------------------------------------------
 
@@ -417,7 +572,7 @@ class Campaign:
                                 cap=spec.backoff_cap_s, rng=self._rng)
         while True:
             log.info("slot %d attempt %d", slot_index, len(attempts) + 1)
-            attempt = self._one_attempt()
+            attempt = self._one_attempt(slot_index)
             attempts.append(attempt)
             slot = {"slot": slot_index, "class": attempt["class"],
                     "attempts": attempts}

@@ -33,6 +33,13 @@ it. Kinds:
   mid-run; invariant: the respawned workers drain the surviving
   shard state — exactly-once dispatch, a complete backhauled trace,
   fsck-clean storage.
+* ``tenancy`` — crashed-tenant reclamation on a shared orchestrator
+  (doc/tenancy.md): two namespaces on one TenantOrchestrator while
+  ``tenancy.lease.expire`` force-expires one tenant's lease with every
+  event parked; invariant: the namespace is reclaimed undispatched, a
+  re-lease over the same journal recovers each event exactly-once, the
+  sibling namespace completes undisturbed, and nothing crosses
+  namespaces.
 * ``telemetry`` — fleet-telemetry relay outage
   (doc/observability.md "Fleet telemetry"): ``telemetry.push.drop``
   kills the producer's pushes; invariant: never an exception into
@@ -143,6 +150,17 @@ SCENARIOS: Dict[str, dict] = {
                 "dispatch stays exactly-once",
         "faults": {"wire.binary.garble": {"prob": 0.3, "max_fires": 4}},
     },
+    "tenant_crash": {
+        "kind": "tenancy",
+        "desc": "a tenant's lease force-expires mid-run "
+                "(tenancy.lease.expire) with every event parked; its "
+                "namespace must be reclaimed and a re-lease over the "
+                "same journal must recover each event exactly-once, "
+                "while the sibling namespace dispatches undisturbed "
+                "and nothing leaks across namespaces",
+        "faults": {"tenancy.lease.expire": {"prob": 1.0,
+                                            "max_fires": 1}},
+    },
     "relay_outage": {
         "kind": "telemetry",
         "desc": "the fleet-telemetry collector goes dark; the relay "
@@ -161,6 +179,7 @@ DEFAULT_MATRIX: List[str] = [
     "wire_drop", "wire_dup", "wire_lost_reply", "wire_sever",
     "ingress_429", "storage_torn", "knowledge_outage", "crash_restart",
     "edge_stale", "edge_sharded", "wire_garble", "relay_outage",
+    "tenant_crash",
 ]
 
 

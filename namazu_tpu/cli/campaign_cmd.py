@@ -65,6 +65,26 @@ def register(sub) -> None:
                         "uds://PATH` shows the whole campaign. "
                         "Default: auto (<storage>/telemetry.sock); "
                         "'' disables")
+    p.add_argument("--serve", default="", metavar="URL",
+                   help="tenancy serve mode (doc/tenancy.md): lease "
+                        "namespaced run slots on a shared orchestrator "
+                        "(http://host:port or uds:///path) instead of "
+                        "forking run children; slots drive their "
+                        "workload through the wire and record the "
+                        "released trace into the storage")
+    p.add_argument("--serve-events", type=int, default=200, metavar="N",
+                   help="with --serve: events per slot workload "
+                        "(default 200)")
+    p.add_argument("--serve-entities", type=int, default=2, metavar="K",
+                   help="with --serve: loopback entities per slot "
+                        "(default 2)")
+    p.add_argument("--serve-ttl", type=float, default=15.0, metavar="S",
+                   help="with --serve: lease TTL; the supervisor renews "
+                        "at TTL/3, and a crashed slot's namespace is "
+                        "reclaimed on expiry (default 15s)")
+    p.add_argument("--serve-policy", default="random",
+                   help="with --serve: exploration policy for the "
+                        "leased namespace (default random)")
     p.add_argument("--no-resume", action="store_true",
                    help="ignore an existing campaign.json and start a "
                         "fresh campaign")
@@ -88,6 +108,11 @@ def run(args) -> int:
         extra_run_args=(["--knowledge", args.knowledge]
                         if args.knowledge else []),
         telemetry_collector=args.telemetry_collector,
+        serve_url=args.serve,
+        serve_ttl_s=args.serve_ttl,
+        serve_events=args.serve_events,
+        serve_entities=args.serve_entities,
+        serve_policy=args.serve_policy,
     )
     campaign = Campaign(spec)
     try:

@@ -29,6 +29,16 @@ def register(sub) -> None:
                         "(doc/robustness.md): a restarted orchestrator "
                         "pointed at the same dir resumes the parked "
                         "events a kill -9 stranded")
+    p.add_argument("--serve", action="store_true",
+                   help="host the tenancy plane (doc/tenancy.md): N "
+                        "concurrent campaigns lease namespaced run "
+                        "slots on this one orchestrator over the wire "
+                        "(POST /api/v3/tenancy, framed lease ops); "
+                        "clients without a run namespace land in the "
+                        "default namespace unchanged")
+    p.add_argument("--uds", default=None, metavar="PATH",
+                   help="also serve the framed uds:// wire on PATH "
+                        "(events + lease ops without a TCP port)")
     p.set_defaults(func=run)
 
 
@@ -54,12 +64,23 @@ def run(args) -> int:
     load_policy_plugins(
         cfg, os.path.dirname(os.path.abspath(args.config))
         if args.config else None)
+    if args.uds:
+        cfg.set("uds_path", args.uds)
     policy = create_policy(cfg.get("explore_policy"))
     policy.load_config(cfg)
-    orchestrator = Orchestrator(cfg, policy, collect_trace=False)
+    if args.serve:
+        from namazu_tpu.tenancy.host import TenantOrchestrator
+
+        orchestrator = TenantOrchestrator(cfg, policy,
+                                          collect_trace=False)
+    else:
+        orchestrator = Orchestrator(cfg, policy, collect_trace=False)
     orchestrator.start()
     rest = orchestrator.hub.endpoint("rest")
-    print(f"orchestrator ready (REST port {rest.port}); Ctrl-C to stop")
+    mode = "tenancy host" if args.serve else "orchestrator"
+    print(f"{mode} ready (REST port {rest.port}"
+          + (f", uds {args.uds}" if args.uds else "")
+          + "); Ctrl-C to stop", flush=True)
 
     stop = threading.Event()
     _signal.signal(_signal.SIGINT, lambda *a: stop.set())

@@ -411,6 +411,24 @@ def render_top(payload: dict) -> str:
         f"{payload.get('instance_count', 0)} instance(s), "
         f"{payload.get('stale_instances', 0)} stale; fleet table "
         f"version {_fmt_cell(payload.get('fleet_table_version'))}")
+    # tenancy plane (doc/tenancy.md): one row per (instance, run
+    # namespace) — how one orchestrator hosting 8 campaigns reads per
+    # tenant. Absent entirely on pre-tenancy fleets.
+    run_rows = [(inst.get("instance", ""), run, doc)
+                for inst in payload.get("instances", [])
+                for run, doc in sorted((inst.get("runs") or {}).items())]
+    if run_rows:
+        lines.append("")
+        rtab = [["RUN", "INSTANCE", "EV/S", "EVENTS", "PARKED"]]
+        for instance, run, doc in run_rows:
+            rtab.append([run, instance,
+                         _fmt_cell(doc.get("events_per_sec")),
+                         _fmt_cell(doc.get("events_total")),
+                         _fmt_cell(doc.get("parked"))])
+        rwidths = [max(len(r[i]) for r in rtab) for i in range(5)]
+        lines.extend("  ".join(cell.ljust(w) for cell, w
+                               in zip(row, rwidths)).rstrip()
+                     for row in rtab)
     objectives = (payload.get("slo") or {}).get("objectives") or []
     if objectives:
         lines.append("")

@@ -1,30 +1,46 @@
-"""FramedServer: the ONE keep-alive framed-JSON serve loop.
+"""FramedServer: the ONE keep-alive framed-JSON serve loop — now an
+event-driven selector core with a small worker pool.
 
 Three servers grew the same loop independently — the ``uds://`` event
 endpoint (endpoint/uds.py), the search/knowledge sidecar (sidecar.py),
 and the campaign supervisor's telemetry collector
-(obs/federation.TelemetryServer). PR 9 noted the consolidation and
-deferred it; the causality plane forces the issue — span context must
-be observed and echoed uniformly on every framed wire, and three copies
-of the loop is three places to get that wrong.
+(obs/federation.TelemetryServer). PR 10 consolidated them here; the
+tenancy plane (doc/tenancy.md) forces the next step: one orchestrator
+serving 8+ campaigns' connections must not spend one parked thread per
+idle connection. The rewrite:
 
-The contract every framed wire now shares (one frame each way,
-``uint32-LE length + UTF-8 JSON`` — endpoint/agent.py's codec, any
-number of request/response pairs per connection):
+* ONE selector thread owns accept + reads for every connection and
+  assembles frames incrementally — an idle connection costs a registry
+  entry, not a thread;
+* complete frames dispatch to a small fixed **worker pool** (decode,
+  handler, reply). Per-connection FIFO order is preserved: one request
+  in flight per connection, later frames queue behind it;
+* ops that PARK by design (the long-poll ``poll`` op) hand off from
+  the worker to a short-lived thread, so parked polls occupy exactly
+  one thread per in-flight poll — never a pool slot. Beyond
+  ``max_parked`` simultaneous parked ops the handler runs inline in
+  the worker (bounded degradation, never an error).
+
+The contract every framed wire shares is unchanged (one frame each
+way, ``uint32-LE length + UTF-8 JSON`` — endpoint/agent.py's codec,
+binary high-bit negotiated per connection, any number of
+request/response pairs per connection):
 
 * EOF or a codec/socket error drops the connection cleanly;
 * a valid-JSON **non-object** frame is ANSWERED
   (``{"ok": false, ...}``) so the client's keep-alive stream stays in
   sync, never severed;
+* an in-sync garbled payload (``wire.binary.garble``) is answered
+  ``{"ok": false, "transient": true}`` — the client's bounded retry
+  resends a clean copy;
 * a handler exception is answered (``{"ok": false, "error": ...}``),
   logged, and never desyncs the wire;
 * **span context** (obs/context.py): a request frame carrying ``ctx``
-  has its Lamport clock merged into this process's before the handler
-  runs, and the response echoes a fresh ``ctx`` stamp — so causal
-  order is joinable across every framed hop (knowledge push/pull,
-  telemetry forward, uds event ops) without the handlers knowing.
-  Context-less requests get byte-identical responses to the
-  pre-context wire;
+  has its Lamport clock merged before the handler runs, and the
+  response echoes a fresh ``ctx`` stamp; context-less requests get
+  byte-identical responses to the pre-context wire;
+* per-connection ``codec`` negotiation is answered by the serve loop
+  itself, uniformly across every framed wire;
 * shutdown severs live connections (a parked long-poll must error and
   reconnect, not keep talking to a dead server), and ``sever()`` alone
   simulates crash death for the chaos harness.
@@ -39,19 +55,23 @@ rebind its port immediately.
 
 from __future__ import annotations
 
+import json
 import os
+import queue
+import selectors
 import socket
 import stat
+import struct
 import threading
+from collections import deque
 from typing import Callable, Dict, Optional
 
-from namazu_tpu.endpoint.agent import (FramePayloadError,
-                                       read_frame_ex, write_frame)
+from namazu_tpu.endpoint.agent import (BINARY_FRAME_FLAG, MAX_FRAME,
+                                       write_frame)
 from namazu_tpu.obs import context as _context
 from namazu_tpu.obs import metrics as _metrics
 from namazu_tpu.obs import spans as _spans
 from namazu_tpu.signal import binary as _binary
-from namazu_tpu.signal.base import SignalError
 from namazu_tpu.utils.log import get_logger
 
 log = get_logger("endpoint.framed")
@@ -61,6 +81,15 @@ Handler = Callable[[dict], dict]
 #: decorate(req dict, resp dict) -> None — per-wire piggybacks (the
 #: uds endpoint's table_version) applied after the handler, before send
 Decorator = Callable[[dict, dict], None]
+
+#: ops that park their handler by design: the long-poll family, plus
+#: the tenancy lease ops ("release" waits up to 10s for its
+#: namespace's flush to drain; "lease" may replay a journal). These
+#: hand off from the worker pool to a per-request thread so a parked
+#: op can never starve short ops (post_batch/ack/telemetry) of a pool
+#: slot — a campaign winding down several serve slots at once must not
+#: convoy every other tenant's wire.
+DEFAULT_BLOCKING_OPS = frozenset({"poll", "lease", "release"})
 
 
 def reclaim_stale_unix_socket(path: str, what: str = "server") -> None:
@@ -96,17 +125,57 @@ def reclaim_stale_unix_socket(path: str, what: str = "server") -> None:
         "(another process?); refusing to take it over")
 
 
+class _Conn:
+    """Per-connection state, owned by the selector thread except where
+    noted."""
+
+    __slots__ = ("sock", "rbuf", "wlock", "plock", "busy", "pending")
+
+    #: pipelined-requests bound: a client that floods requests without
+    #: reading replies is dropped rather than buffered without limit
+    MAX_PENDING = 1024
+
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+        self.rbuf = bytearray()
+        #: serializes response writes (workers + poll threads)
+        self.wlock = threading.Lock()
+        #: guards busy/pending (selector thread + workers)
+        self.plock = threading.Lock()
+        self.busy = False
+        self.pending: deque = deque()
+
+
 class FramedServer:
     def __init__(self, handler: Handler, name: str = "framed",
-                 decorate: Optional[Decorator] = None) -> None:
+                 decorate: Optional[Decorator] = None,
+                 workers: int = 4,
+                 blocking_ops=DEFAULT_BLOCKING_OPS,
+                 max_parked: int = 256) -> None:
         self._handler = handler
         self._name = name
         self._decorate = decorate
+        self._workers_n = max(1, int(workers))
+        self._blocking_ops = frozenset(blocking_ops or ())
         self._server: Optional[socket.socket] = None
-        self._accept_thread: Optional[threading.Thread] = None
+        self._selector_thread: Optional[threading.Thread] = None
+        self._worker_threads: list = []
+        self._work: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
         self._conns: set = set()
         self._conns_lock = threading.Lock()
+        # parked-op budget: a Semaphore would block; a counter + cap
+        # degrades to inline execution instead
+        self._parked = 0
+        self._parked_cap = max(1, int(max_parked))
+        self._parked_lock = threading.Lock()
+        # guards the wake-pipe fds: _wake() writes under it and the
+        # selector thread nulls them under it before closing, so a
+        # late shutdown() can never write into a closed (or recycled)
+        # descriptor
+        self._wake_lock = threading.Lock()
+        self._wake_r: Optional[int] = None
+        self._wake_w: Optional[int] = None
         #: AF_UNIX path when bound to one (unlinked at shutdown)
         self.path: Optional[str] = None
 
@@ -137,12 +206,28 @@ class FramedServer:
 
     def start(self) -> None:
         assert self._server is not None, "bind before start"
-        if self._accept_thread is not None:
+        if self._selector_thread is not None:
             return
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name=f"{self._name}-accept",
+        self._wake_r, self._wake_w = os.pipe()
+        for i in range(self._workers_n):
+            t = threading.Thread(target=self._worker_loop,
+                                 name=f"{self._name}-worker-{i}",
+                                 daemon=True)
+            t.start()
+            self._worker_threads.append(t)
+        self._selector_thread = threading.Thread(
+            target=self._selector_loop, name=f"{self._name}-select",
             daemon=True)
-        self._accept_thread.start()
+        self._selector_thread.start()
+
+    def _wake(self) -> None:
+        with self._wake_lock:
+            w = self._wake_w
+            if w is not None:
+                try:
+                    os.write(w, b"x")
+                except OSError:
+                    pass
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -152,17 +237,20 @@ class FramedServer:
                 srv.close()
             except OSError:
                 pass
+        self._wake()
         with self._conns_lock:
             conns, self._conns = set(self._conns), set()
         for conn in conns:
             try:
-                conn.shutdown(socket.SHUT_RDWR)
+                conn.sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
             try:
-                conn.close()
+                conn.sock.close()
             except OSError:
                 pass
+        for _ in self._worker_threads:
+            self._work.put(None)
         if self.path is not None:
             try:
                 os.unlink(self.path)
@@ -178,124 +266,278 @@ class FramedServer:
             conns = list(self._conns)
         for conn in conns:
             try:
-                conn.shutdown(socket.SHUT_RDWR)
+                conn.sock.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
         return len(conns)
 
-    # -- the loop ----------------------------------------------------------
+    # -- selector core -----------------------------------------------------
 
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            srv = self._server
-            if srv is None:
-                return
-            try:
-                conn, _ = srv.accept()
-            except OSError:
-                return  # closed by shutdown
-            with self._conns_lock:
-                self._conns.add(conn)
-            threading.Thread(target=self._serve_conn, args=(conn,),
-                             name=f"{self._name}-conn",
-                             daemon=True).start()
-
-    def _serve_conn(self, conn: socket.socket) -> None:
+    def _selector_loop(self) -> None:
+        sel = selectors.DefaultSelector()
+        srv = self._server
+        if srv is None:
+            return
+        sel.register(srv, selectors.EVENT_READ, "accept")
+        if self._wake_r is not None:
+            sel.register(self._wake_r, selectors.EVENT_READ, "wake")
         try:
             while not self._stop.is_set():
                 try:
-                    req, codec, n_in = read_frame_ex(conn)
-                except FramePayloadError as e:
-                    # the frame's length prefix was intact, only the
-                    # payload was garbled: the stream is still in sync
-                    # — answer it (transient: the client's bounded
-                    # retry resends a clean copy), never sever the
-                    # keep-alive connection (wire.binary.garble)
-                    try:
-                        write_frame(conn, {"ok": False,
-                                           "transient": True,
-                                           "error": str(e)})
-                    except OSError:
-                        break
-                    continue
-                except (SignalError, ValueError, OSError):
-                    # oversized frame or a socket error: the framing
-                    # layer itself is broken — drop the connection
-                    break
-                if req is None:
-                    break  # EOF (one-shot clients just close)
-                if not isinstance(req, dict):
-                    # answered, not severed: the framed stream stays in
-                    # sync for the client's next request
-                    try:
-                        write_frame(conn, {"ok": False,
-                                           "error": "frame must be a "
-                                                    "JSON object"},
-                                    codec=codec)
-                    except OSError:
-                        break
-                    continue
-                if req.get("op") == "codec":
-                    # per-connection codec negotiation: answered by the
-                    # serve loop itself so EVERY framed wire (uds
-                    # endpoint, sidecar, telemetry collector) speaks it
-                    # uniformly. A pre-binary server answers this op
-                    # with its handler's unknown-op error — the client
-                    # then stays on JSON, loss-free.
-                    offered = req.get("codecs")
-                    picked = (_binary.CODEC_BINARY
-                              if isinstance(offered, (list, tuple))
-                              and _binary.CODEC_BINARY in offered
-                              else _binary.CODEC_JSON)
-                    _spans.codec_negotiated(picked)
-                    try:
-                        write_frame(conn, {"ok": True, "codec": picked},
-                                    codec=codec)
-                    except OSError:
-                        break
-                    continue
-                ctx_seen = self._observe_ctx(req)
-                try:
-                    resp = self._handler(req)
-                except Exception as e:  # answer, never desync the wire
-                    log.exception("%s op failed: %r", self._name,
-                                  req.get("op"))
-                    resp = {"ok": False, "error": repr(e)}
-                if self._decorate is not None:
-                    try:
-                        self._decorate(req, resp)
-                    except Exception:  # pragma: no cover - defensive
-                        log.exception("%s response decorator failed",
-                                      self._name)
-                if ctx_seen:
-                    # echo a fresh stamp so the client's clock merges
-                    # ours; context-less peers get the pre-context wire
-                    # byte for byte
-                    resp.setdefault(_context.CTX_KEY,
-                                    _context.wire_stamp())
-                try:
-                    # answer in the codec the request arrived in —
-                    # per-frame, stateless, so mixed-codec clients on
-                    # one endpoint just work
-                    n_out = write_frame(conn, resp, codec=codec)
-                except TypeError:
-                    # a handler value the binary codec cannot carry:
-                    # degrade THIS response to JSON rather than desync
-                    try:
-                        n_out = write_frame(conn, resp)
-                    except OSError:
-                        break
+                    events = sel.select(timeout=1.0)
                 except OSError:
-                    break
-                _spans.wire_bytes(codec, str(req.get("op") or "frame"),
-                                  n_in + n_out)
+                    return
+                for key, _ in events:
+                    kind = key.data
+                    if kind == "accept":
+                        self._accept(sel)
+                    elif kind == "wake":
+                        try:
+                            os.read(self._wake_r, 4096)
+                        except OSError:
+                            pass
+                    else:
+                        self._readable(sel, kind)
         finally:
-            with self._conns_lock:
-                self._conns.discard(conn)
             try:
-                conn.close()
+                sel.close()
             except OSError:
                 pass
+            with self._wake_lock:
+                fds = (self._wake_r, self._wake_w)
+                self._wake_r = self._wake_w = None
+            for fd in fds:
+                if fd is not None:
+                    try:
+                        os.close(fd)
+                    except OSError:
+                        pass
+
+    def _accept(self, sel) -> None:
+        srv = self._server
+        if srv is None:
+            return
+        try:
+            sock, _ = srv.accept()
+        except OSError:
+            return
+        conn = _Conn(sock)
+        with self._conns_lock:
+            self._conns.add(conn)
+        try:
+            sel.register(sock, selectors.EVENT_READ, conn)
+        except (OSError, ValueError):
+            self._close_conn(None, conn)
+
+    def _readable(self, sel, conn: _Conn) -> None:
+        try:
+            chunk = conn.sock.recv(1 << 16)
+        except OSError:
+            self._close_conn(sel, conn)
+            return
+        if not chunk:
+            self._close_conn(sel, conn)  # EOF
+            return
+        conn.rbuf += chunk
+        while True:
+            frame = self._extract_frame(conn)
+            if frame is None:
+                break
+            if frame == "broken":
+                self._close_conn(sel, conn)
+                return
+            codec, body = frame
+            if not self._enqueue(conn, codec, body):
+                self._close_conn(sel, conn)
+                return
+
+    def _extract_frame(self, conn: _Conn):
+        """One complete frame from the connection buffer:
+        ``(codec, body_bytes)``, ``None`` when incomplete, or
+        ``"broken"`` when the framing layer itself is bad (oversized
+        length — the drop-the-connection class)."""
+        buf = conn.rbuf
+        if len(buf) < 4:
+            return None
+        (length,) = struct.unpack("<I", bytes(buf[:4]))
+        codec = _binary.CODEC_JSON
+        if length & BINARY_FRAME_FLAG:
+            codec = _binary.CODEC_BINARY
+            length &= ~BINARY_FRAME_FLAG
+        if length > MAX_FRAME:
+            return "broken"
+        if len(buf) < 4 + length:
+            return None
+        body = bytes(buf[4:4 + length])
+        del buf[:4 + length]
+        return codec, body
+
+    def _enqueue(self, conn: _Conn, codec: str, body: bytes) -> bool:
+        """Queue one raw frame for processing, preserving per-connection
+        FIFO; False = the client pipelined past the bound (drop it)."""
+        with conn.plock:
+            if conn.busy:
+                if len(conn.pending) >= conn.MAX_PENDING:
+                    return False
+                conn.pending.append((codec, body))
+                return True
+            conn.busy = True
+        self._work.put((conn, codec, body))
+        return True
+
+    def _finish_task(self, conn: _Conn) -> None:
+        """A request finished: start the next queued frame, or go idle."""
+        with conn.plock:
+            if conn.pending:
+                codec, body = conn.pending.popleft()
+            else:
+                conn.busy = False
+                return
+        self._work.put((conn, codec, body))
+
+    def _close_conn(self, sel, conn: _Conn) -> None:
+        if sel is not None:
+            try:
+                sel.unregister(conn.sock)
+            except (KeyError, OSError, ValueError):
+                pass
+        with self._conns_lock:
+            self._conns.discard(conn)
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    # -- workers -----------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            task = self._work.get()
+            if task is None:
+                return
+            conn, codec, body = task
+            try:
+                self._process(conn, codec, body)
+            except Exception:  # pragma: no cover - defensive
+                log.exception("%s frame processing failed", self._name)
+                self._finish_task(conn)
+
+    def _send(self, conn: _Conn, resp: dict, codec: str) -> int:
+        with conn.wlock:
+            return write_frame(conn.sock, resp, codec=codec)
+
+    def _process(self, conn: _Conn, codec: str, body: bytes) -> None:
+        """Decode one frame and answer it (worker thread)."""
+        try:
+            if codec == _binary.CODEC_BINARY:
+                req = _binary.loads(body)
+            else:
+                req = json.loads(body)
+        except ValueError as e:
+            # the frame's length prefix was intact, only the payload
+            # was garbled: the stream is still in sync — answer it
+            # (transient: the client's bounded retry resends a clean
+            # copy), never sever the keep-alive connection
+            # (wire.binary.garble)
+            try:
+                self._send(conn, {"ok": False, "transient": True,
+                                  "error": f"undecodable {codec} "
+                                           f"frame: {e}"},
+                           _binary.CODEC_JSON)
+            except OSError:
+                pass
+            self._finish_task(conn)
+            return
+        if not isinstance(req, dict):
+            # answered, not severed: the framed stream stays in sync
+            # for the client's next request
+            try:
+                self._send(conn, {"ok": False,
+                                  "error": "frame must be a JSON "
+                                           "object"}, codec)
+            except OSError:
+                pass
+            self._finish_task(conn)
+            return
+        if req.get("op") == "codec":
+            # per-connection codec negotiation: answered by the serve
+            # loop itself so EVERY framed wire (uds endpoint, sidecar,
+            # telemetry collector) speaks it uniformly. A pre-binary
+            # server answers this op with its handler's unknown-op
+            # error — the client then stays on JSON, loss-free.
+            offered = req.get("codecs")
+            picked = (_binary.CODEC_BINARY
+                      if isinstance(offered, (list, tuple))
+                      and _binary.CODEC_BINARY in offered
+                      else _binary.CODEC_JSON)
+            _spans.codec_negotiated(picked)
+            try:
+                self._send(conn, {"ok": True, "codec": picked}, codec)
+            except OSError:
+                pass
+            self._finish_task(conn)
+            return
+        if req.get("op") in self._blocking_ops:
+            # long-poll class: hand off so the pool slot frees NOW —
+            # one short-lived thread per in-flight parked op, bounded
+            # by max_parked (beyond it, run inline: degraded latency
+            # for short ops, never an error)
+            with self._parked_lock:
+                over = self._parked >= self._parked_cap
+                if not over:
+                    self._parked += 1
+            if not over:
+                threading.Thread(
+                    target=self._answer_parked,
+                    args=(conn, req, codec, len(body)),
+                    name=f"{self._name}-poll", daemon=True).start()
+                return
+        self._answer(conn, req, codec, len(body))
+        self._finish_task(conn)
+
+    def _answer_parked(self, conn: _Conn, req: dict, codec: str,
+                       n_in: int) -> None:
+        try:
+            self._answer(conn, req, codec, n_in)
+        finally:
+            with self._parked_lock:
+                self._parked -= 1
+            self._finish_task(conn)
+
+    def _answer(self, conn: _Conn, req: dict, codec: str,
+                n_in: int) -> None:
+        ctx_seen = self._observe_ctx(req)
+        try:
+            resp = self._handler(req)
+        except Exception as e:  # answer, never desync the wire
+            log.exception("%s op failed: %r", self._name,
+                          req.get("op"))
+            resp = {"ok": False, "error": repr(e)}
+        if self._decorate is not None:
+            try:
+                self._decorate(req, resp)
+            except Exception:  # pragma: no cover - defensive
+                log.exception("%s response decorator failed",
+                              self._name)
+        if ctx_seen:
+            # echo a fresh stamp so the client's clock merges ours;
+            # context-less peers get the pre-context wire byte for byte
+            resp.setdefault(_context.CTX_KEY, _context.wire_stamp())
+        try:
+            # answer in the codec the request arrived in — per-frame,
+            # stateless, so mixed-codec clients on one endpoint work
+            n_out = self._send(conn, resp, codec)
+        except TypeError:
+            # a handler value the binary codec cannot carry: degrade
+            # THIS response to JSON rather than desync
+            try:
+                n_out = self._send(conn, resp, _binary.CODEC_JSON)
+            except OSError:
+                return
+        except OSError:
+            return
+        _spans.wire_bytes(codec, str(req.get("op") or "frame"),
+                          n_in + n_out)
 
     @staticmethod
     def _observe_ctx(req: Dict) -> bool:

@@ -10,11 +10,10 @@ line (the reference panics; dropping is friendlier for long experiments).
 from __future__ import annotations
 
 import queue
-import threading
-import time
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional
 
-from namazu_tpu import obs
+from namazu_tpu import obs, tenancy
+from namazu_tpu.tenancy.shard import ShardedRoutes
 from namazu_tpu.signal.action import Action
 from namazu_tpu.signal.control import Control
 from namazu_tpu.signal.event import Event
@@ -48,7 +47,7 @@ class Endpoint:
 
 
 class EndpointHub:
-    def __init__(self) -> None:
+    def __init__(self, n_shards: int = ShardedRoutes.DEFAULT_SHARDS) -> None:
         self.event_queue: "queue.Queue[Event]" = queue.Queue()
         self.control_queue: "queue.Queue[Control]" = queue.Queue()
         # the zero-RTT dispatch plane's table source (policy/
@@ -57,16 +56,17 @@ class EndpointHub:
         # table policies) — endpoints then advertise no version and
         # serve no table
         self.table_publisher = None
+        # tenancy plane (doc/tenancy.md): the RunRegistry a
+        # TenantOrchestrator attaches so the wire endpoints can answer
+        # lease/renew/release ops; None on single-run orchestrators
+        self.run_registry = None
         self._endpoints: Dict[str, Endpoint] = {}
-        self._entity_route: Dict[str, str] = {}
-        # liveness bookkeeping for the orchestrator's watchdog: monotonic
-        # time of each entity's last inbound event
-        self._last_seen: Dict[str, float] = {}
-        # entities already warned about, per failure class — one WARNING
-        # per entity, not one per dropped action (a dead entity can shed
-        # thousands of drops over a long experiment)
-        self._warned_unroutable: Set[str] = set()
-        self._lock = threading.Lock()
+        # routing + liveness + one-shot-unroutable-warning bookkeeping,
+        # sharded by fnv64a(namespace:entity) so N tenant namespaces
+        # never convoy on one lock (tenancy/shard.py). Keys are
+        # composite route keys; the default namespace's keys are bare
+        # entity ids (the pre-tenancy shape).
+        self._routes = ShardedRoutes(n_shards)
 
     # -- endpoint registration ------------------------------------------
 
@@ -88,19 +88,26 @@ class EndpointHub:
     # -- inbound (transports call these) --------------------------------
 
     def _note_inbound(self, event: Event, endpoint_name: str) -> None:
-        """Routing + liveness bookkeeping for one inbound event; caller
-        holds ``_lock``."""
-        prev = self._entity_route.get(event.entity_id)
-        if prev is not None and prev != endpoint_name:
+        """Routing + liveness bookkeeping for one inbound event."""
+        prev = self._routes.note_inbound(
+            tenancy.signal_route_key(event), endpoint_name)
+        if prev is not None:
             log.warning(
                 "entity %s moved endpoint %s -> %s",
                 event.entity_id, prev, endpoint_name,
             )
-        self._entity_route[event.entity_id] = endpoint_name
-        self._last_seen[event.entity_id] = time.monotonic()
-        # an entity that speaks again is routable again: re-arm its
-        # one-shot unroutable warning
-        self._warned_unroutable.discard(event.entity_id)
+
+    def _note_inbound_batch(self, events, endpoint_name: str) -> None:
+        """Batch routing/liveness bookkeeping: one lock acquisition per
+        TOUCHED SHARD for the whole batch (pre-tenancy: one global
+        lock)."""
+        moves = self._routes.note_inbound_many(
+            [tenancy.signal_route_key(ev) for ev in events],
+            endpoint_name)
+        for key, prev in moves:
+            _, entity = tenancy.split_route_key(key)
+            log.warning("entity %s moved endpoint %s -> %s",
+                        entity, prev, endpoint_name)
 
     @staticmethod
     def _note_context(event: Event) -> None:
@@ -114,7 +121,9 @@ class EndpointHub:
             return
         obs.context.observe(ctx)
         if not ctx.get("r"):
-            run_id = obs.recorder.current_run_id()
+            ns = getattr(event, "_ns", "")
+            run_id = (obs.recorder.recorder().pinned_run_id(ns) if ns
+                      else obs.recorder.current_run_id())
             if run_id:
                 ctx["r"] = run_id
 
@@ -128,6 +137,7 @@ class EndpointHub:
         if not obs.metrics.enabled():
             return
         run_id = obs.recorder.current_run_id() or ""
+        rec = obs.recorder.recorder()
         lc_of = obs.context.lc_of
         max_lc = int(extra_lc)
         for event in events:
@@ -137,14 +147,16 @@ class EndpointHub:
             lc = lc_of(ctx)
             if lc > max_lc:
                 max_lc = lc
-            if run_id and not ctx.get("r"):
-                ctx["r"] = run_id
+            if not ctx.get("r"):
+                ns = getattr(event, "_ns", "")
+                rid = (rec.pinned_run_id(ns) or "") if ns else run_id
+                if rid:
+                    ctx["r"] = rid
         if max_lc > 0:
             obs.context.clock().observe(max_lc)
 
     def post_event(self, event: Event, endpoint_name: str) -> None:
-        with self._lock:
-            self._note_inbound(event, endpoint_name)
+        self._note_inbound(event, endpoint_name)
         event.mark_arrived()
         self._note_context(event)
         obs.mark(event, "intercepted")
@@ -158,9 +170,7 @@ class EndpointHub:
         enqueued in arrival order."""
         if not events:
             return
-        with self._lock:
-            for event in events:
-                self._note_inbound(event, endpoint_name)
+        self._note_inbound_batch(events, endpoint_name)
         self._note_context_batch(events)
         for event in events:
             event.mark_arrived()
@@ -184,9 +194,7 @@ class EndpointHub:
         run records, modulo the ``decision_source="edge"`` tag."""
         if not items:
             return
-        with self._lock:
-            for event, _ in items:
-                self._note_inbound(event, endpoint_name)
+        self._note_inbound_batch([ev for ev, _ in items], endpoint_name)
         # the edge's per-chunk decision stamp (added at backhaul
         # serialization) merges too — the reconcile point is causally
         # after the decision, whatever the wall clocks say
@@ -236,12 +244,8 @@ class EndpointHub:
     # -- outbound (orchestrator calls this) -----------------------------
 
     def send_action(self, action: Action) -> None:
-        with self._lock:
-            name = self._entity_route.get(action.entity_id)
-            first_drop = (name is None
-                          and action.entity_id not in self._warned_unroutable)
-            if first_drop:
-                self._warned_unroutable.add(action.entity_id)
+        name, first_drop = self._routes.resolve(
+            tenancy.signal_route_key(action))
         if name is None:
             self._drop_unroutable(action, first_drop)
             return
@@ -269,17 +273,13 @@ class EndpointHub:
             return
         routed: Dict[str, List[Action]] = {}
         drops = []
-        with self._lock:
-            for action in actions:
-                name = self._entity_route.get(action.entity_id)
-                if name is None:
-                    first = (action.entity_id
-                             not in self._warned_unroutable)
-                    if first:
-                        self._warned_unroutable.add(action.entity_id)
-                    drops.append((action, first))
-                else:
-                    routed.setdefault(name, []).append(action)
+        resolved = self._routes.resolve_many(
+            [tenancy.signal_route_key(a) for a in actions])
+        for action, (name, first) in zip(actions, resolved):
+            if name is None:
+                drops.append((action, first))
+            else:
+                routed.setdefault(name, []).append(action)
         for action, first_drop in drops:
             self._drop_unroutable(action, first_drop)
         n_routed = 0
@@ -294,21 +294,23 @@ class EndpointHub:
     # -- liveness (the orchestrator's watchdog reads these) -------------
 
     def last_seen(self) -> Dict[str, float]:
-        """Snapshot of entity -> monotonic last-inbound-event time."""
-        with self._lock:
-            return dict(self._last_seen)
+        """Snapshot of route key -> monotonic last-inbound-event time
+        (default-namespace keys are bare entity ids)."""
+        return self._routes.last_seen()
 
     def routes(self) -> Dict[str, str]:
-        """Snapshot of the entity -> endpoint routing table (the event
-        journal persists it so recovery can restore dispatch routes)."""
-        with self._lock:
-            return dict(self._entity_route)
+        """Snapshot of the route-key -> endpoint routing table (the
+        event journal persists it so recovery can restore dispatch
+        routes)."""
+        return self._routes.routes()
+
+    def forget_namespace(self, ns: str) -> int:
+        """Drop one namespace's routing/liveness state (a released or
+        reclaimed tenant; doc/tenancy.md)."""
+        return self._routes.forget_namespace(ns)
 
     def stalled_entities(self, timeout_s: float,
                          now: Optional[float] = None) -> Dict[str, float]:
-        """Entities silent for more than ``timeout_s``, with their
+        """Route keys silent for more than ``timeout_s``, with their
         silence duration."""
-        now = time.monotonic() if now is None else now
-        with self._lock:
-            return {e: now - t for e, t in self._last_seen.items()
-                    if now - t > timeout_s}
+        return self._routes.stalled(timeout_s, now=now)

@@ -53,12 +53,12 @@ import re
 import socket as _socket
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib.parse import urlparse, parse_qs
 
-from namazu_tpu import chaos, obs
+from namazu_tpu import chaos, obs, tenancy
 from namazu_tpu.endpoint.hub import Endpoint
 from namazu_tpu.signal import binary as _binary
 from namazu_tpu.signal.action import Action
@@ -86,6 +86,7 @@ _ACTIONS_RE = re.compile(rf"^{API_ROOT}/actions/([^/]+)(?:/([^/]+))?$")
 _CONTROL_RE = re.compile(rf"^{API_ROOT}/control$")
 _POLICY_TABLE_RE = re.compile(rf"^{API_ROOT}/policy/table$")
 _TELEMETRY_RE = re.compile(rf"^{API_ROOT}/telemetry$")
+_TENANCY_RE = re.compile(rf"^{API_ROOT}/tenancy$")
 _TRACES_RE = re.compile(r"^/traces(?:/([^/]+))?$")
 _CAUSALITY_RE = re.compile(r"^/causality/([^/]+)(?:/([^/]+))?$")
 
@@ -280,35 +281,59 @@ class QueuedEndpoint(Endpoint):
 
     # -- action dispatch -------------------------------------------------
 
-    def _queue_for(self, entity: str) -> ActionQueue:
+    def _queue_for(self, entity: str, ns: str = "") -> ActionQueue:
+        """The action queue of (run namespace, entity). The default
+        namespace's key is the bare entity id, so pre-tenancy clients
+        poll the exact queues they always did (doc/tenancy.md)."""
+        key = tenancy.route_key(ns, entity)
         with self._queues_lock:
-            q = self._queues.get(entity)
+            q = self._queues.get(key)
             if q is None:
-                q = self._queues[entity] = ActionQueue()
+                q = self._queues[key] = ActionQueue()
             return q
 
     def send_action(self, action: Action) -> None:
-        self._queue_for(action.entity_id).put(action)
+        self._queue_for(action.entity_id,
+                        tenancy.ns_of(action)).put(action)
 
     def send_actions(self, actions: List[Action]) -> None:
-        """Batch fan-through: group by entity (order preserved within
-        each), resolve every queue under ONE ``_queues_lock``
-        acquisition, then one ``put_many`` (one queue lock + one
-        wakeup) per entity — instead of lock/unlock churn per action."""
+        """Batch fan-through: group by (namespace, entity) (order
+        preserved within each), resolve every queue under ONE
+        ``_queues_lock`` acquisition, then one ``put_many`` (one queue
+        lock + one wakeup) per entity — instead of lock/unlock churn
+        per action."""
         if len(actions) == 1:
             return self.send_action(actions[0])
-        by_entity: Dict[str, List[Action]] = {}
+        by_key: Dict[str, List[Action]] = {}
         for action in actions:
-            by_entity.setdefault(action.entity_id, []).append(action)
+            by_key.setdefault(tenancy.signal_route_key(action),
+                              []).append(action)
         with self._queues_lock:
             queues = {}
-            for entity in by_entity:
-                q = self._queues.get(entity)
+            for key in by_key:
+                q = self._queues.get(key)
                 if q is None:
-                    q = self._queues[entity] = ActionQueue()
-                queues[entity] = q
-        for entity, batch in by_entity.items():
-            queues[entity].put_many(batch)
+                    q = self._queues[key] = ActionQueue()
+                queues[key] = q
+        for key, batch in by_key.items():
+            queues[key].put_many(batch)
+
+    def forget_namespace(self, ns: str) -> int:
+        """Drop one namespace's action queues (a released/reclaimed
+        tenant): a re-lease of the same run name must never poll a dead
+        incarnation's undelivered actions, and a long-lived host must
+        not leak one queue per entity per lease. Parked pollers on the
+        dropped queues are superseded (they return empty and the client
+        re-polls into nothing)."""
+        if not ns:
+            return 0
+        prefix = ns + tenancy.ROUTE_SEP
+        with self._queues_lock:
+            dead = [k for k in self._queues if k.startswith(prefix)]
+            queues = [self._queues.pop(k) for k in dead]
+        for q in queues:
+            q.supersede()
+        return len(dead)
 
     def ack_action(self, entity: str, action: Action) -> None:
         """Observability for one acknowledged (delivered) action."""
@@ -323,7 +348,7 @@ class QueuedEndpoint(Endpoint):
 
     # -- zero-RTT edge backhaul (doc/performance.md) ---------------------
 
-    def ingest_backhaul(self, doc, entity: str):
+    def ingest_backhaul(self, doc, entity: str, ns: str = ""):
         """Decode + dedupe one backhaul request body
         (``{"items": [{"event": ..., "decision": ...}, ...]}``) and
         reconcile the fresh items into the hub. Returns
@@ -359,28 +384,118 @@ class QueuedEndpoint(Endpoint):
             pairs.append((sig, decision))
         fresh = [(ev, d) for ev, d in pairs
                  if not self.note_backhaul_uuid(ev.uuid)]
+        if ns:
+            for ev, _ in fresh:
+                tenancy.set_ns(ev, ns)
         if fresh:
             self.hub.post_edge_backhaul(fresh, self.NAME)
         return len(fresh), len(pairs) - len(fresh)
 
 
 class _TrackingHTTPServer(ThreadingHTTPServer):
-    """ThreadingHTTPServer that knows its open connections, so a
-    simulated crash (`Orchestrator.abandon`, the chaos harness's
-    in-process kill -9) can sever them the way real process death
-    would — otherwise an inspector's keep-alive long-poll keeps talking
-    to a zombie handler thread of a dead orchestrator instead of
-    reconnecting to its successor."""
+    """ThreadingHTTPServer with (a) connection tracking, so a simulated
+    crash (`Orchestrator.abandon`, the chaos harness's in-process
+    kill -9) can sever open connections the way real process death
+    would, and (b) a BOUNDED handler pool (doc/tenancy.md): connections
+    are served by at most ``max_threads`` lazily-spawned workers, with
+    overflow connections queued — 8 campaigns' clients hitting one
+    orchestrator grow a queue, not an unbounded thread count (the
+    stdlib mixin spawned one thread per connection, forever)."""
 
-    def __init__(self, *args, **kw):
+    #: an idle pool worker exits after this long (a short burst's
+    #: threads drain back instead of lingering for the process life)
+    IDLE_EXIT_S = 30.0
+
+    def __init__(self, *args, max_threads: int = 64, **kw):
         super().__init__(*args, **kw)
         self._open_requests: set = set()
         self._open_lock = threading.Lock()
+        self._max_threads = max(1, int(max_threads))
+        # condition-based hand-off (NOT a bare Queue): the spawn
+        # decision and the idle-waiter accounting happen under ONE
+        # lock, so the two lost-wakeup races a stale idle count allows
+        # (enqueue beside a worker mid-dequeue, enqueue beside a
+        # worker mid-retire) are closed by construction — the put-side
+        # invariant is pending <= idle_waiters + spawned workers
+        self._conn_cond = threading.Condition()
+        self._conn_pending: deque = deque()
+        self._idle_waiters = 0
+        self._threads_alive = 0
+        self._pool_stopped = False
 
     def process_request(self, request, client_address):
         with self._open_lock:
             self._open_requests.add(request)
-        super().process_request(request, client_address)
+        with self._conn_cond:
+            if self._pool_stopped:
+                pending = 0
+            else:
+                self._conn_pending.append((request, client_address))
+                pending = len(self._conn_pending)
+            # soft cap: whenever queued connections outnumber waiting
+            # workers, spawn — beyond max_threads the pool grows like
+            # the old thread-per-connection server did (long-lived
+            # keep-alive connections, long-polls included, each hold a
+            # worker; starving them in the queue would strand
+            # entities). The cap's win is burst absorption: short
+            # connections reuse pooled workers instead of costing a
+            # thread each, and the overflow gauge (nmz_rest_conn_
+            # threads vs max) shows sustained pressure.
+            spawn = pending > self._idle_waiters
+            if spawn:
+                self._threads_alive += 1
+            alive = self._threads_alive
+            self._conn_cond.notify()
+        if not pending:
+            self.shutdown_request(request)  # stopping: refuse politely
+            return
+        if spawn:
+            threading.Thread(target=self._conn_worker,
+                             name="rest-conn", daemon=True).start()
+        obs.rest_conn_pool(alive, pending - 1)
+
+    def _next_conn(self):
+        """One connection to serve, or None to retire (idle past
+        IDLE_EXIT_S, or the pool stopped). All accounting under the
+        condition lock."""
+        with self._conn_cond:
+            deadline = time.monotonic() + self.IDLE_EXIT_S
+            while True:
+                if self._conn_pending:
+                    return self._conn_pending.popleft()
+                remaining = deadline - time.monotonic()
+                if self._pool_stopped or remaining <= 0:
+                    self._threads_alive -= 1
+                    return None
+                self._idle_waiters += 1
+                try:
+                    self._conn_cond.wait(remaining)
+                finally:
+                    self._idle_waiters -= 1
+
+    def _conn_worker(self):
+        while True:
+            item = self._next_conn()
+            if item is None:
+                return
+            request, client_address = item
+            try:
+                self.finish_request(request, client_address)
+            except Exception:
+                self.handle_error(request, client_address)
+            finally:
+                self.shutdown_request(request)
+
+    def stop_pool(self) -> None:
+        """Retire every pool worker and close queued-but-unserved
+        connections (shutdown/sever path)."""
+        with self._conn_cond:
+            self._pool_stopped = True
+            drained = list(self._conn_pending)
+            self._conn_pending.clear()
+            self._conn_cond.notify_all()
+        for request, _ in drained:
+            self.shutdown_request(request)
 
     def shutdown_request(self, request):
         with self._open_lock:
@@ -404,7 +519,8 @@ class RestEndpoint(QueuedEndpoint):
     def __init__(self, port: int = 10080, host: str = "127.0.0.1",
                  poll_timeout: float = 30.0, ingress_cap: int = 0,
                  retry_after_s: float = 1.0,
-                 advertise_codec: bool = True):
+                 advertise_codec: bool = True,
+                 max_threads: int = 64):
         super().__init__()
         self._host = host
         self._port = port
@@ -421,6 +537,9 @@ class RestEndpoint(QueuedEndpoint):
         # 0 = unbounded (the pre-backpressure behavior).
         self.ingress_cap = max(0, int(ingress_cap))
         self.retry_after_s = max(0.0, float(retry_after_s))
+        # bounded connection-handler pool (doc/tenancy.md): beyond this
+        # many concurrent connections, new ones queue for a handler
+        self.max_threads = max(1, int(max_threads))
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_mono = time.monotonic()  # /healthz uptime anchor
@@ -449,6 +568,30 @@ class RestEndpoint(QueuedEndpoint):
 
             def log_message(self, fmt, *args):  # route to our logger
                 log.debug("http: " + fmt, *args)
+
+            def _entity_ok(self, entity: str) -> bool:
+                """False AFTER replying 400 for an entity id that
+                would alias a composite route key (tenancy plane:
+                '\x1f' is the namespace separator)."""
+                if tenancy.ROUTE_SEP in entity:
+                    self._reply(400, {"error": "entity id must not "
+                                      "contain \x1f"})
+                    return False
+                return True
+
+            def _req_ns(self):
+                """The request's run namespace (the X-Nmz-Run header,
+                tenancy plane): '' = the process-default namespace
+                (every pre-tenancy client). Returns None AFTER replying
+                400 when the header value is malformed."""
+                raw = self.headers.get(tenancy.RUN_HEADER)
+                if raw is None:
+                    return ""
+                try:
+                    return tenancy.validate_ns(raw.strip())
+                except ValueError as e:
+                    self._reply(400, {"error": str(e)})
+                    return None
 
             def _req_codec(self) -> str:
                 """The request's negotiated codec (the X-Nmz-Codec
@@ -586,6 +729,8 @@ class RestEndpoint(QueuedEndpoint):
                     return self._post_event(m.group(1), m.group(2))
                 if _TELEMETRY_RE.match(url.path):
                     return self._post_telemetry()
+                if _TENANCY_RE.match(url.path):
+                    return self._post_tenancy()
                 if _CONTROL_RE.match(url.path):
                     return self._post_control(parse_qs(url.query))
                 self._reply(404, {"error": f"no route {url.path}"})
@@ -611,6 +756,41 @@ class RestEndpoint(QueuedEndpoint):
                     return self._reply(400, {"error": str(e)})
                 self._reply(200, ack)
 
+            def _post_tenancy(self) -> None:
+                """The slot-leasing wire (doc/tenancy.md): one JSON op
+                body (lease/renew/release/runs) against this host's
+                RunRegistry. 404 on single-run orchestrators — the
+                plane simply isn't there."""
+                try:
+                    raw = self._read_body()  # always drain (keep-alive)
+                except ValueError as e:
+                    return self._reply(400, {"error": str(e)})
+                registry = endpoint.hub.run_registry
+                if registry is None:
+                    return self._reply(
+                        404, {"error": "this orchestrator hosts no "
+                              "tenancy plane"})
+                try:
+                    doc = json.loads(raw)
+                except ValueError as e:
+                    return self._reply(400, {"error": str(e)})
+                if not isinstance(doc, dict):
+                    return self._reply(
+                        400, {"error": "tenancy body must be a JSON "
+                              "object"})
+                from namazu_tpu.policy.base import PolicyError
+                from namazu_tpu.tenancy.registry import (TenancyError,
+                                                         handle_tenancy_op)
+                try:
+                    resp = handle_tenancy_op(doc, registry)
+                except (TenancyError, PolicyError, ValueError) as e:
+                    return self._reply(400, {"error": str(e)})
+                if resp is None:
+                    return self._reply(
+                        400, {"error": f"unknown tenancy op "
+                              f"{doc.get('op')!r}"})
+                self._reply(200, resp)
+
             def _post_event(self, entity: str, uuid: str) -> None:
                 # the body must be READ even when refusing — an unread
                 # body desyncs the keep-alive connection (the next
@@ -635,10 +815,14 @@ class RestEndpoint(QueuedEndpoint):
                         400,
                         {"error": "url entity/uuid do not match event body"},
                     )
+                ns = self._req_ns()
+                if ns is None or not self._entity_ok(entity):
+                    return
                 if endpoint.note_event_uuid(sig.uuid):
                     # retry of a POST whose 200 was lost: the event is
                     # already in the hub — idempotent ack
                     return self._reply(200, {"duplicate": True})
+                tenancy.set_ns(sig, ns)
                 endpoint.hub.post_event(sig, endpoint.NAME)
                 self._reply(200, {})
 
@@ -683,8 +867,14 @@ class RestEndpoint(QueuedEndpoint):
                                   f"{sig.entity_id!r} does not match url "
                                   f"entity {entity!r}"})
                     events.append(sig)
+                ns = self._req_ns()
+                if ns is None or not self._entity_ok(entity):
+                    return
                 fresh = [ev for ev in events
                          if not endpoint.note_event_uuid(ev.uuid)]
+                if ns:
+                    for ev in fresh:
+                        tenancy.set_ns(ev, ns)
                 if fresh:
                     endpoint.hub.post_events(fresh, endpoint.NAME)
                 self._reply(200, {"accepted": len(fresh),
@@ -709,9 +899,12 @@ class RestEndpoint(QueuedEndpoint):
                     doc = self._decode_body(raw)
                 except ValueError as e:
                     return self._reply_badbody(e)
+                ns = self._req_ns()
+                if ns is None or not self._entity_ok(entity):
+                    return
                 try:
                     accepted, duplicates = endpoint.ingest_backhaul(
-                        doc, entity)
+                        doc, entity, ns=ns)
                 except ValueError as e:
                     return self._reply(400, {"error": str(e)})
                 self._reply(200, {
@@ -766,6 +959,9 @@ class RestEndpoint(QueuedEndpoint):
                     return self._reply(404, {"error": f"no route {url.path}"})
                 entity = m.group(1)
                 query = parse_qs(url.query)
+                ns = self._req_ns()
+                if ns is None or not self._entity_ok(entity):
+                    return
                 # chaos seam: stall a long-poll (the inspector's receive
                 # loop must ride it out, not die)
                 fault = chaos.decide("endpoint.poll.stall")
@@ -775,7 +971,7 @@ class RestEndpoint(QueuedEndpoint):
                 if raw_batch is None:
                     # per-event wire (pre-batch inspectors): one head
                     # action as the whole body
-                    action = endpoint._queue_for(entity).peek(
+                    action = endpoint._queue_for(entity, ns).peek(
                         endpoint.poll_timeout)
                     if action is None:
                         return self._reply(204)
@@ -798,7 +994,7 @@ class RestEndpoint(QueuedEndpoint):
                     return self._reply(
                         400, {"error": f"bad linger_ms={raw_linger!r} "
                               "(want a number)"})
-                actions = endpoint._queue_for(entity).peek_batch(
+                actions = endpoint._queue_for(entity, ns).peek_batch(
                     max_n, endpoint.poll_timeout, linger=linger)
                 if not actions:
                     return self._reply(204, headers=self._tv_headers())
@@ -936,9 +1132,12 @@ class RestEndpoint(QueuedEndpoint):
                 if not m:
                     return self._reply(404, {"error": f"no route {url.path}"})
                 entity, uuid = m.group(1), m.group(2)
+                ns = self._req_ns()
+                if ns is None or not self._entity_ok(entity):
+                    return
                 if uuid is None:
-                    return self._delete_batch(entity)
-                action = endpoint._queue_for(entity).delete(uuid)
+                    return self._delete_batch(entity, ns)
+                action = endpoint._queue_for(entity, ns).delete(uuid)
                 if action is not None:
                     self._ack(entity, action)
                     self._reply(200, {})
@@ -948,7 +1147,7 @@ class RestEndpoint(QueuedEndpoint):
             def _ack(self, entity: str, action: Action) -> None:
                 endpoint.ack_action(entity, action)
 
-            def _delete_batch(self, entity: str) -> None:
+            def _delete_batch(self, entity: str, ns: str = "") -> None:
                 """Multi-uuid acknowledge: ``{"uuids": [...]}`` in the
                 body, one queue-lock acquisition for the whole batch.
                 Unknown uuids come back in ``missing`` with a 200 — a
@@ -965,13 +1164,14 @@ class RestEndpoint(QueuedEndpoint):
                         400, {"error": "body must be {\"uuids\": "
                               "[\"...\", ...]}"})
                 deleted, missing = \
-                    endpoint._queue_for(entity).delete_many(uuids)
+                    endpoint._queue_for(entity, ns).delete_many(uuids)
                 for action in deleted:
                     self._ack(entity, action)
                 self._reply(200, {"deleted": [a.uuid for a in deleted],
                                   "missing": missing})
 
-        self._server = _TrackingHTTPServer((self._host, self._port), Handler)
+        self._server = _TrackingHTTPServer((self._host, self._port), Handler,
+                                           max_threads=self.max_threads)
         self._server.daemon_threads = True
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="rest-endpoint", daemon=True
@@ -983,6 +1183,7 @@ class RestEndpoint(QueuedEndpoint):
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
+            self._server.stop_pool()
             self._server = None
 
     def sever(self) -> int:
@@ -1004,6 +1205,7 @@ class RestEndpoint(QueuedEndpoint):
         except OSError:  # pragma: no cover - defensive
             pass
         n = srv.sever_connections()
+        srv.stop_pool()
         with self._queues_lock:
             queues = list(self._queues.values())
         for q in queues:

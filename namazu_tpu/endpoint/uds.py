@@ -46,7 +46,7 @@ from typing import List, Optional
 
 import threading
 
-from namazu_tpu import obs
+from namazu_tpu import obs, tenancy
 from namazu_tpu.endpoint.framed import FramedServer
 from namazu_tpu.endpoint.rest import QueuedEndpoint
 from namazu_tpu.endpoint.shm import (DEFAULT_CAPACITY, ShmIngressThread,
@@ -141,6 +141,8 @@ class UdsEndpoint(QueuedEndpoint):
             return self._op_table()
         if op == "shm_open":
             return self._op_shm_open(req)
+        if op in ("lease", "renew", "release", "runs"):
+            return self._op_tenancy(req)
         # observability ops (telemetry push / fleet view / local
         # metrics dump — obs/federation.py): the uds wire serves the
         # same fleet surface as the REST routes, so a same-host fleet
@@ -151,6 +153,49 @@ class UdsEndpoint(QueuedEndpoint):
         if resp is not None:
             return resp
         return {"ok": False, "error": f"unknown op {op!r}"}
+
+    @staticmethod
+    def _entity_error(entity: str):
+        """Reject entity ids that would alias a composite route key
+        (tenancy/shard.py): '\x1f' inside an entity id would misparse
+        as a namespace separator in journals and watchdog sweeps."""
+        if tenancy.ROUTE_SEP in entity:
+            return {"ok": False,
+                    "error": "entity id must not contain \x1f"}
+        return None
+
+    @staticmethod
+    def _req_ns(req: dict):
+        """``(namespace, None)`` or ``(None, error resp)`` for one op's
+        ``run`` field (tenancy plane; absent = the process-default
+        namespace, every pre-tenancy client)."""
+        raw = req.get(tenancy.RUN_FIELD)
+        if raw is None:
+            return "", None
+        try:
+            return tenancy.validate_ns(raw), None
+        except ValueError as e:
+            return None, {"ok": False, "error": str(e)}
+
+    def _op_tenancy(self, req: dict) -> dict:
+        """The framed face of the slot-leasing wire (doc/tenancy.md) —
+        same op grammar as ``POST /api/v3/tenancy``."""
+        registry = getattr(self.hub, "run_registry", None) \
+            if getattr(self, "hub", None) is not None else None
+        if registry is None:
+            return {"ok": False,
+                    "error": "this orchestrator hosts no tenancy plane"}
+        from namazu_tpu.policy.base import PolicyError
+        from namazu_tpu.tenancy.registry import (TenancyError,
+                                                 handle_tenancy_op)
+        try:
+            resp = handle_tenancy_op(req, registry)
+        except (TenancyError, PolicyError, ValueError) as e:
+            return {"ok": False, "error": str(e)}
+        if resp is None:  # pragma: no cover - dispatcher filtered ops
+            return {"ok": False,
+                    "error": f"unknown tenancy op {req.get('op')!r}"}
+        return resp
 
     def _ingress_refusal(self) -> Optional[dict]:
         """The uds face of the bounded-ingress plane: consult the chaos
@@ -202,6 +247,12 @@ class UdsEndpoint(QueuedEndpoint):
             return {"ok": False,
                     "error": "post_batch needs entity + a non-empty "
                              "events array"}
+        bad_entity = self._entity_error(entity)
+        if bad_entity is not None:
+            return bad_entity
+        ns, bad = self._req_ns(req)
+        if bad is not None:
+            return bad
         refusal = self._ingress_refusal()
         if refusal is not None:
             return refusal
@@ -209,6 +260,9 @@ class UdsEndpoint(QueuedEndpoint):
         if err is not None:
             return {"ok": False, "error": err}
         fresh = [ev for ev in events if not self.note_event_uuid(ev.uuid)]
+        if ns:
+            for ev in fresh:
+                tenancy.set_ns(ev, ns)
         if fresh:
             self.hub.post_events(fresh, self.NAME)
         return {"ok": True, "accepted": len(fresh),
@@ -261,8 +315,15 @@ class UdsEndpoint(QueuedEndpoint):
         if err is not None:
             log.warning("shm post_batch frame dropped: %s", err)
             return
+        ns, bad = self._req_ns(doc)
+        if bad is not None:
+            log.warning("shm post_batch frame dropped: %s", bad["error"])
+            return
         fresh = [ev for ev in events
                  if not self.note_event_uuid(ev.uuid)]
+        if ns:
+            for ev in fresh:
+                tenancy.set_ns(ev, ns)
         if fresh:
             self.hub.post_events(fresh, self.NAME)
 
@@ -270,6 +331,9 @@ class UdsEndpoint(QueuedEndpoint):
         entity = str(req.get("entity") or "")
         if not entity:
             return {"ok": False, "error": "poll needs entity"}
+        bad_entity = self._entity_error(entity)
+        if bad_entity is not None:
+            return bad_entity
         try:
             batch = max(1, int(req.get("batch", 1)))
             linger = min(max(0.0, float(req.get("linger_ms", 0))),
@@ -279,7 +343,10 @@ class UdsEndpoint(QueuedEndpoint):
                           self.poll_timeout)
         except (TypeError, ValueError) as e:
             return {"ok": False, "error": f"bad poll params: {e}"}
-        actions = self._queue_for(entity).peek_batch(
+        ns, bad = self._req_ns(req)
+        if bad is not None:
+            return bad
+        actions = self._queue_for(entity, ns).peek_batch(
             batch, timeout, linger=linger)
         if actions:
             obs.event_batch("actions_poll", len(actions))
@@ -288,12 +355,18 @@ class UdsEndpoint(QueuedEndpoint):
 
     def _op_ack(self, req: dict) -> dict:
         entity = str(req.get("entity") or "")
+        bad_entity = self._entity_error(entity)
+        if bad_entity is not None:
+            return bad_entity
         uuids = req.get("uuids")
         if (not entity or not isinstance(uuids, list) or not uuids
                 or not all(isinstance(u, str) for u in uuids)):
             return {"ok": False,
                     "error": "ack needs entity + a uuids array"}
-        deleted, missing = self._queue_for(entity).delete_many(uuids)
+        ns, bad = self._req_ns(req)
+        if bad is not None:
+            return bad
+        deleted, missing = self._queue_for(entity, ns).delete_many(uuids)
         for action in deleted:
             self.ack_action(entity, action)
         return {"ok": True, "deleted": [a.uuid for a in deleted],
@@ -303,11 +376,18 @@ class UdsEndpoint(QueuedEndpoint):
         entity = str(req.get("entity") or "")
         if not entity:
             return {"ok": False, "error": "backhaul needs entity"}
+        bad_entity = self._entity_error(entity)
+        if bad_entity is not None:
+            return bad_entity
+        ns, bad = self._req_ns(req)
+        if bad is not None:
+            return bad
         refusal = self._ingress_refusal()
         if refusal is not None:
             return refusal
         try:
-            accepted, duplicates = self.ingest_backhaul(req, entity)
+            accepted, duplicates = self.ingest_backhaul(req, entity,
+                                                        ns=ns)
         except ValueError as e:
             return {"ok": False, "error": str(e)}
         return {"ok": True, "accepted": accepted,

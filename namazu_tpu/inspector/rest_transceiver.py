@@ -58,7 +58,7 @@ import urllib.error
 from typing import List, Optional
 from urllib.parse import urlsplit
 
-from namazu_tpu import chaos, obs
+from namazu_tpu import chaos, obs, tenancy
 from namazu_tpu.endpoint.rest import API_ROOT, TABLE_VERSION_HEADER
 from namazu_tpu.signal import binary as _binary
 from namazu_tpu.inspector import edge as _edge_mod
@@ -125,7 +125,11 @@ class _KeepAliveConn:
     construction (event POSTs dedupe server-side, GET peeks, DELETE acks
     report already-gone uuids as ``missing``)."""
 
-    def __init__(self, base_url: str, timeout: float, abort=None):
+    def __init__(self, base_url: str, timeout: float, abort=None,
+                 extra_headers: Optional[dict] = None):
+        #: headers added to EVERY request (the tenancy plane's
+        #: X-Nmz-Run namespace piggyback; doc/tenancy.md)
+        self.extra_headers = dict(extra_headers or {})
         parts = urlsplit(base_url)
         if parts.scheme not in ("http", "https"):
             raise ValueError(f"unsupported scheme {parts.scheme!r}")
@@ -173,6 +177,7 @@ class _KeepAliveConn:
         ``codec`` names the body's encoding and asks for the response
         in kind (the X-Nmz-Codec header)."""
         headers = {"Connection": "keep-alive"}
+        headers.update(self.extra_headers)
         if codec == _binary.CODEC_BINARY:
             headers[_binary.CODEC_HEADER] = _binary.CODEC_BINARY
             if body is not None:
@@ -282,8 +287,13 @@ class RestTransceiver(UnackedReplayMixin, Transceiver):
                  backhaul_window: float = 0.05,
                  codec: str = "auto",
                  edge_shards: int = 0,
-                 shard_pool=None):
+                 shard_pool=None,
+                 run_ns: str = ""):
         super().__init__(entity_id)
+        #: tenancy namespace (doc/tenancy.md): rides every request as
+        #: the X-Nmz-Run header; "" = the process-default namespace
+        #: (the pre-tenancy wire, byte-identical)
+        self.run_ns = str(run_ns or "")
         # the wire codec preference (doc/performance.md "Binary wire +
         # sharded edge"): "auto" upgrades to the binary codec once the
         # server advertises it (JSON until then — pre-binary peers are
@@ -312,9 +322,13 @@ class RestTransceiver(UnackedReplayMixin, Transceiver):
         # outbound connection: shared by caller threads (and the flush
         # thread), serialized by _conn_lock; the receive thread owns its
         # own connection so a long-poll never blocks a POST
-        self._post_conn = _KeepAliveConn(self.base, timeout=30.0)
+        ns_headers = ({tenancy.RUN_HEADER: self.run_ns}
+                      if self.run_ns else None)
+        self._post_conn = _KeepAliveConn(self.base, timeout=30.0,
+                                         extra_headers=ns_headers)
         self._recv_conn = _KeepAliveConn(self.base, timeout=65.0,
-                                         abort=self._stop.is_set)
+                                         abort=self._stop.is_set,
+                                         extra_headers=ns_headers)
         self._conn_lock = threading.Lock()
         # coalescing buffer (use_batch): _buf_cond guards the buffer,
         # _flush_lock serializes whole flushes so concurrent callers

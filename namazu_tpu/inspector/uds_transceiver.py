@@ -200,8 +200,13 @@ class UdsTransceiver(UnackedReplayMixin, Transceiver):
                  edge_shards: int = 0,
                  shard_pool=None,
                  shm: bool = False,
-                 shm_capacity: int = 0):
+                 shm_capacity: int = 0,
+                 run_ns: str = ""):
         super().__init__(entity_id)
+        #: tenancy namespace (doc/tenancy.md): rides every op as the
+        #: "run" field; "" = the process-default namespace (the
+        #: pre-tenancy wire, byte-identical)
+        self.run_ns = str(run_ns or "")
         # shared-memory fast lane (endpoint/shm.py): opened with the
         # shm_open op at start(); event batches ride the ring, acked
         # ops (poll/ack/backhaul/table) stay on this connection. An
@@ -247,6 +252,13 @@ class UdsTransceiver(UnackedReplayMixin, Transceiver):
                     send_backhaul=self._post_backhaul_once,
                     backhaul_window=backhaul_window)
 
+    def _ns_doc(self, doc: dict) -> dict:
+        """Tag one op doc with this transceiver's run namespace (no-op
+        for the default namespace: pre-tenancy ops stay byte-identical)."""
+        if self.run_ns:
+            doc["run"] = self.run_ns
+        return doc
+
     # -- outbound ---------------------------------------------------------
 
     def _post(self, event: Event) -> None:
@@ -276,9 +288,9 @@ class UdsTransceiver(UnackedReplayMixin, Transceiver):
                 log.debug("chaos: dropped %d event(s) pre-shm",
                           len(chunk))
                 return
-            payload = _binary.dumps(
+            payload = _binary.dumps(self._ns_doc(
                 {"op": "post_batch", "entity": entity,
-                 "events": [ev.to_jsonable() for ev in chunk]})
+                 "events": [ev.to_jsonable() for ev in chunk]}))
             # the ring is SPSC: every writer thread (callers, the
             # flush thread, the receive loop's unacked replay) must
             # serialize — the op wire's _conn_lock is that writer lock
@@ -299,8 +311,9 @@ class UdsTransceiver(UnackedReplayMixin, Transceiver):
                 # ring full: the acked op wire below IS the
                 # backpressure
                 obs.shm_ring_full(entity)
-        req = {"op": "post_batch", "entity": entity,
-               "events": [ev.to_jsonable() for ev in chunk]}
+        req = self._ns_doc({"op": "post_batch", "entity": entity,
+                            "events": [ev.to_jsonable()
+                                       for ev in chunk]})
         with self._conn_lock:
             t0 = time.perf_counter()
             resp = self._post_conn.request(req)
@@ -368,7 +381,8 @@ class UdsTransceiver(UnackedReplayMixin, Transceiver):
 
     def _post_backhaul_once(self, entity: str,
                             items: List[dict]) -> Optional[int]:
-        req = {"op": "backhaul", "entity": entity, "items": items}
+        req = self._ns_doc({"op": "backhaul", "entity": entity,
+                            "items": items})
         with self._conn_lock:
             t0 = time.perf_counter()
             resp = self._post_conn.request(req)
@@ -505,12 +519,12 @@ class UdsTransceiver(UnackedReplayMixin, Transceiver):
             self._recv_conn.close()
             raise OSError("chaos: uds keep-alive severed")
         t0 = time.perf_counter()
-        resp = self._recv_conn.request({
+        resp = self._recv_conn.request(self._ns_doc({
             "op": "poll", "entity": self.entity_id,
             "batch": self.poll_batch,
             "linger_ms": int(self.poll_linger * 1000),
             "timeout_s": 25.0,
-        })
+        }))
         obs.transport_rtt("poll", time.perf_counter() - t0)
         if not resp.get("ok"):
             raise RuntimeError(f"uds poll: {resp.get('error', 'failed')}")
@@ -525,10 +539,10 @@ class UdsTransceiver(UnackedReplayMixin, Transceiver):
         if not actions:
             return []
         t0 = time.perf_counter()
-        ack = self._recv_conn.request({
+        ack = self._recv_conn.request(self._ns_doc({
             "op": "ack", "entity": self.entity_id,
             "uuids": [a.uuid for a in actions],
-        })
+        }))
         obs.transport_rtt("ack", time.perf_counter() - t0)
         if not ack.get("ok"):
             raise RuntimeError(f"uds ack: {ack.get('error', 'failed')}")

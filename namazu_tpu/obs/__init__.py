@@ -118,6 +118,11 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     sidecar_request,
     slo_breach,
     slo_burn,
+    tenancy_events,
+    tenancy_parked,
+    tenancy_reclaim,
+    tenancy_runs,
+    rest_conn_pool,
     span,
     span_delta,
     table_version,

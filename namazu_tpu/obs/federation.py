@@ -365,7 +365,7 @@ class _FamilyState:
 class _InstanceState:
     __slots__ = ("job", "instance", "last_seq", "last_seen", "first_seen",
                  "interval_s", "pushes", "duplicates", "families",
-                 "rates")
+                 "rates", "run_rates")
 
     def __init__(self, job: str, instance: str, now: float) -> None:
         self.job = job
@@ -379,6 +379,10 @@ class _InstanceState:
         self.families: Dict[str, _FamilyState] = {}
         #: counter name -> (t, total, rate) for the summary rates
         self.rates: Dict[str, Tuple[float, float, Optional[float]]] = {}
+        #: tenancy plane: run namespace -> (t, total, rate) derived
+        #: from nmz_tenancy_events_total{run} (the /fleet RUN rows)
+        self.run_rates: Dict[str, Tuple[float, float,
+                                        Optional[float]]] = {}
 
 
 class FleetAggregator:
@@ -586,6 +590,22 @@ class FleetAggregator:
             elif prev is not None:
                 rate = prev[2]
             st.rates[name] = (now, total, rate)
+        # per-run-namespace rates (tenancy plane): same derivation,
+        # one series per `run` label value
+        by_run = self._counter_by(st, spans.TENANCY_EVENTS, "run")
+        for run, total in by_run.items():
+            prev = st.run_rates.get(run)
+            rate = None
+            if prev is not None and now > prev[0]:
+                dt = max(now - prev[0], 0.5 * st.interval_s)
+                rate = max(0.0, total - prev[1]) / dt
+            elif prev is not None:
+                rate = prev[2]
+            st.run_rates[run] = (now, total, rate)
+        # runs that vanished from the push (released/reclaimed
+        # namespaces) drop their stale rate rows
+        for run in [r for r in st.run_rates if r not in by_run]:
+            del st.run_rates[run]
 
     # -- federation hop ---------------------------------------------------
 
@@ -667,6 +687,24 @@ class FleetAggregator:
             return None
         vals = [v for v in fs.samples.values() if isinstance(v, float)]
         return sum(vals) if vals else None
+
+    def _runs_section(self, st: _InstanceState) -> Dict[str, Any]:
+        """``{"runs": {run: {...}}}`` for one instance, or ``{}`` when
+        it serves no tenant namespaces (caller holds the lock)."""
+        totals = self._counter_by(st, spans.TENANCY_EVENTS, "run")
+        if not totals:
+            return {}
+        parked = self._counter_by(st, spans.TENANCY_PARKED, "run")
+        out: Dict[str, Any] = {}
+        for run, total in sorted(totals.items()):
+            rate = st.run_rates.get(run, (0, 0, None))[2]
+            out[run] = {
+                "events_total": round(total),
+                "events_per_sec": (round(rate, 1)
+                                   if rate is not None else None),
+                "parked": round(parked.get(run, 0)),
+            }
+        return {"runs": out}
 
     def _hist_quantile(self, st: _InstanceState, name: str,
                        q: float) -> Optional[float]:
@@ -821,6 +859,12 @@ class FleetAggregator:
                         st, spans.EDGE_TABLE_STALENESS),
                     "edge_parked": self._gauge_sum(
                         st, spans.EDGE_PARKED),
+                    # tenancy plane (doc/tenancy.md): one row per run
+                    # namespace this instance serves — events, rate,
+                    # and parked depth per tenant, the `tools top` RUN
+                    # table. Instances without tenancy metrics carry
+                    # no key (pre-tenancy payload shape preserved).
+                    **self._runs_section(st),
                 })
         rows.sort(key=lambda r: (r["job"], r["instance"]))
         spans.fleet_occupancy(len(rows), stale_n)

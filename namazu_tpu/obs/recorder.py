@@ -242,6 +242,12 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._runs: "OrderedDict[str, RunTrace]" = OrderedDict()
         self._current: Optional[RunTrace] = None
+        # tenancy plane (doc/tenancy.md): namespace tag -> concurrently
+        # OPEN RunTrace. Pinned runs record in parallel with (and
+        # independent of) the `_current` run; signals tagged with a
+        # namespace resolve here, untagged ones keep resolving to
+        # `_current` — so N tenants' records never interleave
+        self._pinned: Dict[str, RunTrace] = {}
         # cumulative GA generations (or MCTS simulations) evolved in this
         # process; decisions snapshot it so a replayed delay points back
         # at the search round that produced its table
@@ -265,10 +271,60 @@ class FlightRecorder:
         with self._lock:
             self._runs[rid] = run
             self._runs.move_to_end(rid)
-            while len(self._runs) > self.max_runs:
-                self._runs.popitem(last=False)
+            self._evict_runs()
             self._current = run
         return rid
+
+    def _evict_runs(self) -> None:
+        """Ring eviction; caller holds the lock. Still-OPEN pinned runs
+        are never evicted (a tenant's live trace must not vanish under
+        it because seven siblings started later) — the ring can
+        temporarily exceed ``max_runs`` by the number of live pins,
+        which the lease table bounds."""
+        if len(self._runs) <= self.max_runs:
+            return
+        protected = {run.run_id for run in self._pinned.values()}
+        for rid in list(self._runs):
+            if len(self._runs) <= self.max_runs:
+                return
+            if rid in protected or self._runs[rid] is self._current:
+                continue
+            del self._runs[rid]
+
+    # -- pinned (tenancy) runs --------------------------------------------
+
+    def begin_pinned(self, tag: str, run_id: Optional[str] = None,
+                     now: Optional[float] = None,
+                     wall: Optional[float] = None) -> str:
+        """Open a run trace for namespace ``tag`` WITHOUT making it the
+        process-current run (tenancy plane: N runs record concurrently).
+        Returns the run id; with observability disabled no trace is
+        allocated and namespaced recording stays a no-op."""
+        rid = run_id or _uuid.uuid4().hex[:12]
+        if not metrics.enabled():
+            with self._lock:
+                self._pinned.pop(tag, None)
+            return rid
+        run = RunTrace(rid, self.max_records, now=now, wall=wall)
+        with self._lock:
+            self._runs[rid] = run
+            self._runs.move_to_end(rid)
+            self._pinned[tag] = run
+            self._evict_runs()
+        return rid
+
+    def end_pinned(self, tag: str, now: Optional[float] = None) -> None:
+        with self._lock:
+            run = self._pinned.pop(tag, None)
+            if run is not None:
+                run.ended_mono = time.monotonic() if now is None else now
+
+    def pinned(self, tag: str) -> Optional[RunTrace]:
+        return self._pinned.get(tag)
+
+    def pinned_run_id(self, tag: str) -> Optional[str]:
+        run = self._pinned.get(tag)
+        return None if run is None else run.run_id
 
     def end_run(self, run_id: Optional[str] = None,
                 now: Optional[float] = None) -> None:
@@ -334,6 +390,20 @@ def reset(max_runs: int = 8, max_records: int = 4096) -> FlightRecorder:
     return _recorder
 
 
+def _trace_for(sig) -> Optional[RunTrace]:
+    """The run trace a signal's records belong to: signals tagged with
+    a tenancy namespace (``sig._ns``, set at the ingress edge and
+    propagated event -> action) resolve to that namespace's PINNED run;
+    untagged signals keep resolving to the process-current run. A
+    namespaced signal with no pinned run records NOWHERE — leaking a
+    tenant's records into the default run would break the isolation
+    the tenancy plane promises (doc/tenancy.md)."""
+    tag = getattr(sig, "_ns", "")
+    if tag:
+        return _recorder.pinned(tag)
+    return _recorder.current()
+
+
 def begin_run(run_id: Optional[str] = None) -> str:
     # a new run means a new search: clear the stall detector's
     # fitness/novelty windows so run A's final plateau (or its absolute
@@ -374,7 +444,7 @@ def record_intercepted(event, endpoint: str,
                        now: Optional[float] = None) -> None:
     if not metrics.enabled():
         return
-    run = _recorder.current()
+    run = _trace_for(event)
     if run is None:
         return
     run.stamp(event.uuid, "intercepted", now=now,
@@ -387,7 +457,7 @@ def record_enqueued(event, policy: str,
                     now: Optional[float] = None) -> None:
     if not metrics.enabled():
         return
-    run = _recorder.current()
+    run = _trace_for(event)
     if run is None:
         return
     run.stamp(event.uuid, "enqueued", now=now,
@@ -398,7 +468,7 @@ def record_decided(event, policy: str,
                    now: Optional[float] = None) -> None:
     if not metrics.enabled():
         return
-    run = _recorder.current()
+    run = _trace_for(event)
     if run is None:
         return
     run.stamp(event.uuid, "decided", now=now,
@@ -410,7 +480,7 @@ def record_decision(event, policy: str, **detail: Any) -> None:
     schedule-generation id, fault flag, ...) to the event's record."""
     if not metrics.enabled():
         return
-    run = _recorder.current()
+    run = _trace_for(event)
     if run is None:
         return
     run.record_for(event.uuid, entity=event.entity_id, policy=policy,
@@ -422,7 +492,7 @@ def record_released(event, policy: str,
     """The policy's delay queue released the event (dwell is over)."""
     if not metrics.enabled():
         return
-    run = _recorder.current()
+    run = _trace_for(event)
     if run is None:
         return
     run.stamp(event.uuid, "released", now=now,
@@ -439,7 +509,7 @@ def record_edge(event, endpoint: str, policy: str, action,
     stage-by-stage replay would cost per event."""
     if not metrics.enabled():
         return
-    run = _recorder.current()
+    run = _trace_for(event)
     if run is None:
         return
     detail = {name: decision[name] for name in
@@ -475,7 +545,7 @@ def record_dispatched(action, kind: str,
     on one record), else by the action's own (shell/nop injections)."""
     if not metrics.enabled():
         return
-    run = _recorder.current()
+    run = _trace_for(action)
     if run is None:
         return
     key = action.event_uuid or action.uuid
@@ -489,7 +559,7 @@ def record_acked(action, now: Optional[float] = None) -> None:
     """The inspector acknowledged the action over REST."""
     if not metrics.enabled():
         return
-    run = _recorder.current()
+    run = _trace_for(action)
     if run is None:
         return
     run.stamp(action.event_uuid or action.uuid, "acked", now=now,

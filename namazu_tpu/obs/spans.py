@@ -114,6 +114,14 @@ FLEET_STALE_INSTANCES = "nmz_fleet_stale_instances"
 SLO_BURN = "nmz_slo_burn"
 SLO_BREACHES = "nmz_slo_breaches_total"
 CAMPAIGN_SLOTS = "nmz_campaign_slots_total"
+# tenancy plane (doc/tenancy.md): per-namespace serving telemetry —
+# the `run` label is the namespace name, the /fleet RUN dimension
+TENANCY_EVENTS = "nmz_tenancy_events_total"
+TENANCY_PARKED = "nmz_tenancy_parked"
+TENANCY_RUNS = "nmz_tenancy_runs"
+TENANCY_RECLAIMS = "nmz_tenancy_reclaims_total"
+REST_CONN_THREADS = "nmz_rest_conn_threads"
+REST_CONNS_QUEUED = "nmz_rest_conns_queued"
 
 # chaos + survivability plane (doc/robustness.md "Chaos plane"):
 # injected faults by point, ingress backpressure rejections, the
@@ -493,6 +501,60 @@ def campaign_slot(cls: str) -> None:
         CAMPAIGN_SLOTS, "campaign run slots finished, by class",
         ("slot_class",),
     ).labels(slot_class=cls).inc()
+
+
+def tenancy_events(run: str, n: int = 1) -> None:
+    """Events ingested for one tenant namespace (the per-run events/s
+    numerator of the /fleet RUN table)."""
+    if not metrics.enabled():
+        return
+    metrics.get().counter(
+        TENANCY_EVENTS, "events ingested per tenant run namespace",
+        ("run",),
+    ).labels(run=run).inc(n)
+
+
+def tenancy_parked(run: str, depth: int) -> None:
+    """One namespace's parked-event depth (its policy's ScheduledQueue
+    residency) — refreshed on ingest and on the host's reaper tick."""
+    if not metrics.enabled():
+        return
+    metrics.get().gauge(
+        TENANCY_PARKED, "parked events per tenant run namespace",
+        ("run",),
+    ).labels(run=run).set(depth)
+
+
+def tenancy_runs(n: int) -> None:
+    """How many run namespaces this orchestrator currently leases."""
+    if not metrics.enabled():
+        return
+    metrics.get().gauge(
+        TENANCY_RUNS, "active leased run namespaces").set(n)
+
+
+def tenancy_reclaim(run: str) -> None:
+    """A lease expired and its namespace was reclaimed (the crashed-
+    tenant transition; parked events stay journaled for the re-lease)."""
+    if not metrics.enabled():
+        return
+    metrics.get().counter(
+        TENANCY_RECLAIMS,
+        "tenant namespaces reclaimed after lease expiry", ("run",),
+    ).labels(run=run).inc()
+
+
+def rest_conn_pool(active: int, queued: int) -> None:
+    """The REST endpoint's bounded ingress pool: handler threads alive
+    vs connections queued waiting for one (doc/tenancy.md — 8 campaigns'
+    clients must not mean unbounded thread growth)."""
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.gauge(REST_CONN_THREADS,
+              "REST connection handler threads alive").set(active)
+    reg.gauge(REST_CONNS_QUEUED,
+              "REST connections queued for a handler thread").set(queued)
 
 
 def chaos_fault_injected(point: str) -> None:

@@ -28,7 +28,7 @@ import time
 import uuid as _uuid
 from typing import Optional
 
-from namazu_tpu import chaos, obs
+from namazu_tpu import chaos, obs, tenancy
 from namazu_tpu.endpoint.hub import EndpointHub
 from namazu_tpu.endpoint.local import LocalEndpoint
 from namazu_tpu.policy.base import POLICY_DONE, ExplorePolicy, create_policy
@@ -42,6 +42,19 @@ log = get_logger("orchestrator")
 
 _STOP = object()
 _FWD_DONE = object()
+
+
+class FlushMarker:
+    """Rides the merged action queue behind a namespace's final
+    actions (tenancy plane): the action loop fires it at the END of the
+    batch that carried it — i.e. after those actions were dispatched
+    AND their releases journaled — so a lease release can wait for its
+    namespace's drain deterministically."""
+
+    __slots__ = ("done",)
+
+    def __init__(self) -> None:
+        self.done = threading.Event()
 
 
 class Orchestrator:
@@ -128,7 +141,12 @@ class Orchestrator:
                 poll_timeout=float(
                     config.get("rest_poll_timeout", 30.0) or 30.0),
                 # bounded ingress (doc/robustness.md): 0 = unbounded
-                ingress_cap=int(config.get("rest_ingress_cap", 0) or 0)))
+                ingress_cap=int(config.get("rest_ingress_cap", 0) or 0),
+                # bounded connection-handler pool (doc/tenancy.md):
+                # beyond this many concurrent connections, new ones
+                # queue for a handler instead of growing a thread each
+                max_threads=int(
+                    config.get("rest_max_threads", 64) or 64)))
         uds_path = str(config.get("uds_path", "") or "")
         if uds_path:
             from namazu_tpu.endpoint.uds import UdsEndpoint
@@ -344,56 +362,81 @@ class Orchestrator:
                     if stop:
                         return
                     continue
-            if self.journal is not None:
-                # write-ahead: the batch is durable BEFORE the policy
-                # sees it, so a crash from here on can lose nothing
-                try:
-                    self.journal.append_events(batch, self.hub.routes())
-                    obs.journal_events(len(batch))
-                except OSError:
-                    log.exception("event journal append failed; "
-                                  "continuing without durability")
-                # chaos seam: die like kill -9 WOULD — after the journal
-                # write, before dispatch (the recovery window the crash
-                # scenarios exercise)
-                if chaos.decide("orchestrator.crash") is not None:
-                    log.error("chaos: orchestrator.crash fired; "
-                              "SIGKILLing this process")
-                    os.kill(os.getpid(), _signal.SIGKILL)
-            target = self.policy if self.enabled else self.dumb
-            for ev in batch:
-                obs.mark(ev, "enqueued")
-                obs.record_enqueued(ev, target.name)
-            try:
-                if len(batch) == 1:
-                    target.queue_event(batch[0])
-                    rejected = ()
-                else:
-                    # queue_events isolates per-event failures itself
-                    # and reports them (policy/base.py contract);
-                    # reaching this except means a batch-level failure
-                    # (e.g. queue closed at shutdown)
-                    rejected = target.queue_events(batch) or ()
-            except Exception:
-                log.exception("policy %s rejected a batch of %d events "
-                              "(first: %r)", target.name, len(batch),
-                              batch[0])
-            else:
-                # queue_event(s) returning means the policy chose the
-                # batch's delays/priorities — the decision point.
-                # Rejected events get no marks, exactly like a scalar
-                # rejection: batched and per-event telemetry stay
-                # identical
-                rejected_ids = {id(ev) for ev in rejected}
-                for ev in batch:
-                    if id(ev) in rejected_ids:
-                        continue
-                    obs.mark(ev, "decided")
-                    obs.record_decided(ev, target.name)
-                    obs.policy_decision(target.name, ev.entity_id,
-                                        obs.latency(ev, "intercepted"))
+            self._dispatch_central_batch(batch)
             if stop:
                 return
+
+    def _dispatch_central_batch(self, batch: list) -> None:
+        """Journal + feed one drained central batch to its policy. The
+        single-run body; TenantOrchestrator overrides to partition the
+        batch by run namespace first (doc/tenancy.md)."""
+        self._journal_and_queue(batch, self.journal,
+                                self.policy if self.enabled else self.dumb)
+
+    def _routes_for_ns(self, ns: str) -> dict:
+        """One namespace's entity -> endpoint routes (bare entity
+        keys): what its journal persists — a journal is a single-tenant
+        artifact, so recovery resolves entities without knowing about
+        route-key prefixes (and never sees other tenants' routes)."""
+        out = {}
+        for key, endpoint_name in self.hub.routes().items():
+            key_ns, entity = tenancy.split_route_key(key)
+            if key_ns == ns:
+                out[entity] = endpoint_name
+        return out
+
+    def _journal_and_queue(self, batch: list, journal,
+                           target: ExplorePolicy,
+                           routes: Optional[dict] = None) -> None:
+        if journal is not None:
+            # write-ahead: the batch is durable BEFORE the policy
+            # sees it, so a crash from here on can lose nothing
+            try:
+                journal.append_events(
+                    batch, routes if routes is not None
+                    else self._routes_for_ns(""))
+                obs.journal_events(len(batch))
+            except OSError:
+                log.exception("event journal append failed; "
+                              "continuing without durability")
+            # chaos seam: die like kill -9 WOULD — after the journal
+            # write, before dispatch (the recovery window the crash
+            # scenarios exercise)
+            if chaos.decide("orchestrator.crash") is not None:
+                log.error("chaos: orchestrator.crash fired; "
+                          "SIGKILLing this process")
+                os.kill(os.getpid(), _signal.SIGKILL)
+        for ev in batch:
+            obs.mark(ev, "enqueued")
+            obs.record_enqueued(ev, target.name)
+        try:
+            if len(batch) == 1:
+                target.queue_event(batch[0])
+                rejected = ()
+            else:
+                # queue_events isolates per-event failures itself
+                # and reports them (policy/base.py contract);
+                # reaching this except means a batch-level failure
+                # (e.g. queue closed at shutdown)
+                rejected = target.queue_events(batch) or ()
+        except Exception:
+            log.exception("policy %s rejected a batch of %d events "
+                          "(first: %r)", target.name, len(batch),
+                          batch[0])
+        else:
+            # queue_event(s) returning means the policy chose the
+            # batch's delays/priorities — the decision point.
+            # Rejected events get no marks, exactly like a scalar
+            # rejection: batched and per-event telemetry stay
+            # identical
+            rejected_ids = {id(ev) for ev in rejected}
+            for ev in batch:
+                if id(ev) in rejected_ids:
+                    continue
+                obs.mark(ev, "decided")
+                obs.record_decided(ev, target.name)
+                obs.policy_decision(target.name, ev.entity_id,
+                                    obs.latency(ev, "intercepted"))
 
     def _ingest_edge_batch(self, events: list) -> None:
         """Reconcile backhauled edge decisions: one complete flight-
@@ -425,8 +468,7 @@ class Orchestrator:
                 t0 = d.get("t_intercepted")
                 if isinstance(t0, (int, float)):
                     parkings.append(stamp - t0)
-            if self.collect_trace:
-                self.trace.append(action)
+            self._trace_append(action)
         # causality-plane stage attribution (obs/causality.py): the
         # edge path's two segments, observed batch-wise (one family
         # resolution per burst — this loop runs at zero-RTT rates)
@@ -468,13 +510,21 @@ class Orchestrator:
             # barriers so in-process execution keeps its place in the
             # release order
             forward: list = []
-            released_uuids: list = []
+            released: list = []  # (uuid, namespace) pairs
+            markers: list = []
             for item in batch:
                 if item is _FWD_DONE:
                     done += 1
                     continue
+                if isinstance(item, FlushMarker):
+                    # fired at the END of this batch (after dispatch +
+                    # release journaling), where its namespace's
+                    # preceding actions are fully accounted
+                    markers.append(item)
+                    continue
                 action: Action = item  # type: ignore[assignment]
-                released_uuids.append(action.event_uuid or action.uuid)
+                released.append((action.event_uuid or action.uuid,
+                                 getattr(action, "_ns", "")))
                 action.mark_triggered()
                 obs.mark(action, "dispatched")
                 kind = ("orchestrator" if action.orchestrator_side_only
@@ -482,8 +532,7 @@ class Orchestrator:
                 obs.record_dispatched(action, kind)
                 obs.action_dispatched(kind,
                                       obs.latency(action, "intercepted"))
-                if self.collect_trace:
-                    self.trace.append(action)
+                self._trace_append(action)
                 if action.orchestrator_side_only:
                     if forward:
                         self.hub.send_actions(forward)
@@ -497,17 +546,35 @@ class Orchestrator:
                     forward.append(action)
             if forward:
                 self.hub.send_actions(forward)
-            if self.journal is not None and released_uuids:
+            if released:
                 # release records land AFTER dispatch: the crash window
                 # between the two is at-least-once, which the endpoint
                 # dedupe + waiter-keyed dispatch absorb; the reverse
                 # order would lose events (chaos/journal.py)
-                try:
-                    self.journal.append_releases(released_uuids)
-                except OSError:
-                    log.exception("event journal release append failed")
+                self._journal_releases(released)
+            for marker in markers:
+                marker.done.set()
             if done >= self._n_policies:
                 return
+
+    def _trace_append(self, action: Action) -> None:
+        """Collected-trace hook; TenantOrchestrator routes namespaced
+        actions to their namespace's own trace."""
+        if self.collect_trace:
+            self.trace.append(action)
+
+    def _journal_releases(self, released: list) -> None:
+        """Append ``(uuid, namespace)`` release records; the base class
+        owns only the default namespace's journal."""
+        if self.journal is None:
+            return
+        uuids = [u for u, ns in released if not ns]
+        if not uuids:
+            return
+        try:
+            self.journal.append_releases(uuids)
+        except OSError:
+            log.exception("event journal release append failed")
 
     def _watchdog_loop(self) -> None:
         """Liveness sweep: declare entities silent past the timeout dead
@@ -524,17 +591,18 @@ class Orchestrator:
         returns how many parked events were force-released."""
         stalled = self.hub.stalled_entities(self.liveness_timeout_s)
         released = 0
-        for entity, silent_for in stalled.items():
+        for key, silent_for in stalled.items():
+            ns, entity = tenancy.split_route_key(key)
             n = 0
-            for pol in (self.policy, self.dumb):
+            for pol in self._policies_for(ns):
                 try:
                     n += pol.force_release_entity(entity)
                 except Exception:
                     log.exception("force-release for entity %s failed "
                                   "in policy %s", entity, pol.name)
             released += n
-            if entity not in self._stalled:
-                self._stalled.add(entity)
+            if key not in self._stalled:
+                self._stalled.add(key)
                 obs.entity_stalled(entity)
                 log.warning(
                     "entity %s declared dead (silent %.1fs > %.1fs); "
@@ -543,6 +611,11 @@ class Orchestrator:
         # entities that spoke again re-arm their stall transition
         self._stalled &= set(stalled)
         return released
+
+    def _policies_for(self, ns: str):
+        """The policies that may hold parked events of one namespace;
+        TenantOrchestrator overrides for non-default namespaces."""
+        return (self.policy, self.dumb)
 
     def _control_loop(self) -> None:
         while True:

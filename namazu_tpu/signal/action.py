@@ -76,6 +76,12 @@ class Action(Signal):
         # lifecycle spans survive the event -> action hand-off so the
         # dispatch/ack stages can report end-to-end latencies
         obs_spans.carry(action, event)
+        # so does the tenancy namespace (doc/tenancy.md): the action
+        # must route/record/poll under its cause event's run, and this
+        # is the one choke point every policy's action minting crosses
+        ns = getattr(event, "_ns", "")
+        if ns:
+            action._ns = ns
         return action
 
     def mark_triggered(self, now: Optional[float] = None) -> None:

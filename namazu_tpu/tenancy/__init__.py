@@ -1,0 +1,102 @@
+"""Tenancy plane: one orchestrator serving N concurrent experiments.
+
+The reference Namazu (and our reproduction through PR 12) runs one
+orchestrator per experiment: ``nmz-tpu campaign`` forks a full ``run``
+process per slot, so aggregate throughput across experiments is a
+process-count problem. This package is the consolidation move serving
+stacks make when they go from one-model-per-process to a multi-tenant
+scheduler (doc/tenancy.md):
+
+* **Namespaced runs** — a :class:`~namazu_tpu.tenancy.registry.RunRegistry`
+  hosts N concurrent run namespaces inside one orchestrator process.
+  Each namespace owns its own policy instance (its own ScheduledQueue),
+  flight-recorder run, crash-recovery journal, and collected trace.
+  Every wire op carries a ``run`` namespace — the ``X-Nmz-Run`` header
+  on the REST wire, a ``run`` field on framed/shm ops. An absent
+  namespace is the **process-default namespace**: every pre-tenancy
+  client lands there with byte-identical replies.
+* **Entity-sharded hub** — the EndpointHub's single routing lock is
+  split into per-shard locks keyed by ``fnv64a(namespace:entity) % N``
+  (:mod:`namazu_tpu.tenancy.shard`), so namespaces never contend on
+  one lock.
+* **Slot leasing** — tenants acquire namespaces through
+  ``lease``/``renew``/``release`` ops with TTL expiry
+  (:mod:`namazu_tpu.tenancy.registry`): a crashed tenant's lease
+  expires, its namespace is reclaimed with parked events left in its
+  journal, and a re-lease over the same journal dir recovers them
+  exactly-once — sibling namespaces dispatch undisturbed throughout.
+
+The host side lives in :class:`~namazu_tpu.tenancy.host.TenantOrchestrator`;
+the client side (the campaign supervisor's ``--serve`` mode, bench
+``--runs``) in :class:`~namazu_tpu.tenancy.client.TenancyClient`.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+#: the process-default namespace: pre-tenancy clients (no run header/
+#: field) land here and observe the exact pre-tenancy behavior
+DEFAULT_NS = ""
+
+#: the REST wire's namespace piggyback (established X-Nmz-* style)
+RUN_HEADER = "X-Nmz-Run"
+
+#: the framed/shm wire's namespace field
+RUN_FIELD = "run"
+
+#: separator inside composite routing keys. Unit separator: never part
+#: of an entity id or a run namespace (validate_ns refuses it), so
+#: ``split_route_key`` is unambiguous.
+ROUTE_SEP = "\x1f"
+
+
+def ns_of(sig) -> str:
+    """The namespace a signal is tagged with ('' = default). Tags are
+    plain attributes set at the ingress edge (endpoint handlers) and
+    propagated event -> action at ``Action.for_event``."""
+    return getattr(sig, "_ns", DEFAULT_NS)
+
+
+def set_ns(sig, ns: str) -> None:
+    """Tag a signal with its namespace (no-op for the default one, so
+    default-namespace signals stay attribute-identical to pre-tenancy
+    ones)."""
+    if ns:
+        sig._ns = ns
+
+
+def route_key(ns: str, entity: str) -> str:
+    """The hub/queue key for (namespace, entity). The default
+    namespace's key IS the bare entity id — pre-tenancy state (journaled
+    route tables, tests pinning key shapes) reads unchanged."""
+    return entity if not ns else ns + ROUTE_SEP + entity
+
+
+def split_route_key(key: str) -> Tuple[str, str]:
+    """Inverse of :func:`route_key`: ``(namespace, entity)``."""
+    if ROUTE_SEP in key:
+        ns, _, entity = key.partition(ROUTE_SEP)
+        return ns, entity
+    return DEFAULT_NS, key
+
+
+def signal_route_key(sig) -> str:
+    """The routing key of one tagged signal."""
+    return route_key(ns_of(sig), sig.entity_id)
+
+
+def validate_ns(ns: str) -> str:
+    """Check a wire-supplied namespace; returns it. Raises ValueError
+    on names that would alias the default namespace or break the
+    composite-key encoding."""
+    if not isinstance(ns, str) or not ns:
+        raise ValueError("run namespace must be a non-empty string")
+    if ROUTE_SEP in ns:
+        raise ValueError("run namespace must not contain \\x1f")
+    if len(ns) > 128:
+        raise ValueError("run namespace too long (>128 chars)")
+    return ns
+
+
+from namazu_tpu.tenancy.shard import fnv64a, shard_index  # noqa: E402,F401

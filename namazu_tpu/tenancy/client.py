@@ -1,0 +1,173 @@
+"""TenancyClient: the tenant side of the lease wire.
+
+One small client for both transports a serving orchestrator exposes:
+
+* ``http(s)://host:port`` — ``POST /api/v3/tenancy`` with a JSON op
+  body (the REST face; endpoint/rest.py);
+* ``uds:///path/to.sock`` (or a bare socket path) — the same op dicts
+  as framed JSON over the uds endpoint (endpoint/uds.py).
+
+Used by the campaign supervisor's ``--serve`` mode and by
+``bench.py --runs``; errors surface as :class:`TenancyWireError` so a
+supervisor can classify them as infra failures.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Any, Dict, Optional
+from urllib.parse import urlparse
+
+from namazu_tpu.endpoint.agent import read_frame, write_frame
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("tenancy.client")
+
+
+class TenancyWireError(Exception):
+    pass
+
+
+class TenancyClient:
+    def __init__(self, url: str, timeout: float = 10.0) -> None:
+        self.url = url
+        self.timeout = timeout
+        parsed = urlparse(url)
+        if parsed.scheme in ("http", "https"):
+            self._uds_path = None
+            self._base = url.rstrip("/")
+        elif parsed.scheme == "uds":
+            # uds://tmp/x.sock parses as netloc="tmp" path="/x.sock";
+            # rejoin them so relative forms resolve to the SAME path
+            # the transceivers use (url[len("uds://"):])
+            self._uds_path = parsed.netloc + parsed.path
+        elif not parsed.scheme:
+            self._uds_path = url
+        else:
+            raise TenancyWireError(
+                f"unsupported tenancy url {url!r} (want http(s):// or "
+                "uds://)")
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    # -- transport --------------------------------------------------------
+
+    def _op(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        if self._uds_path is not None:
+            return self._op_uds(doc)
+        return self._op_http(doc)
+
+    def _op_http(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            self._base + "/api/v3/tenancy",
+            data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                body = json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read() or b"{}").get("error", "")
+            except ValueError:
+                detail = ""
+            raise TenancyWireError(
+                f"tenancy op {doc.get('op')!r} failed: HTTP {e.code} "
+                f"{detail}".strip()) from None
+        except (OSError, ValueError) as e:
+            raise TenancyWireError(
+                f"tenancy op {doc.get('op')!r} failed: {e}") from e
+        return body
+
+    def _op_uds(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            for attempt in (0, 1):
+                sock = self._sock
+                if sock is None:
+                    sock = socket.socket(socket.AF_UNIX,
+                                         socket.SOCK_STREAM)
+                    sock.settimeout(self.timeout)
+                    try:
+                        sock.connect(self._uds_path)
+                    except OSError as e:
+                        raise TenancyWireError(
+                            f"tenancy socket {self._uds_path}: {e}") \
+                            from e
+                    self._sock = sock
+                try:
+                    write_frame(sock, doc)
+                    resp = read_frame(sock)
+                except (OSError, ValueError) as e:
+                    self._drop_sock()
+                    if attempt == 0:
+                        continue  # one transparent reconnect
+                    raise TenancyWireError(
+                        f"tenancy op {doc.get('op')!r} failed: {e}") \
+                        from e
+                if resp is None:
+                    self._drop_sock()
+                    if attempt == 0:
+                        continue
+                    raise TenancyWireError(
+                        f"tenancy op {doc.get('op')!r}: connection "
+                        "closed")
+                if not isinstance(resp, dict):
+                    raise TenancyWireError(
+                        f"tenancy op {doc.get('op')!r}: non-object "
+                        "reply")
+                if not resp.get("ok", True):
+                    raise TenancyWireError(
+                        f"tenancy op {doc.get('op')!r} failed: "
+                        f"{resp.get('error')}")
+                return resp
+        raise TenancyWireError("unreachable")  # pragma: no cover
+
+    def _drop_sock(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop_sock()
+
+    # -- ops --------------------------------------------------------------
+
+    def lease(self, run: str, ttl_s: Optional[float] = None,
+              policy: str = "random",
+              policy_param: Optional[dict] = None,
+              journal_dir: str = "",
+              collect_trace: bool = True) -> Dict[str, Any]:
+        doc: Dict[str, Any] = {"op": "lease", "run": run,
+                               "policy": policy,
+                               "collect_trace": collect_trace}
+        if ttl_s is not None:
+            doc["ttl_s"] = ttl_s
+        if policy_param:
+            doc["policy_param"] = policy_param
+        if journal_dir:
+            doc["journal_dir"] = journal_dir
+        return self._op(doc)
+
+    def renew(self, lease_id: str,
+              ttl_s: Optional[float] = None) -> Dict[str, Any]:
+        doc: Dict[str, Any] = {"op": "renew", "lease_id": lease_id}
+        if ttl_s is not None:
+            doc["ttl_s"] = ttl_s
+        return self._op(doc)
+
+    def release(self, lease_id: str,
+                want_trace: bool = True) -> Dict[str, Any]:
+        return self._op({"op": "release", "lease_id": lease_id,
+                         "trace": want_trace})
+
+    def runs(self) -> Dict[str, Any]:
+        return self._op({"op": "runs"})

@@ -1,0 +1,341 @@
+"""TenantOrchestrator: ONE orchestrator process serving N experiments.
+
+Extends the single-run :class:`~namazu_tpu.orchestrator.core.Orchestrator`
+with the tenancy plane (doc/tenancy.md): a :class:`RunRegistry` of
+leased run namespaces, per-namespace policy/journal/trace/flight-
+recorder isolation, and a reaper that reclaims crashed tenants'
+namespaces on lease expiry.
+
+The default namespace stays EXACTLY the base orchestrator: untagged
+events ride the inherited code paths (same policy, same journal, same
+collected trace), so a TenantOrchestrator hosting zero leases is
+behaviorally identical to an Orchestrator — the loss-free-compatibility
+half of the tenancy contract. Namespaced events partition out of the
+same drained batch and feed their namespace's own policy; their actions
+carry the namespace back through dispatch, trace collection, release
+journaling, and the endpoint action queues.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from namazu_tpu import obs, tenancy
+from namazu_tpu.endpoint.hub import EndpointHub
+from namazu_tpu.obs import recorder as _recorder
+from namazu_tpu.orchestrator.core import (_FWD_DONE, FlushMarker,
+                                           Orchestrator)
+from namazu_tpu.policy.base import POLICY_DONE, ExplorePolicy
+from namazu_tpu.tenancy.registry import RunNamespace, RunRegistry
+from namazu_tpu.utils.config import Config
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("tenancy.host")
+
+
+class TenantOrchestrator(Orchestrator):
+    def __init__(self, config: Config, policy: ExplorePolicy,
+                 collect_trace: bool = False,
+                 hub: Optional[EndpointHub] = None):
+        super().__init__(config, policy, collect_trace=collect_trace,
+                         hub=hub)
+        self.registry = RunRegistry(self)
+        # the wire endpoints answer lease/renew/release ops through
+        # this attachment (endpoint/rest.py, endpoint/uds.py)
+        self.hub.run_registry = self.registry
+        #: live namespaces by name — the loops' resolution table
+        #: (distinct from the registry's lease table: a namespace stays
+        #: here through its release flush, after its lease is gone)
+        self._namespaces: Dict[str, RunNamespace] = {}
+        self._ns_lock = threading.Lock()
+        self._reap_interval_s = float(
+            config.get("tenancy_reap_interval_s", 0.25) or 0.25)
+        self._reaper_stop = threading.Event()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._started:
+            return
+        super().start()
+        t = threading.Thread(target=self._reaper_loop,
+                             name="orc-tenancy-reaper", daemon=True)
+        t.start()
+        self._threads["tenancy-reaper"] = t
+
+    def shutdown(self):
+        # flush every still-leased namespace FIRST, while the action
+        # loop is alive to drain it (their tenants get no release doc —
+        # a shutdown host is equivalent to every lease ending at once)
+        if self._started and not self._shut_down:
+            for row in self.registry.payload():
+                try:
+                    self.registry.release(row["lease_id"],
+                                          want_trace=False)
+                except Exception:
+                    log.exception("releasing run %s at shutdown failed",
+                                  row["run"])
+        self._reaper_stop.set()
+        trace = super().shutdown()
+        t = self._threads.get("tenancy-reaper")
+        if t is not None:
+            t.join(timeout=5)
+        return trace
+
+    def abandon(self) -> None:
+        self._reaper_stop.set()
+        # a simulated SIGKILL takes every namespace's parked queue with
+        # it, exactly like the default policy's (journals survive for
+        # the re-lease recovery)
+        with self._ns_lock:
+            namespaces = list(self._namespaces.values())
+        for ns in namespaces:
+            ns.detached = True
+            self._close_ns_policy(ns)
+            if ns.journal is not None:
+                ns.journal.close()
+        super().abandon()
+
+    def _reaper_loop(self) -> None:
+        while not self._reaper_stop.wait(self._reap_interval_s):
+            try:
+                self.registry.sweep()
+            except Exception:  # pragma: no cover - defensive
+                log.exception("tenancy lease sweep failed")
+            with self._ns_lock:
+                namespaces = list(self._namespaces.values())
+            for ns in namespaces:
+                if not ns.detached:
+                    obs.tenancy_parked(ns.name, ns.parked_depth())
+
+    # -- namespace attach/detach (the registry calls these) --------------
+
+    def attach_namespace(self, ns: RunNamespace) -> int:
+        """Start a namespace's policy + forward loop and recover its
+        journal; returns how many journaled events were recovered."""
+        with self._ns_lock:
+            self._namespaces[ns.name] = ns
+            # the action loop exits after one _FWD_DONE per policy ever
+            # forwarded; grows monotonically so early releases (their
+            # _FWD_DONE arriving mid-run) can never trip the exit
+            self._n_policies += 1
+        ns.policy.start()
+        t = threading.Thread(target=self._ns_forward_loop, args=(ns,),
+                             name=f"orc-fwd-ns-{ns.name}", daemon=True)
+        t.start()
+        self._threads[f"fwd-ns-{ns.name}"] = t
+        return self._recover_ns_journal(ns)
+
+    def _recover_ns_journal(self, ns: RunNamespace) -> int:
+        """Re-lease recovery (doc/tenancy.md): parked events a reclaimed
+        predecessor journaled but never released replay into THIS
+        namespace — dedupe rings seeded first so an inspector-side
+        replay acks idempotent, exactly like single-run crash
+        recovery."""
+        if ns.journal is None:
+            return 0
+        recovered = ns.journal.unreleased()
+        if not recovered:
+            return 0
+        for name in ("rest", "uds"):
+            ep = self.hub.endpoint(name)
+            if ep is not None and hasattr(ep, "note_event_uuid"):
+                for event, _ in recovered:
+                    ep.note_event_uuid(event.uuid)
+        for event, endpoint_name in recovered:
+            tenancy.set_ns(event, ns.name)
+            self.hub.post_event(event, endpoint_name or "local")
+        obs.journal_recovered(len(recovered))
+        log.warning("run %s: recovered %d parked event(s) from its "
+                    "journal; resuming the tenant's run", ns.name,
+                    len(recovered))
+        return len(recovered)
+
+    def _ns_forward_loop(self, ns: RunNamespace) -> None:
+        marker = FlushMarker()
+        ns._flush_marker = marker
+        put = self._merged_actions.put
+        while True:
+            item = ns.policy.action_out.get()
+            if item is POLICY_DONE:
+                # marker BEFORE the done sentinel: it fires once every
+                # action above has been dispatched + release-journaled
+                put(marker)
+                put(_FWD_DONE)
+                return
+            # defensive namespace tag: policies mint actions through
+            # Action.for_event (which inherits the event's tag), but a
+            # plugin emitting raw actions must still route/trace under
+            # its tenant
+            if isinstance(item, list):
+                for action in item:
+                    tenancy.set_ns(action, ns.name)
+            else:
+                tenancy.set_ns(item, ns.name)
+            put(item)
+
+    def _close_ns_policy(self, ns: RunNamespace) -> None:
+        """Close a namespace's delay queue WITHOUT releasing (the
+        reclaim path): parked items die here — only the journal
+        resurrects them — then the policy flushes empty so its
+        POLICY_DONE keeps the action loop's accounting exact."""
+        q = getattr(ns.policy, "_queue", None)
+        if q is not None:
+            try:
+                q.close()
+                q.drain_remaining()
+            except Exception:  # pragma: no cover - best effort
+                log.exception("closing run %s's delay queue failed",
+                              ns.name)
+        try:
+            ns.policy.shutdown()
+        except Exception:  # pragma: no cover - best effort
+            log.exception("shutting down run %s's policy failed",
+                          ns.name)
+
+    def release_namespace(self, ns: RunNamespace) -> None:
+        """Graceful detach: flush parked events through dispatch, wait
+        for the drain, then drop the namespace's journal/routes/pin."""
+        ns.detached = True
+        ns.policy.shutdown()  # releases parked events, emits POLICY_DONE
+        drained = True
+        marker = getattr(ns, "_flush_marker", None)
+        if marker is not None and self._started and not self._shut_down:
+            drained = marker.done.wait(timeout=10)
+            if not drained:
+                log.warning("run %s: flush did not drain within 10s; "
+                            "keeping its journal for recovery", ns.name)
+        ns.flushed.set()
+        if ns.journal is not None:
+            if drained:
+                # the run completed and every release was journaled:
+                # same remove-on-clean-shutdown contract as the base
+                # journal
+                ns.journal.remove()
+            else:
+                # the action loop still owes this namespace dispatches:
+                # removing the journal here would delete the only
+                # durable copy of journaled-but-undispatched events —
+                # keep it closed on disk, exactly like a reclaim
+                ns.journal.close()
+        self._detach_common(ns)
+
+    def reclaim_namespace(self, ns: RunNamespace) -> None:
+        """Crash reclamation (lease expiry): parked events are NOT
+        dispatched — they stay in the journal for the re-lease —
+        and sibling namespaces are untouched."""
+        ns.detached = True
+        self._close_ns_policy(ns)
+        if ns.journal is not None:
+            ns.journal.close()
+        self._detach_common(ns)
+
+    def _detach_common(self, ns: RunNamespace) -> None:
+        # identity-guarded teardown: a reclaim/release racing a
+        # concurrent RE-LEASE of the same run name (the advertised
+        # crash-recovery flow) must tear down only ITS OWN namespace's
+        # name-keyed state — popping/forgetting by name alone would
+        # silently detach the successor and strand the new tenant
+        with self._ns_lock:
+            mine = self._namespaces.get(ns.name) is ns
+            if mine:
+                self._namespaces.pop(ns.name, None)
+        if not mine:
+            log.warning("run %s: a newer lease took the name during "
+                        "detach; leaving its state untouched", ns.name)
+            return
+        _recorder.recorder().end_pinned(ns.name)
+        self.hub.forget_namespace(ns.name)
+        # drop the tenant's per-entity action queues on every endpoint
+        # too: a re-lease of the same run name must not poll the dead
+        # incarnation's undelivered actions, and queues must not leak
+        # one-per-entity-per-lease on a long-lived host
+        for name in ("rest", "uds"):
+            ep = self.hub.endpoint(name)
+            if ep is not None and hasattr(ep, "forget_namespace"):
+                ep.forget_namespace(ns.name)
+        obs.tenancy_parked(ns.name, 0)
+
+    # -- loop hooks (the base loops call these) ---------------------------
+
+    def _dispatch_central_batch(self, batch: list) -> None:
+        """Partition one drained batch by run namespace: the default
+        sub-batch rides the inherited single-run path unchanged; each
+        namespace's sub-batch journals + queues against its OWN
+        journal/policy."""
+        default_batch = []
+        by_ns: Dict[str, list] = {}
+        for ev in batch:
+            name = tenancy.ns_of(ev)
+            if not name:
+                default_batch.append(ev)
+            else:
+                by_ns.setdefault(name, []).append(ev)
+        if default_batch:
+            super()._dispatch_central_batch(default_batch)
+        routes_by_ns = None
+        for name, sub in by_ns.items():
+            with self._ns_lock:
+                ns = self._namespaces.get(name)
+            if ns is None or ns.detached:
+                # late events of a released/reclaimed tenant: dropped,
+                # counted — never leaked into the default namespace
+                obs.action_unroutable(sub[0].entity_id)
+                log.warning("dropping %d event(s) for unknown/detached "
+                            "run %s", len(sub), name)
+                continue
+            ns.events_ingested += len(sub)
+            obs.tenancy_events(name, len(sub))
+            target = ns.policy if self.enabled else self.dumb
+            if ns.journal is not None and routes_by_ns is None:
+                # ONE route-table scan per drained batch, shared by
+                # every journaled namespace's sub-batch (not one full
+                # scan per namespace)
+                routes_by_ns = self._partition_routes()
+            self._journal_and_queue(
+                sub, ns.journal, target,
+                routes=(routes_by_ns or {}).get(name, {}))
+            obs.tenancy_parked(name, ns.parked_depth())
+
+    def _partition_routes(self):
+        out = {}
+        for key, endpoint_name in self.hub.routes().items():
+            key_ns, entity = tenancy.split_route_key(key)
+            out.setdefault(key_ns, {})[entity] = endpoint_name
+        return out
+
+    def _trace_append(self, action) -> None:
+        name = tenancy.ns_of(action)
+        if not name:
+            return super()._trace_append(action)
+        with self._ns_lock:
+            ns = self._namespaces.get(name)
+        if ns is not None and ns.collect_trace:
+            ns.trace.append(action)
+
+    def _journal_releases(self, released: list) -> None:
+        super()._journal_releases(released)  # default namespace
+        by_ns: Dict[str, list] = {}
+        for uuid, name in released:
+            if name:
+                by_ns.setdefault(name, []).append(uuid)
+        for name, uuids in by_ns.items():
+            with self._ns_lock:
+                ns = self._namespaces.get(name)
+            if ns is None or ns.journal is None:
+                continue
+            try:
+                ns.journal.append_releases(uuids)
+            except Exception:
+                log.exception("run %s: release journal append failed",
+                              name)
+
+    def _policies_for(self, ns: str):
+        if not ns:
+            return (self.policy, self.dumb)
+        with self._ns_lock:
+            run = self._namespaces.get(ns)
+        return (run.policy, self.dumb) if run is not None \
+            else (self.dumb,)
+

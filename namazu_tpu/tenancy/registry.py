@@ -1,0 +1,286 @@
+"""Run namespaces + slot leases: the tenancy plane's bookkeeping.
+
+A :class:`RunNamespace` is everything one experiment owns inside a
+shared orchestrator: its own policy instance (and therefore its own
+ScheduledQueue of parked events), its own flight-recorder run, its own
+crash-recovery journal, and its own collected trace. The
+:class:`RunRegistry` hands namespaces out as TTL **leases**
+(``lease`` / ``renew`` / ``release`` — the wire ops the REST
+``/api/v3/tenancy`` route and the framed endpoints expose):
+
+* a **released** lease flushes its namespace — parked events dispatch,
+  the journal is removed, and the response carries the run's collected
+  trace (the tenant records it into its own storage);
+* an **expired** lease (the tenant crashed, stopped renewing) is
+  **reclaimed**: parked events are dropped *without dispatch* — they
+  stay in the namespace's journal, exactly as a SIGKILL would leave
+  them — and a later lease naming the same journal dir recovers them
+  exactly-once, while sibling namespaces dispatch undisturbed
+  throughout. The ``tenancy.lease.expire`` chaos seam forces this path
+  deterministically (doc/robustness.md).
+
+Lease TTLs are renewed by live tenants (the campaign supervisor's
+``--serve`` loop renews at TTL/3); the registry's sweep runs on the
+host's reaper thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid as _uuid
+from typing import Any, Dict, List, Optional
+
+from namazu_tpu import chaos, tenancy
+from namazu_tpu.obs import recorder as _recorder
+from namazu_tpu.obs import spans as _spans
+from namazu_tpu.policy.base import ExplorePolicy, create_policy
+from namazu_tpu.utils.config import Config
+from namazu_tpu.utils.log import get_logger
+from namazu_tpu.utils.trace import SingleTrace
+
+log = get_logger("tenancy")
+
+#: default lease TTL (seconds) when the tenant names none
+DEFAULT_TTL_S = 30.0
+#: TTL bounds: a sub-100ms TTL is a typo'd footgun, an hours-long one
+#: defeats crash reclamation
+MIN_TTL_S = 0.2
+MAX_TTL_S = 3600.0
+
+
+class TenancyError(Exception):
+    pass
+
+
+class RunNamespace:
+    """One tenant's state inside a shared orchestrator."""
+
+    def __init__(self, name: str, policy: ExplorePolicy,
+                 run_id: str, journal=None,
+                 collect_trace: bool = True,
+                 storage_dir: str = "") -> None:
+        self.name = name
+        self.policy = policy
+        self.run_id = run_id
+        self.journal = journal
+        self.collect_trace = collect_trace
+        self.storage_dir = storage_dir
+        self.trace = SingleTrace()
+        self.created_mono = time.monotonic()
+        #: events ingested for this namespace (the /fleet RUN row)
+        self.events_ingested = 0
+        #: set once the namespace's policy flush has fully drained
+        #: through the action loop (release waits on it)
+        self.flushed = threading.Event()
+        #: set when the namespace is detached (release or reclaim);
+        #: the event loop drops late events for detached namespaces
+        self.detached = False
+
+    def parked_depth(self) -> int:
+        q = getattr(self.policy, "_queue", None)
+        try:
+            return len(q) if q is not None else 0
+        except Exception:  # pragma: no cover - defensive
+            return 0
+
+
+class Lease:
+    __slots__ = ("lease_id", "ns", "ttl_s", "expires_at", "renewals",
+                 "journal_dir")
+
+    def __init__(self, ns: RunNamespace, ttl_s: float,
+                 journal_dir: str = "") -> None:
+        self.lease_id = _uuid.uuid4().hex
+        self.ns = ns
+        self.ttl_s = ttl_s
+        self.expires_at = time.monotonic() + ttl_s
+        self.renewals = 0
+        self.journal_dir = journal_dir
+
+
+def _clamp_ttl(raw, default: float = DEFAULT_TTL_S) -> float:
+    try:
+        ttl = float(raw) if raw is not None else default
+    except (TypeError, ValueError):
+        raise TenancyError(f"bad ttl_s {raw!r}") from None
+    return min(max(ttl, MIN_TTL_S), MAX_TTL_S)
+
+
+def handle_tenancy_op(req: Dict[str, Any],
+                      registry: "RunRegistry") -> Optional[Dict[str, Any]]:
+    """Answer one wire-form tenancy op (``lease``/``renew``/``release``/
+    ``runs``); ``None`` = not a tenancy op (the caller keeps
+    dispatching). Shared by the REST ``POST /api/v3/tenancy`` route and
+    the framed uds wire, so both faces speak one grammar. Raises
+    :class:`TenancyError` (and the policy registry's errors) for the
+    caller to turn into a 400 / ``ok: false``."""
+    op = req.get("op")
+    if op == "lease":
+        doc = registry.lease(
+            run=req.get("run") or "",
+            ttl_s=req.get("ttl_s"),
+            policy=str(req.get("policy") or "random"),
+            policy_param=(req.get("policy_param")
+                          if isinstance(req.get("policy_param"), dict)
+                          else None),
+            journal_dir=str(req.get("journal_dir") or ""),
+            collect_trace=bool(req.get("collect_trace", True)),
+            storage_dir=str(req.get("storage_dir") or ""))
+        return dict(doc, ok=True)
+    if op == "renew":
+        return dict(registry.renew(str(req.get("lease_id") or ""),
+                                   ttl_s=req.get("ttl_s")), ok=True)
+    if op == "release":
+        return dict(registry.release(
+            str(req.get("lease_id") or ""),
+            want_trace=bool(req.get("trace", True))), ok=True)
+    if op == "runs":
+        return {"ok": True, "runs": registry.payload()}
+    return None
+
+
+class RunRegistry:
+    """The lease table of one :class:`TenantOrchestrator`."""
+
+    def __init__(self, host) -> None:
+        self._host = host
+        self._lock = threading.Lock()
+        self._leases: Dict[str, Lease] = {}
+        self._by_ns: Dict[str, Lease] = {}
+
+    # -- ops (the wire handlers call these) ------------------------------
+
+    def lease(self, run: str, ttl_s=None, policy: str = "random",
+              policy_param: Optional[dict] = None,
+              journal_dir: str = "", collect_trace: bool = True,
+              storage_dir: str = "") -> Dict[str, Any]:
+        """Create + attach one namespace; returns the lease doc. The
+        namespace name is the tenant's stable identity: re-leasing a
+        name whose previous lease expired (with the same journal dir)
+        recovers its journaled parked events exactly-once."""
+        run = tenancy.validate_ns(run)
+        ttl = _clamp_ttl(ttl_s)
+        pol = create_policy(policy or "random")
+        cfg = {"explore_policy": policy or "random"}
+        if policy_param:
+            cfg["explore_policy_param"] = dict(policy_param)
+        pol.load_config(Config(cfg))
+        journal = None
+        if journal_dir:
+            from namazu_tpu.chaos.journal import EventJournal
+
+            journal = EventJournal(journal_dir)
+        with self._lock:
+            if run in self._by_ns:
+                raise TenancyError(f"run {run!r} is already leased")
+            run_id = _recorder.recorder().begin_pinned(
+                run, run_id=f"{run}-{_uuid.uuid4().hex[:8]}")
+            ns = RunNamespace(run, pol, run_id, journal=journal,
+                              collect_trace=collect_trace,
+                              storage_dir=storage_dir)
+            lease = Lease(ns, ttl, journal_dir=journal_dir)
+            self._leases[lease.lease_id] = lease
+            self._by_ns[run] = lease
+        recovered = self._host.attach_namespace(ns)
+        _spans.tenancy_runs(self.active_count())
+        log.info("leased run %s (ttl %.1fs, policy %s%s)", run, ttl,
+                 pol.name,
+                 f", recovered {recovered}" if recovered else "")
+        return {"lease_id": lease.lease_id, "run": run,
+                "run_id": run_id, "ttl_s": ttl,
+                "recovered": recovered}
+
+    def renew(self, lease_id: str, ttl_s=None) -> Dict[str, Any]:
+        with self._lock:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                raise TenancyError(f"unknown lease {lease_id!r} "
+                                   "(expired and reclaimed?)")
+            lease.ttl_s = _clamp_ttl(ttl_s, default=lease.ttl_s)
+            lease.expires_at = time.monotonic() + lease.ttl_s
+            lease.renewals += 1
+            return {"lease_id": lease_id, "run": lease.ns.name,
+                    "ttl_s": lease.ttl_s,
+                    "renewals": lease.renewals}
+
+    def release(self, lease_id: str,
+                want_trace: bool = True) -> Dict[str, Any]:
+        """Graceful end-of-run: flush the namespace (parked events
+        dispatch), return the run summary + collected trace, remove the
+        journal (the run completed — nothing left to recover)."""
+        with self._lock:
+            lease = self._leases.pop(lease_id, None)
+            if lease is None:
+                raise TenancyError(f"unknown lease {lease_id!r} "
+                                   "(expired and reclaimed?)")
+            self._by_ns.pop(lease.ns.name, None)
+        ns = lease.ns
+        self._host.release_namespace(ns)
+        _spans.tenancy_runs(self.active_count())
+        doc = {"run": ns.name, "run_id": ns.run_id,
+               "events": ns.events_ingested,
+               "dispatched": len(ns.trace)}
+        if want_trace and ns.collect_trace:
+            doc["trace"] = ns.trace.to_jsonable()
+        log.info("released run %s (%d event(s), %d action(s) traced)",
+                 ns.name, ns.events_ingested, len(ns.trace))
+        return doc
+
+    def payload(self) -> List[Dict[str, Any]]:
+        """Active leases, for the ``runs`` status op and /fleet."""
+        now = time.monotonic()
+        with self._lock:
+            return [{
+                "run": lease.ns.name,
+                "run_id": lease.ns.run_id,
+                "lease_id": lease.lease_id,
+                "ttl_s": lease.ttl_s,
+                "expires_in_s": round(lease.expires_at - now, 3),
+                "renewals": lease.renewals,
+                "events": lease.ns.events_ingested,
+                "parked": lease.ns.parked_depth(),
+            } for lease in self._leases.values()]
+
+    # -- host-side --------------------------------------------------------
+
+    def namespace(self, run: str) -> Optional[RunNamespace]:
+        with self._lock:
+            lease = self._by_ns.get(run)
+            return None if lease is None else lease.ns
+
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._leases)
+
+    def sweep(self, now: Optional[float] = None) -> int:
+        """Expire overdue leases (reclaiming their namespaces); returns
+        how many were reclaimed. The ``tenancy.lease.expire`` chaos
+        seam force-expires one live lease per fire — the deterministic
+        stand-in for a tenant that stopped renewing."""
+        now = time.monotonic() if now is None else now
+        due: List[Lease] = []
+        with self._lock:
+            for lease in list(self._leases.values()):
+                expired = lease.expires_at <= now
+                if not expired \
+                        and chaos.decide("tenancy.lease.expire") is not None:
+                    expired = True
+                if expired:
+                    del self._leases[lease.lease_id]
+                    self._by_ns.pop(lease.ns.name, None)
+                    due.append(lease)
+        for lease in due:
+            ns = lease.ns
+            parked = ns.parked_depth()
+            self._host.reclaim_namespace(ns)
+            _spans.tenancy_reclaim(ns.name)
+            log.warning(
+                "lease on run %s expired (tenant dead?); namespace "
+                "reclaimed with %d parked event(s) left %s", ns.name,
+                parked,
+                f"journaled in {lease.journal_dir}" if lease.journal_dir
+                else "undispatched (no journal)")
+        if due:
+            _spans.tenancy_runs(self.active_count())
+        return len(due)

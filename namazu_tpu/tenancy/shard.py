@@ -1,0 +1,192 @@
+"""Entity sharding for the tenancy plane: FNV-1a keys + a sharded
+route table.
+
+The pre-tenancy EndpointHub serialized ALL routing/liveness bookkeeping
+through one lock — fine for one run, a convoy for eight campaigns whose
+inbound bursts all touch it. :class:`ShardedRoutes` splits that state
+into ``n_shards`` independently-locked shards keyed by
+``fnv64a(namespace + ':' + entity) % n_shards``, so two namespaces (or
+two disjoint entity sets) practically never contend on one lock, while
+per-key operations stay exactly as cheap as before.
+
+FNV-1a (64-bit) is the hash: stable across processes and Python builds
+(``hash()`` is salted per process — a journal written by one process
+must shard identically in its successor), one multiply + xor per byte,
+and well-mixed in the low bits the modulo keeps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from namazu_tpu import tenancy
+from namazu_tpu.policy.replayable import fnv64a as _fnv64a_bytes
+
+
+def fnv64a(text: str) -> int:
+    """64-bit FNV-1a of a string's UTF-8 bytes (the str face of the
+    replayable-policy helper — ONE implementation of a hash whose
+    cross-process stability is load-bearing)."""
+    return _fnv64a_bytes(text.encode("utf-8"))
+
+
+def shard_index(ns: str, entity: str, n_shards: int) -> int:
+    """The shard owning (namespace, entity)."""
+    return fnv64a(ns + ":" + entity) % n_shards
+
+
+class _Shard:
+    __slots__ = ("lock", "route", "last_seen", "warned")
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        #: route key -> endpoint name
+        self.route: Dict[str, str] = {}
+        #: route key -> monotonic last-inbound time
+        self.last_seen: Dict[str, float] = {}
+        #: route keys already warned unroutable
+        self.warned: set = set()
+
+
+class ShardedRoutes:
+    """The hub's routing/liveness table, sharded by (ns, entity).
+
+    Keys are composite route keys (:func:`namazu_tpu.tenancy.route_key`);
+    the default namespace's keys are bare entity ids, so everything a
+    pre-tenancy consumer reads (journaled route snapshots, watchdog
+    sweeps) keeps its shape.
+    """
+
+    DEFAULT_SHARDS = 16
+
+    def __init__(self, n_shards: int = DEFAULT_SHARDS) -> None:
+        self.n_shards = max(1, int(n_shards))
+        self._shards: List[_Shard] = [_Shard()
+                                      for _ in range(self.n_shards)]
+
+    def _shard(self, key: str) -> _Shard:
+        ns, entity = tenancy.split_route_key(key)
+        return self._shards[shard_index(ns, entity, self.n_shards)]
+
+    # -- inbound bookkeeping --------------------------------------------
+
+    def note_inbound(self, key: str, endpoint_name: str,
+                     now: Optional[float] = None) -> Optional[str]:
+        """Record one inbound event's route + liveness; returns the
+        PREVIOUS endpoint name when the entity moved (the caller logs
+        it — log I/O never runs under a shard lock)."""
+        now = time.monotonic() if now is None else now
+        shard = self._shard(key)
+        with shard.lock:
+            prev = shard.route.get(key)
+            shard.route[key] = endpoint_name
+            shard.last_seen[key] = now
+            shard.warned.discard(key)
+        return prev if (prev is not None and prev != endpoint_name) \
+            else None
+
+    def note_inbound_many(self, keys, endpoint_name: str
+                          ) -> List[Tuple[str, str]]:
+        """Batch face: keys grouped by shard, ONE lock acquisition per
+        touched shard. Returns the ``(key, previous_endpoint)`` moves."""
+        now = time.monotonic()
+        by_shard: Dict[int, List[str]] = {}
+        for key in keys:
+            ns, entity = tenancy.split_route_key(key)
+            by_shard.setdefault(
+                shard_index(ns, entity, self.n_shards), []).append(key)
+        moves: List[Tuple[str, str]] = []
+        for idx, shard_keys in by_shard.items():
+            shard = self._shards[idx]
+            with shard.lock:
+                for key in shard_keys:
+                    prev = shard.route.get(key)
+                    if prev is not None and prev != endpoint_name:
+                        moves.append((key, prev))
+                    shard.route[key] = endpoint_name
+                    shard.last_seen[key] = now
+                    shard.warned.discard(key)
+        return moves
+
+    # -- outbound resolution --------------------------------------------
+
+    def resolve(self, key: str) -> Tuple[Optional[str], bool]:
+        """``(endpoint_name_or_None, first_drop)`` for one action; the
+        first unroutable hit per key arms its one-shot warning."""
+        shard = self._shard(key)
+        with shard.lock:
+            name = shard.route.get(key)
+            first_drop = False
+            if name is None and key not in shard.warned:
+                shard.warned.add(key)
+                first_drop = True
+        return name, first_drop
+
+    def resolve_many(self, keys) -> List[Tuple[Optional[str], bool]]:
+        """Batch resolve, one lock acquisition per touched shard;
+        results align with ``keys``."""
+        idxs = []
+        by_shard: Dict[int, List[int]] = {}
+        for i, key in enumerate(keys):
+            ns, entity = tenancy.split_route_key(key)
+            idx = shard_index(ns, entity, self.n_shards)
+            idxs.append(idx)
+            by_shard.setdefault(idx, []).append(i)
+        out: List[Tuple[Optional[str], bool]] = [None] * len(keys)  # type: ignore[list-item]
+        for idx, positions in by_shard.items():
+            shard = self._shards[idx]
+            with shard.lock:
+                for i in positions:
+                    key = keys[i]
+                    name = shard.route.get(key)
+                    first_drop = False
+                    if name is None and key not in shard.warned:
+                        shard.warned.add(key)
+                        first_drop = True
+                    out[i] = (name, first_drop)
+        return out
+
+    # -- snapshots -------------------------------------------------------
+
+    def routes(self) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for shard in self._shards:
+            with shard.lock:
+                out.update(shard.route)
+        return out
+
+    def last_seen(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for shard in self._shards:
+            with shard.lock:
+                out.update(shard.last_seen)
+        return out
+
+    def stalled(self, timeout_s: float,
+                now: Optional[float] = None) -> Dict[str, float]:
+        now = time.monotonic() if now is None else now
+        out: Dict[str, float] = {}
+        for shard in self._shards:
+            with shard.lock:
+                for key, t in shard.last_seen.items():
+                    if now - t > timeout_s:
+                        out[key] = now - t
+        return out
+
+    def forget_namespace(self, ns: str) -> int:
+        """Drop every key of one namespace (a released/reclaimed run's
+        routes must not shadow a later lease of the same name across a
+        different endpoint); returns how many were dropped."""
+        prefix = ns + tenancy.ROUTE_SEP
+        dropped = 0
+        for shard in self._shards:
+            with shard.lock:
+                dead = [k for k in shard.route if k.startswith(prefix)]
+                for k in dead:
+                    shard.route.pop(k, None)
+                    shard.last_seen.pop(k, None)
+                    shard.warned.discard(k)
+                dropped += len(dead)
+        return dropped

@@ -330,9 +330,11 @@ def test_unroutable_warning_rearms_after_event(caplog):
     with caplog.at_level(logging.WARNING, logger="namazu_tpu.endpoint"):
         hub.send_action(ev.default_action())      # warn #1
         hub.post_event(ev, "local")               # entity speaks: re-arm
-        # remove the route again to force a drop
-        with hub._lock:
-            hub._entity_route.clear()
+        # remove the route again to force a drop (the routing table is
+        # sharded now — tenancy/shard.py; clear every shard's routes)
+        for shard in hub._routes._shards:
+            with shard.lock:
+                shard.route.clear()
         hub.send_action(ev.default_action())      # warn #2
     warnings = [r for r in caplog.records if r.levelno >= logging.WARNING]
     assert len(warnings) == 2

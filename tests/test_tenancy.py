@@ -1,0 +1,661 @@
+"""Tenancy plane (doc/tenancy.md): namespaced runs, sharded routing,
+slot leases, cross-namespace isolation, and pre-tenancy compatibility.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from namazu_tpu import tenancy
+from namazu_tpu.obs import metrics, recorder as recorder_mod
+from namazu_tpu.obs.recorder import FlightRecorder
+from namazu_tpu.policy import create_policy
+from namazu_tpu.signal import PacketEvent
+from namazu_tpu.tenancy.client import TenancyClient, TenancyWireError
+from namazu_tpu.tenancy.host import TenantOrchestrator
+from namazu_tpu.tenancy.registry import TenancyError
+from namazu_tpu.tenancy.shard import ShardedRoutes, fnv64a
+from namazu_tpu.utils.config import Config
+
+
+@pytest.fixture(autouse=True)
+def fresh_obs():
+    """Fresh metrics registry + flight recorder per test (pinned runs
+    are process-global state)."""
+    old_reg = metrics.set_registry(metrics.MetricsRegistry())
+    metrics.configure(True)
+    old_rec = recorder_mod.set_recorder(FlightRecorder(max_runs=32))
+    yield
+    metrics.set_registry(old_reg)
+    recorder_mod.set_recorder(old_rec)
+
+
+def _policy_param(seed=7, interval="0ms"):
+    return {"seed": seed, "min_interval": interval,
+            "max_interval": interval,
+            "fault_action_probability": 0.0,
+            "shell_action_interval": 0}
+
+
+def _host(tmp_path, **cfg_extra):
+    cfg = Config(dict({
+        "rest_port": 0,
+        "uds_path": str(tmp_path / "endpoint.sock"),
+        "run_id": "host-default",
+        "explore_policy": "random",
+        "explore_policy_param": _policy_param(),
+        "tenancy_reap_interval_s": 0.05,
+    }, **cfg_extra))
+    policy = create_policy("random")
+    policy.load_config(cfg)
+    host = TenantOrchestrator(cfg, policy, collect_trace=True)
+    host.start()
+    return host
+
+
+def _post_event(base, ev, run=""):
+    headers = {"Content-Type": "application/json"}
+    if run:
+        headers[tenancy.RUN_HEADER] = run
+    req = urllib.request.Request(
+        f"{base}/api/v3/events/{ev.entity_id}/{ev.uuid}",
+        data=json.dumps(ev.to_jsonable()).encode(),
+        headers=headers, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, r.read()
+
+
+def _poll(base, entity, run="", batch=None):
+    url = f"{base}/api/v3/actions/{entity}"
+    if batch:
+        url += f"?batch={batch}"
+    req = urllib.request.Request(
+        url, headers={tenancy.RUN_HEADER: run} if run else {})
+    with urllib.request.urlopen(req, timeout=20) as r:
+        return r.status, json.loads(r.read() or b"null")
+
+
+# -- hashing + keys -----------------------------------------------------
+
+
+def test_fnv64a_known_vectors():
+    # the canonical FNV-1a 64 test vectors: the hash must be stable
+    # across processes (journals, multi-process fleets shard alike)
+    assert fnv64a("") == 0xcbf29ce484222325
+    assert fnv64a("a") == 0xaf63dc4c8601ec8c
+    assert fnv64a("foobar") == 0x85944171f73967e8
+
+
+def test_route_key_shapes():
+    assert tenancy.route_key("", "ent0") == "ent0"
+    assert tenancy.route_key("exp", "ent0") == "exp\x1fent0"
+    assert tenancy.split_route_key("ent0") == ("", "ent0")
+    assert tenancy.split_route_key("exp\x1fent0") == ("exp", "ent0")
+    with pytest.raises(ValueError):
+        tenancy.validate_ns("")
+    with pytest.raises(ValueError):
+        tenancy.validate_ns("a\x1fb")
+    with pytest.raises(ValueError):
+        tenancy.validate_ns("x" * 200)
+
+
+def test_sharded_routes():
+    routes = ShardedRoutes(4)
+    assert routes.note_inbound("ent0", "rest") is None
+    assert routes.note_inbound("ent0", "rest") is None
+    assert routes.note_inbound("ent0", "uds") == "rest"  # a move
+    routes.note_inbound_many(["a\x1fe1", "a\x1fe2", "b\x1fe1"], "rest")
+    assert routes.resolve("a\x1fe1") == ("rest", False)
+    name, first = routes.resolve("missing")
+    assert name is None and first            # one-shot warning arms
+    assert routes.resolve("missing") == (None, False)
+    assert routes.forget_namespace("a") == 2
+    assert routes.resolve("a\x1fe1")[0] is None
+    assert routes.resolve("b\x1fe1")[0] == "rest"
+    stalled = routes.stalled(0.0, now=time.monotonic() + 1.0)
+    assert "b\x1fe1" in stalled
+
+
+# -- lease lifecycle ----------------------------------------------------
+
+
+def test_lease_renew_release_and_expiry(tmp_path):
+    host = _host(tmp_path, tenancy_reap_interval_s=0.05)
+    try:
+        reg = host.registry
+        doc = reg.lease("exp-a", ttl_s=5.0, policy="random",
+                        policy_param=_policy_param())
+        assert doc["run"] == "exp-a" and doc["recovered"] == 0
+        with pytest.raises(TenancyError):
+            reg.lease("exp-a")  # double lease refused
+        renewed = reg.renew(doc["lease_id"], ttl_s=9.0)
+        assert renewed["ttl_s"] == 9.0 and renewed["renewals"] == 1
+        with pytest.raises(TenancyError):
+            reg.renew("nope")
+        released = reg.release(doc["lease_id"])
+        assert released["run"] == "exp-a"
+        with pytest.raises(TenancyError):
+            reg.release(doc["lease_id"])  # gone
+
+        # expiry: a lease nobody renews is reclaimed by the reaper
+        short = reg.lease("exp-b", ttl_s=0.2, policy="random",
+                          policy_param=_policy_param())
+        deadline = time.monotonic() + 5.0
+        while reg.active_count() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert reg.active_count() == 0
+        with pytest.raises(TenancyError):
+            reg.renew(short["lease_id"])
+    finally:
+        host.shutdown()
+
+
+# -- wire isolation -----------------------------------------------------
+
+
+def test_rest_namespace_isolation_same_entity(tmp_path):
+    host = _host(tmp_path)
+    try:
+        base = f"http://127.0.0.1:{host.hub.endpoint('rest').port}"
+        cli = TenancyClient(base)
+        leases = {run: cli.lease(run, ttl_s=30,
+                                 policy_param=_policy_param())
+                  for run in ("exp-a", "exp-b")}
+        # the SAME entity id in both namespaces and the default one
+        for run in ("exp-a", "exp-b", ""):
+            ev = PacketEvent.create("n0", "n0", "peer",
+                                    hint=f"hint-{run or 'default'}")
+            status, body = _post_event(base, ev, run=run)
+            assert status == 200
+        for run in ("exp-a", "exp-b", ""):
+            status, action = _poll(base, "n0", run=run)
+            assert status == 200
+            assert action["event_hint"].endswith(run or "default")
+        rel = {run: cli.release(leases[run]["lease_id"])
+               for run in ("exp-a", "exp-b")}
+        for run in ("exp-a", "exp-b"):
+            hints = [a["event_hint"] for a in rel[run]["trace"]]
+            assert hints == [f"n0->peer:hint-{run}"]
+    finally:
+        host.shutdown()
+
+
+def test_pretenancy_rest_replies_identical(tmp_path):
+    """A client that never heard of namespaces gets byte-identical
+    replies from a tenancy host (loss-free compatibility)."""
+    from namazu_tpu.orchestrator import Orchestrator
+
+    cfg = Config({"rest_port": 0, "run_id": "solo",
+                  "explore_policy": "random",
+                  "explore_policy_param": _policy_param()})
+    solo_policy = create_policy("random")
+    solo_policy.load_config(cfg)
+    solo = Orchestrator(cfg, solo_policy, collect_trace=True)
+    solo.start()
+    host = _host(tmp_path)
+    try:
+        bodies = {}
+        for tag, orc in (("solo", solo), ("tenant", host)):
+            base = f"http://127.0.0.1:{orc.hub.endpoint('rest').port}"
+            ev = PacketEvent.create("n0", "n0", "peer", hint="h0")
+            _, post_body = _post_event(base, ev)
+            _, dup_body = _post_event(base, ev)  # dedupe ring reply
+            bodies[tag] = (post_body, dup_body)
+        assert bodies["solo"] == bodies["tenant"]
+    finally:
+        solo.shutdown()
+        host.shutdown()
+
+
+def test_uds_wire_namespaces_and_lease_ops(tmp_path):
+    host = _host(tmp_path)
+    try:
+        sock = str(tmp_path / "endpoint.sock")
+        cli = TenancyClient(f"uds://{sock}")
+        lease = cli.lease("exp-u", ttl_s=30,
+                          policy_param=_policy_param())
+        assert lease["ok"] and lease["run"] == "exp-u"
+        runs = cli.runs()["runs"]
+        assert [r["run"] for r in runs] == ["exp-u"]
+
+        from namazu_tpu.inspector.uds_transceiver import UdsTransceiver
+
+        tx = UdsTransceiver("n0", sock, run_ns="exp-u")
+        tx_default = UdsTransceiver("n0", sock)
+        try:
+            tx.start()
+            tx_default.start()
+            ch = tx.send_event(
+                PacketEvent.create("n0", "n0", "peer", hint="ns-ev"))
+            ch_d = tx_default.send_event(
+                PacketEvent.create("n0", "n0", "peer", hint="def-ev"))
+            assert ch.get(timeout=20).event_hint == "n0->peer:ns-ev"
+            assert ch_d.get(timeout=20).event_hint == "n0->peer:def-ev"
+        finally:
+            tx.shutdown()
+            tx_default.shutdown()
+        rel = cli.release(lease["lease_id"])
+        assert [a["event_hint"] for a in rel["trace"]] \
+            == ["n0->peer:ns-ev"]
+        with pytest.raises(TenancyWireError):
+            cli.lease("bad\x1fname")
+    finally:
+        host.shutdown()
+
+
+# -- per-namespace decision equivalence ---------------------------------
+
+
+def test_tenant_run_trace_equivalent_to_solo(tmp_path):
+    """The PR 8/12 equivalence discipline, tenancy edition: one
+    namespace's dispatch order on a BUSY shared orchestrator (noisy
+    sibling tenant) must equal the same seeded workload run solo."""
+    from namazu_tpu.orchestrator import Orchestrator
+
+    # exact (min == max) delays, the PR-8 trace-differ discipline: the
+    # fault-free dispatch order is then deterministic (FIFO among equal
+    # release times), so solo-vs-tenant equality is exact, not flaky
+    param = _policy_param(seed=11, interval="10ms")
+
+    def drive(base, run_ns, hints):
+        from namazu_tpu.inspector.rest_transceiver import RestTransceiver
+
+        tx = RestTransceiver("n0", base, use_batch=False,
+                             run_ns=run_ns)
+        tx.start()
+        try:
+            chans = [tx.send_event(
+                PacketEvent.create("n0", "n0", "peer", hint=h))
+                for h in hints]
+            return [ch.get(timeout=30) for ch in chans]
+        finally:
+            tx.shutdown()
+
+    hints = [f"h{i}" for i in range(12)]
+
+    # solo reference run
+    cfg = Config({"rest_port": 0, "run_id": "solo-ref",
+                  "explore_policy": "random",
+                  "explore_policy_param": dict(param)})
+    solo_policy = create_policy("random")
+    solo_policy.load_config(cfg)
+    solo = Orchestrator(cfg, solo_policy, collect_trace=True)
+    solo.start()
+    try:
+        drive(f"http://127.0.0.1:{solo.hub.endpoint('rest').port}",
+              "", hints)
+    finally:
+        solo_trace = [a.event_hint for a in solo.shutdown()]
+
+    # same seeded workload as a namespace beside a noisy sibling
+    host = _host(tmp_path)
+    try:
+        base = f"http://127.0.0.1:{host.hub.endpoint('rest').port}"
+        lease = host.registry.lease("exp-eq", ttl_s=60,
+                                    policy="random",
+                                    policy_param=dict(param))
+        noisy = host.registry.lease("exp-noise", ttl_s=60,
+                                    policy="random",
+                                    policy_param=_policy_param(seed=3))
+        stop = threading.Event()
+
+        def noise():
+            from namazu_tpu.inspector.rest_transceiver import (
+                RestTransceiver,
+            )
+
+            tx = RestTransceiver("n0", base, use_batch=False,
+                                 run_ns="exp-noise")
+            tx.start()
+            try:
+                i = 0
+                while not stop.is_set() and i < 200:
+                    tx.send_event(PacketEvent.create(
+                        "n0", "n0", "peer", hint=f"noise{i}"))
+                    i += 1
+                    time.sleep(0.002)
+            finally:
+                tx.shutdown()
+
+        t = threading.Thread(target=noise, daemon=True)
+        t.start()
+        drive(base, "exp-eq", hints)
+        stop.set()
+        t.join(timeout=10)
+        rel = host.registry.release(lease["lease_id"])
+        host.registry.release(noisy["lease_id"], want_trace=False)
+        tenant_trace = [a["event_hint"] for a in rel["trace"]]
+        assert tenant_trace == solo_trace
+        assert all(h.startswith("n0->peer:h") for h in tenant_trace)
+    finally:
+        host.shutdown()
+
+
+# -- flight recorder / analytics isolation ------------------------------
+
+
+def test_traces_and_records_stay_per_namespace(tmp_path):
+    host = _host(tmp_path)
+    try:
+        base = f"http://127.0.0.1:{host.hub.endpoint('rest').port}"
+        lease = host.registry.lease("exp-r", ttl_s=30,
+                                    policy_param=_policy_param())
+        run_id = lease["run_id"]
+        ev_ns = PacketEvent.create("n0", "n0", "peer", hint="ns")
+        ev_def = PacketEvent.create("n0", "n0", "peer", hint="def")
+        _post_event(base, ev_ns, run="exp-r")
+        _post_event(base, ev_def)
+        for run in ("exp-r", ""):
+            _poll(base, "n0", run=run)
+
+        def fetch(path):
+            with urllib.request.urlopen(base + path, timeout=10) as r:
+                return json.loads(r.read())
+
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            doc = fetch(f"/traces/{run_id}")
+            if doc.get("traceEvents") is not None:
+                uuids = {e.get("args", {}).get("event")
+                         for e in doc["traceEvents"]}
+                if ev_ns.uuid in uuids:
+                    break
+            time.sleep(0.05)
+        runs = {r["run_id"] for r in fetch("/traces")["runs"]}
+        assert run_id in runs and "host-default" in runs
+        ns_doc = fetch(f"/traces/{run_id}")
+        ns_uuids = {e.get("args", {}).get("event")
+                    for e in ns_doc["traceEvents"]}
+        assert ev_ns.uuid in ns_uuids
+        assert ev_def.uuid not in ns_uuids  # no cross-namespace leak
+        def_doc = fetch("/traces/host-default")
+        def_uuids = {e.get("args", {}).get("event")
+                     for e in def_doc["traceEvents"]}
+        assert ev_def.uuid in def_uuids and ev_ns.uuid not in def_uuids
+        host.registry.release(lease["lease_id"], want_trace=False)
+    finally:
+        host.shutdown()
+
+
+# -- journal recovery (crash reclamation) --------------------------------
+
+
+def test_expired_lease_journal_recovers_exactly_once(tmp_path):
+    from namazu_tpu import chaos
+    from namazu_tpu.chaos.plan import FaultPlan
+
+    host = _host(tmp_path, tenancy_reap_interval_s=3600.0)
+    try:
+        base = f"http://127.0.0.1:{host.hub.endpoint('rest').port}"
+        jdir = str(tmp_path / "jrun")
+        lease = host.registry.lease(
+            "exp-j", ttl_s=600.0, policy="random",
+            policy_param=_policy_param(interval="1500ms"),
+            journal_dir=jdir)
+        from namazu_tpu.inspector.rest_transceiver import RestTransceiver
+
+        tx = RestTransceiver("n0", base, use_batch=False,
+                             run_ns="exp-j")
+        tx.start()
+        try:
+            evs = [PacketEvent.create("n0", "n0", "peer", hint=f"j{i}")
+                   for i in range(5)]
+            chans = [tx.send_event(ev) for ev in evs]
+            ns = host.registry.namespace("exp-j")
+            deadline = time.monotonic() + 5.0
+            while ns.parked_depth() < 5 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert ns.parked_depth() == 5
+            chaos.install(FaultPlan(1, {"tenancy.lease.expire":
+                                        {"prob": 1.0, "max_fires": 1}}))
+            try:
+                assert host.registry.sweep() == 1
+            finally:
+                chaos.clear()
+            assert host.registry.active_count() == 0
+            # nothing dispatched at reclaim
+            assert all(ch.empty() for ch in chans)
+            release = host.registry.lease(
+                "exp-j", ttl_s=600.0, policy="random",
+                policy_param=_policy_param(), journal_dir=jdir)
+            assert release["recovered"] == 5
+            got = [ch.get(timeout=20) for ch in chans]
+            assert len(got) == 5
+            time.sleep(0.2)
+            assert all(ch.empty() for ch in chans)  # exactly once
+            rel = host.registry.release(release["lease_id"])
+            assert sorted(a["event_uuid"] for a in rel["trace"]) \
+                == sorted(ev.uuid for ev in evs)
+        finally:
+            tx.shutdown()
+    finally:
+        host.shutdown()
+
+
+def test_release_drops_action_queues_and_rejects_bad_entities(tmp_path):
+    """A re-lease of the same run name must not poll the dead
+    incarnation's undelivered actions (queues are forgotten at detach),
+    and entity ids that would alias the composite route key are
+    rejected at the wire."""
+    host = _host(tmp_path)
+    try:
+        base = f"http://127.0.0.1:{host.hub.endpoint('rest').port}"
+        lease = host.registry.lease("exp-q", ttl_s=30,
+                                    policy_param=_policy_param())
+        ev = PacketEvent.create("n0", "n0", "peer", hint="stale")
+        _post_event(base, ev, run="exp-q")
+        rest = host.hub.endpoint("rest")
+        key = tenancy.route_key("exp-q", "n0")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with rest._queues_lock:
+                q = rest._queues.get(key)
+            if q is not None and len(q):
+                break
+            time.sleep(0.02)
+        assert q is not None and len(q) == 1  # undelivered action
+        host.registry.release(lease["lease_id"], want_trace=False)
+        with rest._queues_lock:
+            assert key not in rest._queues  # queue forgotten
+        # the next incarnation starts clean: no stale action to poll
+        lease2 = host.registry.lease("exp-q", ttl_s=30,
+                                     policy_param=_policy_param())
+        with rest._queues_lock:
+            assert key not in rest._queues
+        host.registry.release(lease2["lease_id"], want_trace=False)
+
+        # entity ids carrying the separator are refused at the wire.
+        # The REST URL cannot even express a raw \x1f (http.client
+        # refuses the request line; %1F stays literal since the routes
+        # never unquote) — the framed wire is the real vector:
+        bad = PacketEvent.create("a\x1fb", "a\x1fb", "peer")
+        with pytest.raises(Exception):
+            _post_event(base, bad)
+        import socket as _socket
+
+        from namazu_tpu.endpoint.agent import read_frame, write_frame
+
+        c = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+        c.connect(str(tmp_path / "endpoint.sock"))
+        write_frame(c, {"op": "poll", "entity": "a\x1fb",
+                        "timeout_s": 0.1})
+        resp = read_frame(c)
+        c.close()
+        assert resp["ok"] is False \
+            and "must not contain" in resp["error"]
+    finally:
+        host.shutdown()
+
+
+def test_tenant_crash_scenario(tmp_path):
+    from namazu_tpu.chaos.harness import run_scenario
+
+    res = run_scenario("tenant_crash", seed=5, workdir=str(tmp_path))
+    assert res["ok"], res["invariants"]
+    assert res["fault_report"]["fired"].get("tenancy.lease.expire") == 1
+
+
+# -- async framed server ------------------------------------------------
+
+
+def test_framed_parked_polls_do_not_starve_short_ops():
+    """The selector-core contract: with every pool worker's worth of
+    polls PARKED, a short op still answers promptly (parked ops hand
+    off to their own threads; they never hold pool slots)."""
+    import socket as _socket
+
+    from namazu_tpu.endpoint.agent import read_frame, write_frame
+    from namazu_tpu.endpoint.framed import FramedServer
+
+    park = threading.Event()
+
+    def handler(req):
+        if req.get("op") == "poll":
+            park.wait(timeout=20)
+            return {"ok": True, "actions": []}
+        return {"ok": True, "echo": req.get("x")}
+
+    srv = FramedServer(handler, name="t", workers=2)
+    port = srv.bind_tcp("127.0.0.1", 0)
+    srv.start()
+    conns = []
+    try:
+        # park MORE polls than workers
+        for _ in range(6):
+            c = _socket.create_connection(("127.0.0.1", port),
+                                          timeout=10)
+            write_frame(c, {"op": "poll"})
+            conns.append(c)
+        time.sleep(0.2)
+        c = _socket.create_connection(("127.0.0.1", port), timeout=10)
+        conns.append(c)
+        t0 = time.monotonic()
+        write_frame(c, {"op": "short", "x": 42})
+        resp = read_frame(c)
+        assert resp == {"ok": True, "echo": 42}
+        assert time.monotonic() - t0 < 5.0
+        park.set()
+    finally:
+        park.set()
+        for c in conns:
+            c.close()
+        srv.shutdown()
+
+
+def test_framed_pipelined_requests_keep_order():
+    import socket as _socket
+
+    from namazu_tpu.endpoint.agent import read_frame, write_frame
+    from namazu_tpu.endpoint.framed import FramedServer
+
+    srv = FramedServer(lambda req: {"ok": True, "i": req["i"]},
+                       name="t", workers=3)
+    port = srv.bind_tcp("127.0.0.1", 0)
+    srv.start()
+    try:
+        c = _socket.create_connection(("127.0.0.1", port), timeout=10)
+        for i in range(20):
+            write_frame(c, {"i": i})
+        got = [read_frame(c)["i"] for _ in range(20)]
+        assert got == list(range(20))
+        c.close()
+    finally:
+        srv.shutdown()
+
+
+# -- fleet per-run dimension --------------------------------------------
+
+
+def test_fleet_payload_and_top_render_runs_dimension():
+    from namazu_tpu.cli.tools_cmd import render_top
+    from namazu_tpu.obs.federation import SCHEMA, FleetAggregator
+
+    agg = FleetAggregator()
+    doc = {
+        "schema": SCHEMA, "job": "orchestrator", "instance": "i1",
+        "seq": 1, "interval_s": 1.0,
+        "families": [
+            {"name": "nmz_tenancy_events_total", "type": "counter",
+             "labelnames": ["run"],
+             "samples": [{"labels": {"run": "exp-a"}, "value": 42.0},
+                         {"labels": {"run": "exp-b"}, "value": 7.0}]},
+            {"name": "nmz_tenancy_parked", "type": "gauge",
+             "labelnames": ["run"],
+             "samples": [{"labels": {"run": "exp-a"}, "value": 3.0}]},
+        ],
+    }
+    agg.note_push(doc)
+    payload = agg.payload()
+    runs = payload["instances"][0]["runs"]
+    assert runs["exp-a"] == {"events_total": 42,
+                             "events_per_sec": None, "parked": 3}
+    assert runs["exp-b"]["events_total"] == 7
+    text = render_top(payload)
+    assert "RUN" in text and "exp-a" in text and "exp-b" in text
+    # a second push yields a per-run rate
+    doc2 = dict(doc, seq=2)
+    doc2["families"] = [dict(doc["families"][0],
+                             samples=[{"labels": {"run": "exp-a"},
+                                       "value": 52.0}])]
+    agg.note_push(doc2, now=time.monotonic() + 2.0)
+    runs2 = agg.payload()["instances"][0]["runs"]
+    assert runs2["exp-a"]["events_per_sec"] is not None
+
+
+# -- campaign serve mode ------------------------------------------------
+
+
+def test_campaign_serve_mode(tmp_path):
+    from namazu_tpu.campaign import Campaign, CampaignSpec, summarize
+    from namazu_tpu.storage import new_storage
+
+    storage_dir = str(tmp_path / "storage")
+    st = new_storage("naive", storage_dir)
+    st.create()
+    st.close()
+    with open(tmp_path / "storage" / "config.json", "w") as f:
+        json.dump({"explore_policy": "random"}, f)
+
+    host = _host(tmp_path)
+    try:
+        base = f"http://127.0.0.1:{host.hub.endpoint('rest').port}"
+        spec = CampaignSpec(
+            storage_dir=storage_dir, runs=2, retries=1,
+            telemetry_collector="",
+            serve_url=base, serve_ttl_s=5.0, serve_events=24,
+            serve_entities=2,
+            serve_policy="random",
+            serve_policy_param=_policy_param())
+        campaign = Campaign(spec)
+        status = campaign.run(resume=False)
+        assert status == 0
+        summary = summarize(campaign.state)
+        assert summary["experiment"] == 2
+        assert summary["stopped_reason"] == "done"
+        # no leases left behind, traces recorded, storage fsck-clean
+        assert host.registry.active_count() == 0
+        st = new_storage("naive", storage_dir)
+        st.init()
+        assert st.nr_stored_histories() == 2
+        assert len(st.get_stored_history(0)) == 24
+        report = st.fsck(repair=False)
+        assert not report["incomplete_unmarked"]
+        assert not report["tmp_artifacts"]
+        st.close()
+    finally:
+        host.shutdown()
+
+
+def test_bench_multi_run_smoke(tmp_path, monkeypatch):
+    import bench
+
+    aggregate, per_run = bench.run_multi_pipeline(
+        2, 48, 2, flush_window=0.02, batch_max=32,
+        run_id="test-multi", poll_linger=0.02, wire="uds", shm=False)
+    assert aggregate > 0 and len(per_run) == 2
+    assert all(r > 0 for r in per_run)
