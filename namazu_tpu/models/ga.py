@@ -84,7 +84,15 @@ def ga_generation(key: jax.Array, pop: Population, fitness: jax.Array,
     mutation rate (clipped to [0, 1]) — coverage guidance concentrating
     perturbation on the buckets whose relations are untested. The fault
     half is untouched: fault flips change which events EXIST, not their
-    order, so ordering-coverage bias has nothing to say about them."""
+    order, so ordering-coverage bias has nothing to say about them.
+
+    Draw-order contract (the search plane's analogue of
+    ``ScheduledQueue.put_many``'s): one generation consumes exactly the
+    splits/draws derived from its ``key``, and the per-generation key is
+    always ``fold_in(base_key, gen)`` — whether generations run one
+    jitted dispatch at a time or fused in a ``lax.scan``
+    (parallel/islands.py). That is what makes the fused loop bit-exact
+    with the stepwise loop (tests/test_fused_loop.py)."""
     P, H = pop.delays.shape
     n_elite = max(1, int(P * cfg.elite_frac))
     ks = jax.random.split(key, 6)

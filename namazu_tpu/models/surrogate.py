@@ -51,14 +51,19 @@ class RewardSurrogate:
             steps=jnp.zeros((), jnp.int32),
         )
 
-        def loss_fn(params, feats, labels):
+        def loss_fn(params, feats, labels, weight):
             logits = self.model.apply(params, feats)
-            return optax.sigmoid_binary_cross_entropy(logits, labels).mean()
+            per = optax.sigmoid_binary_cross_entropy(logits, labels)
+            # weighted mean over the REAL rows only: partial batches are
+            # padded to a fixed shape with zero-weight rows, so the loss
+            # (and gradient) equals the unpadded mean while every batch
+            # hits one compiled specialization
+            return (per * weight).sum() / jnp.maximum(weight.sum(), 1.0)
 
         @jax.jit
-        def train_step(state: SurrogateState, feats, labels):
+        def train_step(state: SurrogateState, feats, labels, weight):
             loss, grads = jax.value_and_grad(loss_fn)(
-                state.params, feats, labels
+                state.params, feats, labels, weight
             )
             updates, opt_state = self.tx.update(grads, state.opt_state,
                                                 state.params)
@@ -75,18 +80,35 @@ class RewardSurrogate:
     def train(self, feats: np.ndarray, labels: np.ndarray,
               epochs: int = 1, batch: int = 256,
               seed: int = 0) -> float:
-        """Train on (feats [N,K], labels [N] in {0,1}); returns last loss."""
+        """Train on (feats [N,K], labels [N] in {0,1}); returns last loss.
+
+        Every minibatch is padded to the fixed ``batch`` shape with
+        zero-WEIGHT rows (the weighted loss ignores them exactly), so
+        the jitted train step compiles ONCE per feature width no matter
+        how the archive's occupancy grows between rounds — pre-padding,
+        each new occupancy's partial tail batch was a fresh
+        trace+compile in the middle of a campaign (compile-count and
+        padded-vs-exact equality pinned by tests/test_fused_loop.py)."""
         n = len(feats)
+        K = feats.shape[1]
         rng = np.random.RandomState(seed)
         loss = 0.0
         for _ in range(epochs):
             order = rng.permutation(n)
             for i in range(0, n, batch):
                 idx = order[i : i + batch]
+                nb = len(idx)
+                f = np.zeros((batch, K), np.float32)
+                f[:nb] = feats[idx]
+                lb = np.zeros((batch,), np.float32)
+                lb[:nb] = labels[idx]
+                w = np.zeros((batch,), np.float32)
+                w[:nb] = 1.0
                 self.state, l = self._train_step(
                     self.state,
-                    jnp.asarray(feats[idx]),
-                    jnp.asarray(labels[idx], jnp.float32),
+                    jnp.asarray(f),
+                    jnp.asarray(lb),
+                    jnp.asarray(w),
                 )
                 loss = float(l)
         return loss

@@ -113,6 +113,7 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     scorer_throughput,
     scorer_throughput_value,
     search_phase,
+    search_progress,
     search_round,
     search_stall,
     sidecar_request,

@@ -433,6 +433,8 @@ def convergence_stats(recorder_runs,
     novelty: Dict[str, List[float]] = {}
     generations: Dict[str, int] = {}
     installs: Dict[str, int] = {}
+    host_io: Dict[str, float] = {}
+    host_elapsed: Dict[str, float] = {}
     rounds = 0
     for run in recorder_runs or []:
         snap = run.snapshot()
@@ -450,6 +452,14 @@ def convergence_stats(recorder_runs,
                 if g.get("distinct_failures") is not None:
                     novelty.setdefault(b, []).append(
                         float(g["distinct_failures"]))
+                if g.get("host_io_s") is not None:
+                    # fused-loop rounds: host-I/O lane wall time vs the
+                    # round's whole evolve span -> per-generation
+                    # host-gap share (doc/performance.md)
+                    host_io[b] = host_io.get(b, 0.0) + float(g["host_io_s"])
+                    host_elapsed[b] = host_elapsed.get(b, 0.0) + max(
+                        0.0, float(g.get("t_end", 0.0))
+                        - float(g.get("t_start", 0.0)))
             elif g.get("kind") == "install":
                 src = g.get("source", "?")
                 installs[src] = installs.get(src, 0) + 1
@@ -465,6 +475,9 @@ def convergence_stats(recorder_runs,
             "novelty_curve": [int(v) for v in novelty.get(b, [])[-64:]],
             "stalled": detect_stall(fit, novelty.get(b), window=window),
         }
+        if b in host_io and host_elapsed.get(b, 0.0) > 0:
+            backends[b]["host_gap_share"] = round(
+                min(1.0, host_io[b] / host_elapsed[b]), 4)
     return {
         "search_rounds": rounds,
         "installs": dict(sorted(installs.items())),

@@ -573,7 +573,9 @@ def record_generation(backend: str, generations: int, elapsed: float,
                       now: Optional[float] = None,
                       archive_entries: Optional[int] = None,
                       failure_entries: Optional[int] = None,
-                      distinct_failures: Optional[int] = None) -> None:
+                      distinct_failures: Optional[int] = None,
+                      host_io_s: Optional[float] = None,
+                      fit_curve: Optional[list] = None) -> None:
     """One ``search.run()`` round: advances the process generation
     counter and logs the round on the run's search track. The optional
     archive occupancies feed the experiment plane's convergence/stall
@@ -602,6 +604,17 @@ def record_generation(backend: str, generations: int, elapsed: float,
         entry["failure_entries"] = int(failure_entries)
     if distinct_failures is not None:
         entry["distinct_failures"] = int(distinct_failures)
+    if host_io_s is not None:
+        # fused-loop rounds: wall time spent in the overlapped host-I/O
+        # lane — the experiment plane derives the per-generation
+        # host-gap share from it (obs/analytics.py convergence_stats)
+        entry["host_io_s"] = round(float(host_io_s), 6)
+    if fit_curve:
+        # fused-loop rounds: the PER-GENERATION global-best history the
+        # host lane drained (one point per generation, not per round) —
+        # intra-round convergence at a resolution the round-level
+        # fitness_curve cannot see. Tail-capped like the other curves.
+        entry["fit_curve"] = [round(float(v), 6) for v in fit_curve[-64:]]
     run.add_generation(entry)
 
 

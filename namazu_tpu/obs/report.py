@@ -169,6 +169,13 @@ def render_markdown(payload: Dict[str, Any]) -> str:
                 f"archive `{sparkline(b.get('archive_curve', []))}` "
                 f"novelty `{sparkline(b.get('novelty_curve', []))}`; "
                 f"stalled: {_num(b.get('stalled', False))}")
+            if b.get("host_gap_share") is not None:
+                # fused search loop (doc/performance.md): how much of
+                # each generation's wall time the host-I/O lane covers —
+                # the gap the device-side fusion exists to close
+                out(f"  - host-gap share per generation: "
+                    f"{b['host_gap_share'] * 100:.1f}% "
+                    "(overlapped host I/O / evolve wall time)")
         out(f"- stalled: {_num(conv.get('stalled', False))}")
     else:
         out("- no search-plane records (run under a search policy with "

@@ -59,6 +59,7 @@ SEARCH_ARCHIVE = "nmz_search_archive_entries"
 SEARCH_INSTALLS = "nmz_search_installs_total"
 SCORER_THROUGHPUT = "nmz_scorer_schedules_per_sec"
 SEARCH_PHASE = "nmz_search_phase_seconds"
+SEARCH_HOST_GAP = "nmz_search_host_gap_share"
 SEARCH_STALL = "nmz_search_stall"
 SIDECAR_REQUESTS = "nmz_sidecar_requests_total"
 ENTITY_LABEL_OVERFLOW = "nmz_entity_label_overflow_total"
@@ -752,11 +753,21 @@ def sched_queue_wait(queue: str, seconds: float) -> None:
 def search_round(backend: str, generations: int, elapsed: float,
                  schedules: float, best_fitness: float,
                  archive_entries: int, failure_entries: int,
-                 distinct_failures: int) -> None:
-    """One search.run() call's worth of progress."""
+                 distinct_failures: int,
+                 host_io_s: Optional[float] = None) -> None:
+    """One search.run() call's worth of progress. ``host_io_s`` is the
+    wall time the round spent in the fused loop's overlapped host-I/O
+    lane (doc/performance.md "Fused search loop"): published as the
+    ``nmz_search_host_gap_share{backend}`` gauge (host seconds per
+    evolve second — the number the fusion exists to drive toward 0)."""
     if not metrics.enabled():
         return
     reg = metrics.get()
+    if host_io_s is not None and elapsed > 0:
+        reg.gauge(
+            SEARCH_HOST_GAP, "host-I/O share of the last fused search "
+            "round (host_io seconds / evolve seconds)", ("backend",),
+        ).labels(backend=backend).set(host_io_s / elapsed)
     reg.counter(
         SEARCH_GENERATIONS, "GA generations (or MCTS simulations) run",
         ("backend",),
@@ -788,6 +799,18 @@ def search_round(backend: str, generations: int, elapsed: float,
     from namazu_tpu.obs import analytics
 
     analytics.note_search_round(backend, best_fitness, distinct_failures)
+
+
+def search_progress(backend: str, best_fitness: float) -> None:
+    """Live best-fitness update from the fused loop's host lane — the
+    cheap per-chunk publication that keeps the gauge moving while one
+    ``run()`` is still evolving (search_round refreshes it at the end
+    of the round as before)."""
+    if not metrics.enabled():
+        return
+    metrics.get().gauge(
+        SEARCH_BEST_FITNESS, "best fitness seen so far", ("backend",),
+    ).labels(backend=backend).set(best_fitness)
 
 
 def search_stall(backend: str, stalled: bool) -> None:
@@ -914,7 +937,9 @@ def _trace_annotation(name: str):
 @contextlib.contextmanager
 def search_phase(phase: str):
     """Time one search-plane phase (ingest / evolve / extract / install
-    / surrogate) into ``nmz_search_phase_seconds{phase=...}`` and, when
+    / surrogate / host_io — the last is the fused loop's overlapped
+    host-I/O lane, doc/performance.md) into
+    ``nmz_search_phase_seconds{phase=...}`` and, when
     jax's profiler is importable, annotate the region into any active
     device profile via ``jax.profiler.TraceAnnotation`` (no-op without a
     profiler session, no-op fallback when jax is absent). Finer-grained

@@ -37,6 +37,12 @@ import jax
 import jax.numpy as jnp
 
 BIG = 1e9  # "never happens" release time
+# min-identity used to mask archive rows out of a distance min (padded
+# rows and rows past a ring's occupancy): large enough that a masked row
+# can never win against any real feature distance (features live in
+# (0,1)^K so real d2 <= K), small enough that f32 arithmetic on it stays
+# finite
+MASK_BIG = 3.4e38
 
 
 class TraceArrays(NamedTuple):
@@ -273,10 +279,22 @@ def _matmul_dtype():
     return jnp.bfloat16 if jax.default_backend() in ("tpu", "axon") else jnp.float32
 
 
-def min_sq_distance(feats: jax.Array, archive: jax.Array) -> jax.Array:
+def min_sq_distance(feats: jax.Array, archive: jax.Array,
+                    valid_n: Optional[jax.Array] = None) -> jax.Array:
     """min_a ||f_p - a||^2 via the matmul expansion (MXU-friendly).
 
     feats [P,K], archive [A,K] -> [P]. bf16 inputs on TPU, f32 accumulation.
+
+    ``valid_n`` (optional TRACED i32 scalar) is the archive's occupancy:
+    rows at index >= valid_n are masked with :data:`MASK_BIG` so they
+    never win the min — equivalent to calling with ``archive[:n]``
+    while keeping the buffer shape fixed, so a caller that holds a
+    fixed-capacity ring can grow its occupancy without a new jit
+    specialization per size (compile-count pinned by
+    tests/test_fused_loop.py). ``None`` keeps the pre-occupancy graph:
+    every row is live — the in-repo search passes None, because its
+    rings deliberately treat unoccupied slots as neutral 0.5 feature
+    points (SearchBase), and masking them out would change fitness.
     """
     dt = _matmul_dtype()
     f16 = feats.astype(dt)
@@ -288,18 +306,46 @@ def min_sq_distance(feats: jax.Array, archive: jax.Array) -> jax.Array:
     )  # [P, A]
     f2 = jnp.sum(feats * feats, axis=-1, keepdims=True)  # [P,1]
     a2 = jnp.sum(archive * archive, axis=-1)  # [A]
+    if valid_n is not None:
+        a2 = jnp.where(jnp.arange(archive.shape[0]) < valid_n, a2,
+                       MASK_BIG)
     d2 = f2 + a2[None, :] - 2.0 * cross
     return jnp.maximum(jnp.min(d2, axis=-1), 0.0)
 
 
-def _min_sq_distance_best(feats: jax.Array, archive: jax.Array) -> jax.Array:
+def _min_sq_distance_best(feats: jax.Array, archive: jax.Array,
+                          valid_n: Optional[jax.Array] = None) -> jax.Array:
     """The Pallas fused-min kernel on TPU (~10% whole-scorer win at
     production sizes, no [P,A] HBM round-trip), plain XLA elsewhere.
     Dispatch lives in pallas_score; lazily imported because that module
     imports this one."""
     from namazu_tpu.ops.pallas_score import min_sq_distance_auto
 
-    return min_sq_distance_auto(feats, archive)
+    return min_sq_distance_auto(feats, archive, valid_n=valid_n)
+
+
+def _min_sq_pair_best(feats: jax.Array, archive: jax.Array,
+                      failures: jax.Array,
+                      archive_n: Optional[jax.Array] = None,
+                      failure_n: Optional[jax.Array] = None
+                      ) -> tuple[jax.Array, jax.Array]:
+    """(novelty d2 [P], bug d2 [P]) against both archives in one pass:
+    the Pallas pair kernel on TPU streams each feats tile through BOTH
+    distance mins (one kernel launch, no [P] intermediate round-trips
+    between them — the fused score epilogue of doc/performance.md
+    "Fused search loop"); two XLA mins elsewhere. An occupancy of zero
+    yields a neutral 0.0 distance instead of the mask identity: an
+    empty ring carries no information, not an infinitely-far one."""
+    from namazu_tpu.ops.pallas_score import min_sq_distance_pair_auto
+
+    nov, bug = min_sq_distance_pair_auto(feats, archive, failures,
+                                         archive_n=archive_n,
+                                         failure_n=failure_n)
+    if archive_n is not None:
+        nov = jnp.where(archive_n > 0, nov, 0.0)
+    if failure_n is not None:
+        bug = jnp.where(failure_n > 0, bug, 0.0)
+    return nov, bug
 
 
 def score_population(
@@ -312,6 +358,8 @@ def score_population(
     faults: Optional[jax.Array] = None,  # [P, H] fault probabilities
     coin: Optional[jax.Array] = None,  # [H] deterministic fault coin
     novelty_scale: Optional[jax.Array] = None,  # dynamic f32 scalar
+    archive_n: Optional[jax.Array] = None,  # dynamic i32 occupancy
+    failure_n: Optional[jax.Array] = None,  # dynamic i32 occupancy
 ) -> tuple[jax.Array, jax.Array]:
     """Fitness f32[P] and features f32[P,K] for a whole population.
 
@@ -325,7 +373,18 @@ def score_population(
     scalar — the novelty-anneal lever (exploration weight decays as the
     failure archive accumulates distinct signatures) without a new jit
     specialization per annealed value. ``None`` keeps the pre-anneal
-    graph."""
+    graph.
+
+    ``archive_n``/``failure_n`` (traced i32 scalars) are ring
+    occupancies for fixed-capacity archive buffers: rows past the
+    occupancy are masked out of the distance min, equivalent to slicing
+    ``archive[:n]`` but shape-stable, so an external driver whose
+    archive grows mid-run pays ZERO recompilations instead of one per
+    occupancy (compile-count pinned by test). This is the EXPORTED
+    scoring seam's contract; the in-repo search passes ``None`` (the
+    default, and the pre-occupancy graphs) on purpose — SearchBase's
+    rings treat unoccupied slots as neutral 0.5 feature points, and
+    masking them would change fitness."""
     if faults is None:
         feats, _ = jax.vmap(
             lambda d: _genome_features(d, trace, pairs, weights.tau,
@@ -344,8 +403,11 @@ def score_population(
         )(delays, faults)
         live = jnp.maximum(jnp.sum(trace.mask), 1)
         fault_pen = weights.fault_cost * ndrop / live
-    novelty = _min_sq_distance_best(feats, archive)
-    bug = -_min_sq_distance_best(feats, failure_feats)
+    nov_d2, bug_d2 = _min_sq_pair_best(feats, archive, failure_feats,
+                                       archive_n=archive_n,
+                                       failure_n=failure_n)
+    novelty = nov_d2
+    bug = -bug_d2
     delay_cost = jnp.mean(delays, axis=-1)
     w_nov = (weights.novelty if novelty_scale is None
              else weights.novelty * novelty_scale)
@@ -361,10 +423,16 @@ def score_population(
 @functools.partial(jax.jit, static_argnames=("weights",))
 def score_population_jit(delays, trace, pairs, archive, failure_feats,
                          weights: ScoreWeights = ScoreWeights(),
-                         faults=None, coin=None, novelty_scale=None):
+                         faults=None, coin=None, novelty_scale=None,
+                         archive_n=None, failure_n=None):
+    """Jitted :func:`score_population`. ``archive_n``/``failure_n`` are
+    TRACED occupancy scalars — one compiled specialization serves every
+    occupancy of a fixed-capacity archive buffer (the mid-run recompile
+    fix; see ``score_population``)."""
     return score_population(delays, trace, pairs, archive, failure_feats,
                             weights, faults=faults, coin=coin,
-                            novelty_scale=novelty_scale)
+                            novelty_scale=novelty_scale,
+                            archive_n=archive_n, failure_n=failure_n)
 
 
 # -- multi-trace scoring ----------------------------------------------------
@@ -380,6 +448,8 @@ def score_population_multi(
     faults: Optional[jax.Array] = None,  # [P, H]
     coin: Optional[jax.Array] = None,  # [H]
     novelty_scale: Optional[jax.Array] = None,  # dynamic f32 scalar
+    archive_n: Optional[jax.Array] = None,  # dynamic i32 occupancy
+    failure_n: Optional[jax.Array] = None,  # dynamic i32 occupancy
 ) -> tuple[jax.Array, jax.Array]:
     """Fitness aggregated over T recorded traces.
 
@@ -412,9 +482,11 @@ def score_population_multi(
     feats = jnp.swapaxes(feats, 0, 1)  # [P, T, K]
     P, T, K = feats.shape
     flat = feats.reshape(P * T, K)
-    novelty = _min_sq_distance_best(flat, archive).reshape(P, T).mean(axis=1)
-    bug = -_min_sq_distance_best(flat, failure_feats).reshape(P, T).mean(
-        axis=1)
+    nov_d2, bug_d2 = _min_sq_pair_best(flat, archive, failure_feats,
+                                       archive_n=archive_n,
+                                       failure_n=failure_n)
+    novelty = nov_d2.reshape(P, T).mean(axis=1)
+    bug = -bug_d2.reshape(P, T).mean(axis=1)
     delay_cost = jnp.mean(delays, axis=-1)
     fault_pen = (0.0 if faults is None
                  else weights.fault_cost * frac.mean(axis=0))

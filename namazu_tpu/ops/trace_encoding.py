@@ -344,23 +344,40 @@ def envelope_trace(encs: Sequence[EncodedTrace]) -> EncodedTrace:
                         faultable=faultable)
 
 
+def pad_trace_row(enc: EncodedTrace, L: int) -> Dict[str, np.ndarray]:
+    """One trace's scoring arrays right-padded to ``L`` — 0 for
+    ids/times, False for the mask/faultable flags. The ONE home for the
+    pad fills, shared by :func:`stack_traces` and the fused loop's
+    device-resident trace store (models/search.py ``_ResidentTraces``):
+    a resident row sliced back to a batch's length must be
+    value-identical to the host stacker's padding, or fused and
+    stepwise scoring would diverge on the pad region."""
+    def pad(a, fill):
+        n = L - a.shape[0]
+        if n <= 0:
+            return a
+        return np.concatenate([a, np.full((n,), fill, a.dtype)])
+
+    return {
+        "hint": pad(enc.hint_ids, 0),
+        "ent": pad(enc.entity_ids, 0),
+        "arr": pad(enc.arrival, 0),
+        "mask": pad(enc.mask, False),
+        "flt": pad(enc.faultable, False),
+    }
+
+
 def stack_traces(traces: Sequence[EncodedTrace]) -> Tuple[np.ndarray, ...]:
     """Stack encoded traces into batched arrays [T, L]
     ``(hint_ids, entity_ids, arrival, mask, faultable)``, right-padding
     ragged lengths to the longest (auto-length encodes make ragged
-    batches the normal case)."""
+    batches the normal case). Pad fills live in :func:`pad_trace_row`."""
     L = max(t.hint_ids.shape[0] for t in traces)
-
-    def pad(a, fill=0):
-        n = L - a.shape[0]
-        if n == 0:
-            return a
-        return np.concatenate([a, np.full((n,), fill, a.dtype)])
-
+    rows = [pad_trace_row(t, L) for t in traces]
     return (
-        np.stack([pad(t.hint_ids) for t in traces]),
-        np.stack([pad(t.entity_ids) for t in traces]),
-        np.stack([pad(t.arrival) for t in traces]),
-        np.stack([pad(t.mask, False) for t in traces]),
-        np.stack([pad(t.faultable, False) for t in traces]),
+        np.stack([r["hint"] for r in rows]),
+        np.stack([r["ent"] for r in rows]),
+        np.stack([r["arr"] for r in rows]),
+        np.stack([r["mask"] for r in rows]),
+        np.stack([r["flt"] for r in rows]),
     )

@@ -132,6 +132,29 @@ def make_hybrid_mesh(
     return Mesh(grid, axes)
 
 
+def hier_rings(
+    migrate_k: int = 8,
+    dcn_migrate_k: int = 2,
+    migrate_every: int = 1,
+    dcn_every: int = 1,
+    host_axis: str = "h",
+    chip_axis: str = "i",
+):
+    """The topology-aware ring plan for an ``h x i`` mesh, as consumed
+    by ``islands.make_multiaxis_island_step``/``make_fused_island_step``:
+    the neighbor ring over the chip axis FIRST (full-rate, rides ICI
+    within a host), then the thin cross-host ring over DCN. Each ring
+    carries its own cadence — ``dcn_every > 1`` decouples the expensive
+    cross-host hop from the generation count (the ppermute is skipped
+    entirely on off-generations, moving zero bytes over DCN), which is
+    what lets a 16+-device mesh scale near-linearly instead of gating
+    every generation on its slowest fabric."""
+    return (
+        (chip_axis, migrate_k, migrate_every),
+        (host_axis, dcn_migrate_k, dcn_every),
+    )
+
+
 def make_hier_island_step(
     mesh: Mesh,
     cfg: GAConfig,
@@ -140,10 +163,13 @@ def make_hier_island_step(
     dcn_migrate_k: int = 2,
     host_axis: str = "h",
     chip_axis: str = "i",
+    migrate_every: int = 1,
+    dcn_every: int = 1,
 ):
     """Hierarchical island step for an ``h x i`` mesh: full-rate elite
-    ring over ICI (``migrate_k``), thin elite ring over DCN
-    (``dcn_migrate_k`` genomes — a few KB — landing just above the ICI
+    ring over ICI (``migrate_k``, every ``migrate_every`` generations),
+    thin elite ring over DCN (``dcn_migrate_k`` genomes — a few KB —
+    every ``dcn_every`` generations, landing just above the ICI
     migrants so the rings never overwrite each other). State is the same
     :class:`~namazu_tpu.parallel.islands.IslandState` (init with
     ``init_island_state``), so drivers and checkpoints are identical for
@@ -153,5 +179,6 @@ def make_hier_island_step(
 
     return make_multiaxis_island_step(
         mesh, cfg, weights,
-        rings=((chip_axis, migrate_k), (host_axis, dcn_migrate_k)),
+        rings=hier_rings(migrate_k, dcn_migrate_k, migrate_every,
+                         dcn_every, host_axis, chip_axis),
     )

@@ -58,6 +58,25 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.L = 0  # trace-length cap; 0 = encode full traces (no drop)
         self.K = 256
         self.migrate_k = 8
+        # fused search loop (doc/performance.md "Fused search loop"):
+        # the whole generation loop runs device-side in fused_chunk-
+        # generation scans with donated buffers and device-resident
+        # traces/archives — bit-exact with the per-generation path
+        # (pinned by test), so the knob is a dispatch-shape choice, not
+        # a semantics one. fused = false restores the pre-fusion loop.
+        self.fused = True
+        self.fused_chunk = 16
+        # migration cadence, decoupled from the generation count: the
+        # intra-host ICI ring permutes every migrate_every generations;
+        # on a hybrid host x chip mesh (dcn_hosts > 1) the cross-host
+        # ring only every dcn_migrate_every. Both default 1 — the
+        # pre-cadence behavior bit-for-bit, and the same default the
+        # sidecar's params builder uses — so an upgrade never silently
+        # changes a multi-host search; set dcn_migrate_every = 4 on a
+        # DCN mesh to keep the slow fabric off the critical path
+        # (parallel/distributed.py hier_rings, doc/performance.md)
+        self.migrate_every = 1
+        self.dcn_migrate_every = 1
         self.n_devices: Optional[int] = None
         self.checkpoint_path = ""
         self.search_on_start = True
@@ -215,6 +234,12 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.L = int(p("trace_length", self.L))
         self.K = int(p("feature_pairs", self.K))
         self.migrate_k = int(p("migrate_k", self.migrate_k))
+        self.fused = bool(p("fused", self.fused))
+        self.fused_chunk = max(1, int(p("fused_chunk", self.fused_chunk)))
+        self.migrate_every = max(1, int(p("migrate_every",
+                                          self.migrate_every)))
+        self.dcn_migrate_every = max(1, int(p("dcn_migrate_every",
+                                              self.dcn_migrate_every)))
         nd = p("devices", None)
         self.n_devices = int(nd) if nd is not None else None
         self.checkpoint_path = str(p("checkpoint", "") or "")
@@ -670,6 +695,10 @@ class TPUSearchPolicy(QueueBackedPolicy):
             min_failure_signatures=self.min_failure_signatures,
             novelty_floor=self.novelty_floor,
             guidance_bonus=self.guidance_bonus,
+            fused=self.fused,
+            fused_chunk=self.fused_chunk,
+            migrate_every=self.migrate_every,
+            dcn_migrate_every=self.dcn_migrate_every,
         )
         mesh = None
         if self.dcn_hosts > 1:
@@ -918,6 +947,10 @@ class TPUSearchPolicy(QueueBackedPolicy):
             "H": self.H, "L": self.L, "K": self.K,
             "population": self.population,
             "migrate_k": self.migrate_k,
+            "fused": self.fused,
+            "fused_chunk": self.fused_chunk,
+            "migrate_every": self.migrate_every,
+            "dcn_migrate_every": self.dcn_migrate_every,
             "seed": self.seed,
             "max_interval": self.max_interval,
             "max_fault": self.max_fault,

@@ -91,6 +91,10 @@ def build_search_from_params(p: dict):
         min_failure_signatures=p.get("min_failure_signatures", 0),
         novelty_floor=p.get("novelty_floor", 0.25),
         guidance_bonus=p.get("guidance_bonus", 0.5),
+        fused=bool(p.get("fused", True)),
+        fused_chunk=int(p.get("fused_chunk", 16)),
+        migrate_every=int(p.get("migrate_every", 1)),
+        dcn_migrate_every=int(p.get("dcn_migrate_every", 1)),
     )
     n_devices = p.get("devices")
     if p.get("search_backend", "ga") == "mcts":
