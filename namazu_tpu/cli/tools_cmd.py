@@ -222,6 +222,56 @@ def register(sub) -> None:
                     help="write to this file instead of stdout")
     pw.set_defaults(func=why)
 
+    pz = tsub.add_parser(
+        "minimize",
+        help="auto-minimize a failing run to a reproducer dossier "
+             "(triage plane, doc/observability.md \"Triage\"): "
+             "delta-debug the run's installed delay table over the "
+             "causality plane's ordering flips — candidate subsets are "
+             "scored by FREE simulation through the guidance plane, "
+             "only the best survivors replay for real — and emit a "
+             "self-contained dossier (minimal table + flips + probe "
+             "journal + why explanation + DAG slice), keyed by failure "
+             "signature",
+    )
+    pz.add_argument("storage", nargs="?", default="",
+                    help="storage dir holding the failing run; with "
+                         "--url this is instead a failure SIGNATURE to "
+                         "fetch (omit to list the orchestrator's "
+                         "dossiers)")
+    pz.add_argument("run_index", nargs="?", type=int, default=None,
+                    help="failing run index (default: the most recent "
+                         "non-quarantined failure)")
+    pz.add_argument("--baseline", type=int, default=None,
+                    help="passing run index to diff against (default: "
+                         "the most recent success, else a synthetic "
+                         "zero-delay baseline)")
+    pz.add_argument("--url", default="",
+                    help="a running orchestrator's REST endpoint: read "
+                         "its GET /triage[/<signature>] surface instead "
+                         "of minimizing locally")
+    pz.add_argument("--knowledge", default="",
+                    help="knowledge-service address host:port "
+                         "(doc/knowledge.md): pull an existing dossier "
+                         "for this failure signature first; push the "
+                         "freshly minimized one back for other tenants")
+    pz.add_argument("--top", type=int, default=12,
+                    help="candidate flips taken from the causality "
+                         "diff (default 12)")
+    pz.add_argument("--max-probes", type=int, default=4096,
+                    help="simulated-probe budget (default 4096)")
+    pz.add_argument("--max-replays", type=int, default=4,
+                    help="real-replay budget (default 4)")
+    pz.add_argument("--replay-deadline", type=float, default=120.0,
+                    help="seconds per validation replay (default 120)")
+    pz.add_argument("--no-replay", action="store_true",
+                    help="skip real-execution validation entirely "
+                         "(dossier says validated: false)")
+    pz.add_argument("--format", choices=("md", "json"), default="md")
+    pz.add_argument("--out", default="",
+                    help="write to this file instead of stdout")
+    pz.set_defaults(func=minimize)
+
     pr = tsub.add_parser(
         "report",
         help="experiment analytics report (doc/observability.md): "
@@ -393,7 +443,15 @@ def render_top(payload: dict) -> str:
         ("codec", "CODEC", ""),
         ("backhaul_lag_p99_s", "BACKHL99", "s"),
         ("table_version", "TBLV", ""), ("table_skew", "SKEW", ""),
+        # SKEW (a version count) upgraded with its time-domain twin:
+        # the measured publish->edge-install propagation p99
+        # (nmz_table_propagation_seconds, obs/spans.py)
+        ("table_propagation_p99_s", "PROP99", "s"),
         ("edge_parked", "PARKED", ""),
+        # distinct failure signatures carrying a triage dossier on this
+        # instance (nmz_triage_signatures; doc/observability.md
+        # "Triage")
+        ("triage_signatures", "SIGS", ""),
         ("last_seen_age_s", "AGE", "s"), ("stale", "STALE", ""),
     )
     rows = [[header for _, header, _ in cols]]
@@ -623,7 +681,16 @@ def why(args) -> int:
     if args.format == "json":
         text = json.dumps(payload, sort_keys=True) + "\n"
     else:
-        text = causality.render_why_md(payload)
+        # the closing Perfetto pointer names `tools trace export
+        # <run_id>`, which only works when THIS process's recorder
+        # holds the runs — not for --url-fetched payloads or file
+        # dumps, where the pointer would dangle
+        from namazu_tpu import obs
+
+        local_dump = both_ids and not args.url \
+            and obs.trace_run(args.run_a) is not None \
+            and obs.trace_run(args.run_b) is not None
+        text = causality.render_why_md(payload, perfetto=local_dump)
     if args.out:
         with open(args.out, "w") as f:
             f.write(text)
@@ -631,6 +698,80 @@ def why(args) -> int:
     else:
         sys.stdout.write(text)
     return 0
+
+
+def _emit(text: str, out: str) -> None:
+    if out:
+        with open(out, "w") as f:
+            f.write(text)
+        print(f"wrote {out}")
+    else:
+        sys.stdout.write(text)
+
+
+def minimize(args) -> int:
+    """Auto-minimized reproducer for a failing run (triage plane,
+    namazu_tpu/triage): knowledge-first when a signature is already
+    dossier'd, locally delta-debugged otherwise."""
+    from namazu_tpu import triage
+
+    if args.url:
+        base = args.url.rstrip("/")
+        if not args.storage:
+            doc = json.loads(_http_get(f"{base}/triage"))
+            print(json.dumps(doc, sort_keys=True))
+            return 0
+        doc = json.loads(_http_get(f"{base}/triage/{args.storage}"))
+        dossier = doc.get("dossier") or doc
+        text = (json.dumps(dossier, sort_keys=True) + "\n"
+                if args.format == "json"
+                else triage.render_dossier_md(dossier))
+        _emit(text, args.out)
+        return 0
+    if not args.storage:
+        raise SystemExit("error: minimize needs a storage dir "
+                         "(or --url)")
+
+    client = None
+    if args.knowledge:
+        from namazu_tpu.knowledge import shared_client
+
+        client = shared_client(args.knowledge, tenant="tools-minimize")
+        # knowledge-first: a sibling campaign may already have paid the
+        # replays for this exact failure signature
+        try:
+            sig = triage.failure_signature(args.storage, args.run_index)
+        except triage.MinimizeError as e:
+            raise SystemExit(f"error: {e}") from None
+        pulled = client.triage_pull(sig)
+        if pulled is not None:
+            print(f"# dossier for {sig} served from the knowledge "
+                  "pool (no local minimization)", file=sys.stderr)
+            text = (json.dumps(pulled, sort_keys=True) + "\n"
+                    if args.format == "json"
+                    else triage.render_dossier_md(pulled))
+            _emit(text, args.out)
+            return 0
+
+    budget = triage.MinimizeBudget(
+        max_probes=args.max_probes,
+        max_replays=0 if args.no_replay else args.max_replays,
+        replay_deadline_s=args.replay_deadline)
+    try:
+        dossier = triage.minimize_run(
+            args.storage, run_index=args.run_index,
+            baseline_index=args.baseline, top=args.top, budget=budget)
+    except triage.MinimizeError as e:
+        raise SystemExit(f"error: {e}") from None
+    if client is not None:
+        # best-effort like every knowledge op: an outage warns once
+        # inside the client and the dossier still prints
+        client.triage_push(dossier)
+    text = (json.dumps(dossier, sort_keys=True) + "\n"
+            if args.format == "json"
+            else triage.render_dossier_md(dossier))
+    _emit(text, args.out)
+    return 0 if dossier.get("validated") or args.no_replay else 2
 
 
 def report(args) -> int:

@@ -89,6 +89,9 @@ _TELEMETRY_RE = re.compile(rf"^{API_ROOT}/telemetry$")
 _TENANCY_RE = re.compile(rf"^{API_ROOT}/tenancy$")
 _TRACES_RE = re.compile(r"^/traces(?:/([^/]+))?$")
 _CAUSALITY_RE = re.compile(r"^/causality/([^/]+)(?:/([^/]+))?$")
+# triage surface (namazu_tpu/triage): dossier list / one dossier by
+# failure signature
+_TRIAGE_RE = re.compile(r"^/triage(?:/([^/]+))?$")
 
 
 class ActionQueue:
@@ -921,7 +924,16 @@ class RestEndpoint(QueuedEndpoint):
                         400, {"error": f"bad op {ops!r}; known: "
                               f"{[o.value for o in ControlOp]}"}
                     )
-                endpoint.hub.post_control(Control(op))
+                # tenancy plane: an X-Nmz-Run header scopes the op to
+                # that namespace's publisher (one tenant's disable must
+                # never suspend a sibling's table); absent = the
+                # process-default policy, pre-tenancy behavior
+                ns = self._req_ns()
+                if ns is None:
+                    return
+                ctrl = Control(op)
+                tenancy.set_ns(ctrl, ns)
+                endpoint.hub.post_control(ctrl)
                 self._reply(200, {})
 
             def do_GET(self) -> None:
@@ -954,6 +966,9 @@ class RestEndpoint(QueuedEndpoint):
                 if m:
                     return self._get_causality(m.group(1), m.group(2),
                                                parse_qs(url.query))
+                m = _TRIAGE_RE.match(url.path)
+                if m:
+                    return self._get_triage(m.group(1))
                 m = _ACTIONS_RE.match(url.path)
                 if not (m and m.group(2) is None):
                     return self._reply(404, {"error": f"no route {url.path}"})
@@ -1105,6 +1120,32 @@ class RestEndpoint(QueuedEndpoint):
                         404, {"error": "no recorded run "
                               f"{run_a if run_b is None else (run_a, run_b)!r}"})
                 self._reply(200, payload)
+
+            def _get_triage(self, signature) -> None:
+                """Triage surface (namazu_tpu/triage): the dossier
+                summaries this process holds, or one full dossier by
+                failure signature — what ``nmz-tpu tools minimize
+                --url`` reads."""
+                try:
+                    from namazu_tpu.triage import store as triage_store
+
+                    if signature is None:
+                        return self._reply(
+                            200,
+                            {"dossiers": triage_store.summaries()})
+                    dossier = triage_store.dossier_for(signature)
+                except Exception as e:  # stats bugs must not kill ops
+                    log.exception("triage payload failed")
+                    return self._reply(
+                        500, {"error": f"triage failed: {e}"})
+                if dossier is None:
+                    return self._reply(
+                        404, {"error": "no triage dossier for "
+                              f"signature {signature!r} (minimize a "
+                              "failing run first, or pull it from the "
+                              "knowledge pool: tools minimize "
+                              "--knowledge)"})
+                self._reply(200, {"dossier": dossier})
 
             def _get_traces(self, run_id, query) -> None:
                 """Flight-recorder surface: run list, or one run as

@@ -141,6 +141,8 @@ class UdsEndpoint(QueuedEndpoint):
             return self._op_table()
         if op == "shm_open":
             return self._op_shm_open(req)
+        if op == "control":
+            return self._op_control(req)
         if op in ("lease", "renew", "release", "runs"):
             return self._op_tenancy(req)
         # observability ops (telemetry push / fleet view / local
@@ -176,6 +178,32 @@ class UdsEndpoint(QueuedEndpoint):
             return tenancy.validate_ns(raw), None
         except ValueError as e:
             return None, {"ok": False, "error": str(e)}
+
+    def _op_control(self, req: dict) -> dict:
+        """The framed face of ``POST /api/v3/control``: enable/disable
+        orchestration, scoped by the op's ``run`` field exactly like
+        the REST route's X-Nmz-Run header (a namespaced op suspends/
+        resumes that tenant's publisher only; absent = the
+        process-default policy, pre-tenancy behavior)."""
+        from namazu_tpu.signal.control import Control, ControlOp
+
+        hub = getattr(self, "hub", None)
+        if hub is None:
+            return {"ok": False, "error": "endpoint not attached to an "
+                                          "orchestrator hub"}
+        ns, err = self._req_ns(req)
+        if err is not None:
+            return err
+        try:
+            ctrl = Control(ControlOp(str(req.get("control_op") or "")))
+        except ValueError:
+            return {"ok": False,
+                    "error": f"bad control op "
+                             f"{req.get('control_op')!r}; known: "
+                             f"{[o.value for o in ControlOp]}"}
+        tenancy.set_ns(ctrl, ns)
+        hub.post_control(ctrl)
+        return {"ok": True}
 
     def _op_tenancy(self, req: dict) -> dict:
         """The framed face of the slot-leasing wire (doc/tenancy.md) —

@@ -267,6 +267,17 @@ class EdgeDispatcher:
             log.debug("edge table v%d installed (%d buckets)",
                       self._table.version, self._table.H)
             self._confirmed_mono = time.monotonic()
+            # search-install -> edge-adoption propagation (the
+            # publisher stamped its install time into the doc);
+            # negative gaps (cross-host monotonic clocks) and
+            # stamp-less docs observe nothing
+            try:
+                installed = float(doc["installed_mono"])
+            except (KeyError, TypeError, ValueError):
+                installed = None
+            if installed is not None:
+                _spans.table_propagation(
+                    time.monotonic() - installed)
             return self._table.version
 
     # -- the decision hot path -------------------------------------------

@@ -39,9 +39,11 @@ log = get_logger("knowledge.client")
 
 #: knowledge wire version, single-sourced here (the service's VERSION
 #: re-exports it): v2 = v1 + the relation-coverage fields
-#: (doc/knowledge.md). The client stamps every frame with it, so
-#: version-gating logic sees what the peer actually speaks.
-WIRE_VERSION = 2
+#: (doc/knowledge.md); v3 = v2 + the triage dossier ops
+#: (``triage_push``/``triage_pull``, doc/observability.md "Triage").
+#: The client stamps every frame with it, so version-gating logic sees
+#: what the peer actually speaks.
+WIRE_VERSION = 3
 
 
 def pairs_fingerprint(pairs) -> str:
@@ -301,6 +303,29 @@ class KnowledgeClient:
             return None
         probs = np.asarray(resp.get("probs") or [], np.float32)
         return probs if probs.shape == (feats.shape[0],) else None
+
+    def triage_push(self, dossier: dict) -> Optional[dict]:
+        """Attach one minimized-reproducer dossier (triage plane, wire
+        v3) to its failure signature; returns the service response or
+        ``None`` when degraded. Same contract as every other op: an
+        outage never raises into campaign code."""
+        if not isinstance(dossier, dict) \
+                or not dossier.get("signature"):
+            return None
+        return self._request({"op": "triage_push",
+                              "dossier": dossier})
+
+    def triage_pull(self, signature: str) -> Optional[dict]:
+        """Fetch the minimized-reproducer dossier pooled for one failure
+        signature (triage plane, wire v3). ``None`` = degraded OR no
+        dossier pooled — either way the caller minimizes locally; a
+        pre-v3 service refuses the op, which reads as an outage and
+        cools down like one."""
+        resp = self._request({"op": "triage_pull",
+                              "signature": str(signature)})
+        ok = resp is not None and resp.get("dossier") is not None
+        obs.triage_dossier_pull(ok)
+        return resp.get("dossier") if resp is not None else None
 
     def stats(self) -> Optional[dict]:
         return self._request({"op": "stats"})

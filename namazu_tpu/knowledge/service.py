@@ -136,13 +136,17 @@ class KnowledgeService:
     # v2: pool_push/pool_pull carry relation-coverage signatures
     # (guidance plane, doc/search.md) — a per-(scenario, space)
     # covered-bit set pooled by union, served back to warm-start a
-    # cold campaign's coverage frontier. v1 peers simply omit/ignore
-    # the new fields; nothing else about the framing changed. The
-    # version constant is single-sourced in knowledge/client.py so the
-    # frames the client stamps can never disagree with what the
-    # service declares.
+    # cold campaign's coverage frontier. v3: the triage plane's
+    # dossier ops (triage_push/triage_pull) — one minimized-reproducer
+    # dossier per failure signature, so every tenant that hits a
+    # signature pulls the minimization another tenant already paid
+    # for. Older peers simply omit/refuse the newer ops; nothing
+    # about the framing changed. The version constant is
+    # single-sourced in knowledge/client.py so the frames the client
+    # stamps can never disagree with what the service declares.
     VERSION = WIRE_VERSION
-    OPS = ("pool_push", "pool_pull", "surrogate_predict", "stats")
+    OPS = ("pool_push", "pool_pull", "surrogate_predict", "stats",
+           "triage_push", "triage_pull")
 
     def __init__(self, pool_dir: str, state_dir: str = ""):
         if not pool_dir:
@@ -170,9 +174,15 @@ class KnowledgeService:
         self._coverage: Dict[str, Dict[str, Any]] = {}
         # (scenario, pairs_fp, K) -> _SurrogateStore
         self._surrogates: Dict[Tuple[str, str, int], _SurrogateStore] = {}
+        # failure signature -> dossier doc (triage plane, wire v3):
+        # one minimized reproducer per signature, replaced only by a
+        # strictly smaller (fewer-flip) validated dossier
+        self._triage: Dict[str, Dict[str, Any]] = {}
         self._pushes = 0
         self._pulls = 0
         self._dedupe_hits = 0
+        self._triage_pulls = 0
+        self._triage_hits = 0
         self._load_state()
         # fleet telemetry (doc/observability.md "Fleet telemetry"): the
         # tenant/pool gauges normally refresh per request — a relay
@@ -201,6 +211,9 @@ class KnowledgeService:
 
     def _coverage_path(self) -> str:
         return os.path.join(self.state_dir, "coverage.json")
+
+    def _triage_path(self) -> str:
+        return os.path.join(self.state_dir, "triage.json")
 
     def _store_path(self, key: Tuple[str, str, int]) -> str:
         sid = hashlib.sha256(
@@ -233,6 +246,17 @@ class KnowledgeService:
         except Exception:
             log.exception("coverage state unreadable; starting with an "
                           "empty coverage set")
+        try:
+            with open(self._triage_path()) as f:
+                loaded = json.load(f)
+            self._triage = {str(sig): dict(d)
+                            for sig, d in loaded.items()
+                            if isinstance(d, dict)}
+        except FileNotFoundError:
+            pass
+        except Exception:
+            log.exception("triage dossier state unreadable; starting "
+                          "with an empty dossier set")
 
     def _save_scenarios(self) -> None:
         try:
@@ -252,6 +276,13 @@ class KnowledgeService:
                 sort_keys=True)
         except OSError:
             log.exception("could not persist pooled coverage")
+
+    def _save_triage(self) -> None:
+        try:
+            atomic_write_json(self._triage_path(), self._triage,
+                              sort_keys=True)
+        except OSError:
+            log.exception("could not persist triage dossiers")
 
     @staticmethod
     def _coverage_key(scenario: str, h: int, w: int, win: int) -> str:
@@ -304,6 +335,8 @@ class KnowledgeService:
             "pool_pull": self._pool_pull,
             "surrogate_predict": self._surrogate_predict,
             "stats": self._stats,
+            "triage_push": self._triage_push,
+            "triage_pull": self._triage_pull,
         }.get(op)
         if handler is None:
             return {"ok": False, "v": self.VERSION,
@@ -549,6 +582,52 @@ class KnowledgeService:
                 "train_rounds": store.train_rounds,
                 "_deferred": deferred}
 
+    def _triage_push(self, req: dict) -> dict:
+        """Attach one minimized-reproducer dossier to its failure
+        signature (triage plane, wire v3). Content-keyed like the pool:
+        a re-push of the same signature only replaces the stored
+        dossier when it is strictly better — validated beats
+        unvalidated, then fewer minimal flips wins — so a worse late
+        arrival can never clobber the fleet's best explanation."""
+        self._touch_tenant(req, "pushes")
+        dossier = req.get("dossier")
+        if not isinstance(dossier, dict):
+            return {"ok": False, "error": "triage_push needs a dossier"}
+        sig = str(dossier.get("signature") or "")
+        if not sig:
+            return {"ok": False,
+                    "error": "dossier has no failure signature"}
+        dossier = dict(dossier, signature=sig)
+
+        def _rank(d: dict) -> Tuple[int, float]:
+            flips = d.get("minimal_flips")
+            try:
+                flips = float(flips)
+            except (TypeError, ValueError):
+                flips = float("inf")
+            return (0 if d.get("validated") else 1, flips)
+
+        cur = self._triage.get(sig)
+        accepted = cur is None or _rank(dossier) < _rank(cur)
+        if accepted:
+            self._triage[sig] = dossier
+            self._save_triage()
+        return {"ok": True, "accepted": accepted,
+                "dossier_count": len(self._triage)}
+
+    def _triage_pull(self, req: dict) -> dict:
+        """Serve the dossier pooled for one failure signature — the
+        cross-tenant payoff: a cold tenant hitting a known signature
+        gets the minimized repro without paying for the replays."""
+        self._touch_tenant(req, "pulls")
+        self._triage_pulls += 1
+        sig = str(req.get("signature") or "")
+        dossier = self._triage.get(sig)
+        if dossier is not None:
+            self._triage_hits += 1
+        return {"ok": True, "dossier": dossier,
+                "dossier_count": len(self._triage)}
+
     def _stats(self, req: dict) -> dict:
         """Pool/tenant occupancy for dashboards and the PR 3 analytics
         plane (obs/analytics.py folds this into its payload)."""
@@ -567,6 +646,12 @@ class KnowledgeService:
             "pushes": self._pushes,
             "pulls": self._pulls,
             "dedupe_hits": self._dedupe_hits,
+            "triage": {
+                "dossiers": len(self._triage),
+                "pulls": self._triage_pulls,
+                "hits": self._triage_hits,
+                "signatures": sorted(self._triage),
+            },
             "coverage": {
                 key: {"scenario": c["scenario"], "H": c["H"],
                       "w": c["w"],

@@ -126,11 +126,16 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     rest_conn_pool,
     span,
     span_delta,
+    table_propagation,
     table_version,
     telemetry_forward_dropped,
     telemetry_push,
     transport_retry_after,
     transport_rtt,
+    triage_dossier_pull,
+    triage_minimized,
+    triage_probe,
+    triage_signatures,
     wire_bytes,
 )
 

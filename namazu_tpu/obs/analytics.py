@@ -696,6 +696,18 @@ def payload(top: int = DEFAULT_TOP,
     except Exception:
         log.warning("causality fold failed; payload served without it",
                     exc_info=True)
+    # triage fold (namazu_tpu/triage): per-signature dossier summaries —
+    # additive like the knowledge/slo/causality sections (no dossiers,
+    # no section), preserving the compute_payload parity
+    try:
+        from namazu_tpu.triage import store as _triage_store
+
+        rows = _triage_store.summaries()
+        if rows:
+            doc["triage"] = {"dossiers": rows}
+    except Exception:
+        log.warning("triage fold failed; payload served without it",
+                    exc_info=True)
     return doc
 
 

@@ -639,8 +639,14 @@ def why_payload(records_a: List[dict], records_b: List[dict],
     }
 
 
-def render_why_md(doc: Dict[str, Any]) -> str:
-    """Markdown face of a why payload (``tools why --format md``)."""
+def render_why_md(doc: Dict[str, Any], perfetto: bool = True) -> str:
+    """Markdown face of a why payload (``tools why --format md``).
+
+    ``perfetto=False`` drops the closing "export and load in
+    ui.perfetto.dev" pointer — callers rendering a payload whose runs
+    have no local recorder dump (``--url``-fetched payloads, triage
+    dossiers replayed elsewhere) must not print an export command that
+    would only say "no recorded run"."""
     diff = doc.get("diff") or {}
     run_a, run_b = doc.get("run_a", "a"), doc.get("run_b", "b")
     lines = [
@@ -689,9 +695,13 @@ def render_why_md(doc: Dict[str, Any]) -> str:
                 f"| {row.get('acyclic')} | {row.get('inversions')} "
                 f"| {cp.get('critical_stage')} "
                 f"| {cp.get('span_p99_s')}s |")
-    lines += ["",
-              "Inspect either side visually: `nmz-tpu tools trace "
-              "export <run_id> --out trace.json` and load it in "
-              "ui.perfetto.dev (tracks per entity/policy; the decision "
-              "args carry the delay and table provenance).", ""]
+    if perfetto:
+        lines += ["",
+                  "Inspect either side visually: `nmz-tpu tools trace "
+                  "export <run_id> --out trace.json` and load it in "
+                  "ui.perfetto.dev (tracks per entity/policy; the "
+                  "decision args carry the delay and table "
+                  "provenance).", ""]
+    else:
+        lines.append("")
     return "\n".join(lines)

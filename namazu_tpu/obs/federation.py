@@ -855,6 +855,16 @@ class FleetAggregator:
                     "table_version": held,
                     "table_skew": (round(fleet_version - held)
                                    if held is not None else None),
+                    # SKEW's time-domain twin: the measured publish->
+                    # edge-install propagation p99
+                    # (nmz_table_propagation_seconds, obs/spans.py)
+                    "table_propagation_p99_s": self._hist_quantile(
+                        st, spans.TABLE_PROPAGATION, 0.99),
+                    # triage plane: distinct failure signatures this
+                    # instance holds a dossier for (the tools-top SIGS
+                    # column; doc/observability.md "Triage")
+                    "triage_signatures": self._gauge_max(
+                        st, spans.TRIAGE_SIGNATURES),
                     "edge_table_staleness_s": self._gauge_max(
                         st, spans.EDGE_TABLE_STALENESS),
                     "edge_parked": self._gauge_sum(

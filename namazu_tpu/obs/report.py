@@ -206,6 +206,30 @@ def render_markdown(payload: Dict[str, Any]) -> str:
             out("- no objectives declared")
         out("")
 
+    triage = payload.get("triage")
+    if triage is not None:
+        # present only when this process holds triage dossiers
+        # (namazu_tpu/triage); omitted otherwise so dossier-less
+        # payloads render byte-identically to pre-triage reports
+        out("## Triage")
+        out("")
+        dossiers = triage.get("dossiers", [])
+        if dossiers:
+            out("| signature | run | minimal flips | candidates "
+                "| probes sim/replay | validated |")
+            out("|---|---:|---:|---:|---|---|")
+            for row in dossiers:
+                out(f"| `{row.get('signature')}` "
+                    f"| {_num(row.get('run_index'))} "
+                    f"| {_num(row.get('minimal_flips'))} "
+                    f"| {_num(row.get('candidate_flips'))} "
+                    f"| {_num(row.get('probes_simulated'))}/"
+                    f"{_num(row.get('probes_replayed'))} "
+                    f"| {_num(row.get('validated', False))} |")
+        else:
+            out("- no dossiers recorded")
+        out("")
+
     out("## Suspicious branches")
     out("")
     if suspicious:

@@ -103,6 +103,12 @@ EDGE_BACKHAUL_LAG = "nmz_edge_backhaul_lag_seconds"
 EDGE_TABLE_STALENESS = "nmz_edge_table_staleness_seconds"
 EDGE_PARKED = "nmz_edge_parked_events"
 EDGE_TABLE_VERSION_HELD = "nmz_edge_table_version"
+# search-install -> edge-decision propagation (ROADMAP item 3): the
+# TablePublisher stamps each published table with its install time and
+# every edge sync that adopts the table observes the gap (same-host
+# CLOCK_MONOTONIC) — the first-class histogram behind `tools top`'s
+# SKEW column, which shows versions-behind but not seconds-behind
+TABLE_PROPAGATION = "nmz_table_propagation_seconds"
 
 # fleet telemetry federation (doc/observability.md "Fleet telemetry"):
 # relay push outcomes (producer side), fleet occupancy (aggregator
@@ -145,6 +151,17 @@ KNOWLEDGE_SURROGATE_ROUNDS = "nmz_knowledge_surrogate_train_rounds_total"
 KNOWLEDGE_TENANTS = "nmz_knowledge_tenants"
 KNOWLEDGE_POOL = "nmz_knowledge_pool_entries"
 KNOWLEDGE_OUTAGES = "nmz_knowledge_outages_total"
+
+# triage plane (doc/observability.md "Triage"): minimization probe
+# traffic split by mode (simulated = free predicted_gain scoring,
+# replayed = real campaign-runner executions), the last minimization's
+# size ratio (minimal flips / candidate flips), dossier pulls against
+# the knowledge wire, and how many failure signatures this process
+# holds dossiers for (the /fleet SIGS column)
+TRIAGE_PROBES = "nmz_triage_probes_total"
+TRIAGE_MINIMIZATION_RATIO = "nmz_triage_minimization_ratio"
+TRIAGE_DOSSIER_PULLS = "nmz_triage_dossier_pulls_total"
+TRIAGE_SIGNATURES = "nmz_triage_signatures"
 
 # causality plane (doc/observability.md "Causality"): each event's
 # intercepted->acked span decomposed into named segments — queue (hub
@@ -1045,3 +1062,62 @@ def knowledge_outage() -> None:
         KNOWLEDGE_OUTAGES,
         "knowledge-service outages degraded to local-only search",
     ).inc()
+
+
+# -- triage plane (doc/observability.md "Triage") ------------------------
+
+def table_propagation(seconds: Optional[float]) -> None:
+    """One published table's search-install -> edge-adoption gap
+    (publisher install stamp -> edge sync, same-host CLOCK_MONOTONIC;
+    None/negative = the doc predates the stamp or crossed hosts —
+    observe nothing rather than a fake 0)."""
+    if seconds is None or seconds < 0.0 or not metrics.enabled():
+        return
+    metrics.get().histogram(
+        TABLE_PROPAGATION,
+        "delay-table search-install -> edge-decision propagation",
+    ).observe(seconds)
+
+
+def triage_probe(mode: str, n: int = 1) -> None:
+    """Minimization probes by cost class: ``simulated`` = scored free
+    through the guidance plane's predicted_gain, ``replayed`` = a real
+    campaign-runner execution."""
+    if not metrics.enabled() or n <= 0:
+        return
+    metrics.get().counter(
+        TRIAGE_PROBES, "delta-debugging minimization probes", ("mode",),
+    ).labels(mode=mode).inc(n)
+
+
+def triage_minimized(ratio: float) -> None:
+    """Size of the latest minimized reproducer relative to its
+    candidate flip set (0 = everything shed, 1 = nothing shed)."""
+    if not metrics.enabled():
+        return
+    metrics.get().gauge(
+        TRIAGE_MINIMIZATION_RATIO,
+        "latest minimization's minimal-flips / candidate-flips ratio",
+    ).set(max(0.0, min(1.0, float(ratio))))
+
+
+def triage_dossier_pull(ok: bool) -> None:
+    """One dossier fetch against the knowledge wire (v3 triage_pull);
+    ok = a dossier came back (miss and outage both count false)."""
+    if not metrics.enabled():
+        return
+    metrics.get().counter(
+        TRIAGE_DOSSIER_PULLS,
+        "triage dossier pulls against the knowledge service", ("ok",),
+    ).labels(ok=str(bool(ok)).lower()).inc()
+
+
+def triage_signatures(n: int) -> None:
+    """Distinct failure signatures this process holds dossiers for
+    (the /fleet SIGS column's source gauge)."""
+    if not metrics.enabled():
+        return
+    metrics.get().gauge(
+        TRIAGE_SIGNATURES,
+        "failure signatures with a local triage dossier",
+    ).set(n)

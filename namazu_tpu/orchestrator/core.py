@@ -622,6 +622,13 @@ class Orchestrator:
             ctrl = self.hub.control_queue.get()
             if ctrl is _STOP:
                 return
+            ns = tenancy.ns_of(ctrl)
+            if ns:
+                # a namespace-scoped op (X-Nmz-Run / framed `run`)
+                # touches exactly that tenant's serving state — the
+                # process-default flag and publisher stay untouched
+                self._control_namespace(ns, ctrl.op)
+                continue
             pub = self.hub.table_publisher
             if ctrl.op is ControlOp.ENABLE_ORCHESTRATION:
                 self.enabled = True
@@ -634,6 +641,12 @@ class Orchestrator:
                     # decisions now come from the passthrough policy
                     pub.suspend()
             log.info("orchestration enabled=%s", self.enabled)
+
+    def _control_namespace(self, ns: str, op: ControlOp) -> None:
+        """Apply one namespace-scoped control op; the base orchestrator
+        hosts no namespaces (TenantOrchestrator overrides)."""
+        log.warning("control op %s for run %r ignored: this "
+                    "orchestrator hosts no run namespaces", op.value, ns)
 
 
 class AutopilotOrchestrator(Orchestrator):

@@ -40,6 +40,7 @@ produce identical delays (the trace-differ equivalence test).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
 from namazu_tpu import obs
@@ -75,6 +76,11 @@ class TablePublisher:
             "H": int(H),
             "max_interval": float(max_interval),
             "delays": [float(x) for x in delays],
+            # install stamp for nmz_table_propagation_seconds: a
+            # same-host edge that adopts this doc observes
+            # monotonic() - installed_mono (cross-host docs skip the
+            # observation — monotonic clocks don't compare)
+            "installed_mono": time.monotonic(),
         }
         with self._lock:
             self._version += 1

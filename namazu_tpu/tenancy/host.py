@@ -287,7 +287,8 @@ class TenantOrchestrator(Orchestrator):
                 continue
             ns.events_ingested += len(sub)
             obs.tenancy_events(name, len(sub))
-            target = ns.policy if self.enabled else self.dumb
+            target = ns.policy if (self.enabled and ns.enabled) \
+                else self.dumb
             if ns.journal is not None and routes_by_ns is None:
                 # ONE route-table scan per drained batch, shared by
                 # every journaled namespace's sub-batch (not one full
@@ -338,4 +339,27 @@ class TenantOrchestrator(Orchestrator):
             run = self._namespaces.get(ns)
         return (run.policy, self.dumb) if run is not None \
             else (self.dumb,)
+
+    def _control_namespace(self, name: str, op) -> None:
+        """A namespace-scoped control op (the X-Nmz-Run header / framed
+        ``run`` field on ``control``): flip THAT tenant's orchestration
+        switch and suspend/resume ITS publisher — the process-default
+        flag, policy, and publisher stay untouched, so one tenant's
+        disable can never starve a sibling's table."""
+        from namazu_tpu.signal.control import ControlOp
+
+        with self._ns_lock:
+            ns = self._namespaces.get(name)
+        if ns is None or ns.detached:
+            log.warning("control op %s for unknown/detached run %r "
+                        "ignored", op.value, name)
+            return
+        ns.enabled = op is ControlOp.ENABLE_ORCHESTRATION
+        pub = getattr(ns.policy, "table_publisher", None)
+        if pub is not None:
+            if ns.enabled:
+                pub.resume()
+            else:
+                pub.suspend()
+        log.info("run %s orchestration enabled=%s", name, ns.enabled)
 

@@ -70,6 +70,10 @@ class RunNamespace:
         self.created_mono = time.monotonic()
         #: events ingested for this namespace (the /fleet RUN row)
         self.events_ingested = 0
+        #: per-namespace orchestration switch (a namespaced control op
+        #: flips THIS, never the host's process-default flag): False
+        #: routes the namespace's events to the passthrough policy
+        self.enabled = True
         #: set once the namespace's policy flush has fully drained
         #: through the action loop (release waits on it)
         self.flushed = threading.Event()

@@ -1,0 +1,54 @@
+"""Triage plane: auto-minimized reproducers + failure-signature dossiers.
+
+At fleet scale, *finding* a failure is no longer the bottleneck —
+*explaining* it is. Namazu's premise makes explanation tractable: the
+orchestrator owns every injected delay, so a failing run's delay table
+IS its root-cause hypothesis, and shrinking that hypothesis is
+delta debugging over schedules (the DEMi lineage, PAPERS.md). This
+package does the shrink:
+
+* :mod:`namazu_tpu.triage.minimize` — given a failing run, derive the
+  candidate ordering flips from the causality plane's
+  ``relation_flips`` divergence set, then delta-debug flip subsets
+  toward a MINIMAL table. Most probes are **free**: a candidate table's
+  realized order is simulated through the guidance plane
+  (``bucket_sequence_from_encoded`` + ``CoverageMap.predicted_gain``)
+  without executing anything; only the best-scored survivors are
+  validated by real replay through the campaign runner. The result is
+  a self-contained **dossier**: minimal table + flip set + probe
+  journal + a ``tools why`` explanation + a causality DAG slice around
+  the critical path.
+* :mod:`namazu_tpu.triage.store` — the process-local dossier registry
+  behind ``GET /triage``, the analytics TRIAGE section, and the
+  ``nmz_triage_signatures`` gauge.
+
+Dossiers travel on the knowledge wire (v3 ``triage_push`` /
+``triage_pull``, doc/knowledge.md) keyed by failure signature
+(``models/failure_pool.trace_digest``), so every tenant that hits a
+known signature pulls the minimized repro instead of re-paying the
+replays. Degradation contract matches the rest of the knowledge plane:
+outages warn once and never raise into campaign code.
+
+Surfaces: ``nmz-tpu tools minimize`` (cli/tools_cmd.py),
+``GET /triage`` + ``GET /triage/<signature>`` (endpoint/rest.py), the
+TRIAGE section of ``tools report`` / ``GET /analytics``, and the
+``nmz_triage_*`` metrics federated through ``/fleet``
+(doc/observability.md "Triage").
+"""
+
+from __future__ import annotations
+
+from namazu_tpu.triage.minimize import (  # noqa: F401
+    SCHEMA_DOSSIER,
+    MinimizeBudget,
+    MinimizeError,
+    failure_signature,
+    minimize_run,
+    render_dossier_md,
+)
+from namazu_tpu.triage.store import (  # noqa: F401
+    dossier_for,
+    record_dossier,
+    reset_store,
+    summaries,
+)

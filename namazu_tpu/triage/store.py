@@ -1,0 +1,65 @@
+"""Process-local dossier registry: the memory behind ``GET /triage``.
+
+One dict, keyed by failure signature. ``tools minimize`` records every
+dossier it produces here (when run in-process) and the REST plane
+serves it back out; the knowledge pool is the *durable*, cross-tenant
+copy — this store is just the live orchestrator's working set, the
+same split the failure pool makes between its in-memory ring and the
+knowledge wire.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from namazu_tpu import obs
+
+_lock = threading.Lock()
+_dossiers: Dict[str, Dict[str, Any]] = {}
+
+
+def record_dossier(dossier: Dict[str, Any]) -> None:
+    """Index one dossier by its failure signature (last write wins —
+    the minimizer only re-records when it found a smaller repro)."""
+    sig = str(dossier.get("signature") or "")
+    if not sig:
+        return
+    with _lock:
+        _dossiers[sig] = dict(dossier)
+        n = len(_dossiers)
+    obs.triage_signatures(n)
+
+
+def dossier_for(signature: str) -> Optional[Dict[str, Any]]:
+    with _lock:
+        doc = _dossiers.get(str(signature))
+        return dict(doc) if doc is not None else None
+
+
+def summaries() -> List[Dict[str, Any]]:
+    """One compact row per signature (the ``GET /triage`` listing and
+    the analytics TRIAGE table) — full dossiers stay behind
+    ``GET /triage/<signature>``."""
+    with _lock:
+        docs = [dict(d) for d in _dossiers.values()]
+    rows = []
+    for d in sorted(docs, key=lambda d: str(d.get("signature") or "")):
+        rows.append({
+            "signature": d.get("signature"),
+            "run_index": d.get("run_index"),
+            "minimal_flips": d.get("minimal_flips"),
+            "candidate_flips": d.get("candidate_flips"),
+            "probes_simulated": d.get("probes_simulated"),
+            "probes_replayed": d.get("probes_replayed"),
+            "minimization_ratio": d.get("minimization_ratio"),
+            "validated": bool(d.get("validated")),
+        })
+    return rows
+
+
+def reset_store() -> None:
+    """Test hook: forget every dossier."""
+    with _lock:
+        _dossiers.clear()
+    obs.triage_signatures(0)

@@ -1,0 +1,503 @@
+"""Triage plane (doc/observability.md "Triage"): delta-debugged
+minimal reproducers, failure-signature dossiers on the knowledge wire
+(v3 ``triage_push``/``triage_pull``), the ``tools minimize`` CLI, the
+``GET /triage`` routes, the analytics/report TRIAGE section, the
+fleet PROP99/SIGS columns, the ``relation_flips`` minimality-budget
+boundary, and the namespaced control-op isolation regression."""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from namazu_tpu import obs, tenancy, triage
+from namazu_tpu.obs import analytics, causality, metrics, recorder, report, spans
+from namazu_tpu.obs.metrics import MetricsRegistry
+from namazu_tpu.ops import trace_encoding as te
+from namazu_tpu.signal import PacketEvent
+from namazu_tpu.signal.action import EventAcceptanceAction
+from namazu_tpu.storage import new_storage
+from namazu_tpu.triage import store as triage_store
+from namazu_tpu.utils.trace import SingleTrace
+
+
+@pytest.fixture(autouse=True)
+def fresh(tmp_path):
+    old_reg = metrics.set_registry(MetricsRegistry())
+    metrics.configure(True)
+    old_rec = recorder.set_recorder(recorder.FlightRecorder())
+    triage_store.reset_store()
+    yield
+    triage_store.reset_store()
+    metrics.set_registry(old_reg)
+    metrics.configure(True)
+    recorder.set_recorder(old_rec)
+
+
+# -- the synthetic failing campaign --------------------------------------
+
+#: the hint the failing run's injected delay lands on; the recorded
+#: event_hint is flow-qualified by PacketEvent.create
+DELAYED = "m2"
+DELAYED_FLOW = f"a->b:{DELAYED}"
+DELAY_S = 0.05
+
+
+def _make_trace(delayed_hint=None, n=8):
+    """n events with DISTINCT hints; ``delayed_hint`` gets a triggered
+    time past its arrival (an injected delay the minimizer must
+    recover), everything else releases on arrival."""
+    t, now = SingleTrace(), 1000.0
+    for i in range(n):
+        ev = PacketEvent.create(f"n{i % 3}", "a", "b", hint=f"m{i}")
+        a = EventAcceptanceAction.for_event(ev)
+        now += 0.002
+        a.event_arrived = now
+        a.triggered_time = now + (
+            DELAY_S if f"m{i}" == delayed_hint else 0.0)
+        t.append(a)
+    return t
+
+
+def _campaign(path, with_baseline=True):
+    """A naive storage holding one passing baseline and one failing
+    run whose only divergence is the injected delay on DELAYED."""
+    st = new_storage("naive", str(path))
+    st.create()
+    if with_baseline:
+        st.create_new_working_dir()
+        st.record_new_trace(_make_trace())
+        st.record_result(True, 1.0)
+    st.create_new_working_dir()
+    st.record_new_trace(_make_trace(DELAYED))
+    st.record_result(False, 1.0)
+    st.close()
+    return str(path)
+
+
+def _bucket():
+    return te.hint_bucket(DELAYED_FLOW, te.DEFAULT_H)
+
+
+# -- the minimizer -------------------------------------------------------
+
+
+def test_minimize_recovers_injected_delay(tmp_path):
+    """The acceptance shape: a single injected delay minimizes to a
+    <=3-flip reproducer, replay-validates, and >=80% of the probes are
+    simulated (predicted_gain), not replayed."""
+    st_dir = _campaign(tmp_path / "st")
+    bx = _bucket()
+    replays = []
+
+    def replay(table):
+        replays.append(np.asarray(table).copy())
+        return table[bx] > 0  # reproduces iff the real culprit is delayed
+
+    d = triage.minimize_run(st_dir, replay=replay)
+    assert d["schema"] == triage.SCHEMA_DOSSIER
+    assert d["validated"] is True
+    assert 1 <= d["minimal_flips"] <= 3
+    assert d["minimal_flips"] < d["candidate_flips"]
+    # the minimal delay table holds the injected delay on the culprit
+    assert set(d["table"]["delays"]) == {str(bx)}
+    assert d["table"]["delays"][str(bx)] == pytest.approx(DELAY_S,
+                                                         rel=1e-3)
+    # probe economics: simulation does the bisection, replay only
+    # validates the survivor
+    total = d["probes_simulated"] + d["probes_replayed"]
+    assert d["probes_simulated"] >= 0.8 * total
+    assert d["probes_replayed"] == len(replays) >= 1
+    assert 0.0 <= d["minimization_ratio"] <= 1.0
+    # every probe is journaled with its cost class
+    modes = {j["mode"] for j in d["journal"]}
+    assert modes == {"simulated", "replayed"}
+    # the embedded why payload and the DAG slice around the flips
+    assert d["why"]["schema"] == causality.SCHEMA_WHY
+    assert d["why"]["diff"]["flips_minimal"] >= 1
+    assert d["dag_slice"]["around_flips"]
+    flip = d["flips"][0]
+    assert DELAYED_FLOW in flip["first"] + flip["then"]
+    # probe metrics flowed to the registry
+    reg = metrics.registry()
+    sim = reg.sample(spans.TRIAGE_PROBES, mode="simulated")
+    rep = reg.sample(spans.TRIAGE_PROBES, mode="replayed")
+    assert sim.value == d["probes_simulated"]
+    assert rep.value == d["probes_replayed"]
+
+
+def test_minimize_unvalidated_without_replay(tmp_path):
+    st_dir = _campaign(tmp_path / "st")
+    d = triage.minimize_run(
+        st_dir, budget=triage.MinimizeBudget(max_replays=0))
+    assert d["validated"] is False
+    assert d["probes_replayed"] == 0
+    assert d["probes_simulated"] > 0
+    assert 1 <= d["minimal_flips"] <= 3
+
+
+def test_minimize_synthesizes_baseline_when_none_passed(tmp_path):
+    """No passing run recorded: the minimizer diffs against the
+    zero-delay synthetic baseline and still isolates the culprit."""
+    st_dir = _campaign(tmp_path / "st", with_baseline=False)
+    d = triage.minimize_run(
+        st_dir, budget=triage.MinimizeBudget(max_replays=0))
+    assert d["baseline_index"] is None
+    assert str(_bucket()) in d["table"]["delays"]
+
+
+def test_minimize_requires_a_failure(tmp_path):
+    st = new_storage("naive", str(tmp_path / "ok"))
+    st.create()
+    st.create_new_working_dir()
+    st.record_new_trace(_make_trace())
+    st.record_result(True, 1.0)
+    st.close()
+    with pytest.raises(triage.MinimizeError):
+        triage.minimize_run(str(tmp_path / "ok"))
+
+
+def test_failure_signature_stable(tmp_path):
+    st_dir = _campaign(tmp_path / "st")
+    sig = triage.failure_signature(st_dir)
+    assert sig == triage.failure_signature(st_dir)
+    d = triage.minimize_run(
+        st_dir, budget=triage.MinimizeBudget(max_replays=0))
+    assert d["signature"] == sig
+
+
+def test_render_dossier_md_sections(tmp_path):
+    st_dir = _campaign(tmp_path / "st")
+    d = triage.minimize_run(st_dir, replay=lambda t: t[_bucket()] > 0)
+    md = triage.render_dossier_md(d)
+    assert f"Triage dossier `{d['signature']}`" in md
+    assert "Minimal ordering flips" in md
+    assert "Minimal delay table" in md
+    assert "replay-validated" in md
+    assert str(_bucket()) in md
+    # the embedded tools-why explanation rides along
+    assert "Minimal ordering flips" in md
+
+
+# -- store + analytics + report + REST -----------------------------------
+
+
+def test_dossier_store_and_analytics_fold(tmp_path):
+    st_dir = _campaign(tmp_path / "st")
+    d = triage.minimize_run(
+        st_dir, budget=triage.MinimizeBudget(max_replays=0))
+    rows = triage_store.summaries()
+    assert len(rows) == 1 and rows[0]["signature"] == d["signature"]
+    assert rows[0]["minimal_flips"] == d["minimal_flips"]
+    assert triage_store.dossier_for(d["signature"])["flips"] == d["flips"]
+    assert triage_store.dossier_for("nope") is None
+    # the SIGS gauge tracks distinct signatures held
+    assert metrics.registry().sample(
+        spans.TRIAGE_SIGNATURES).value == 1.0
+    # analytics folds the summaries in additively; report renders them
+    doc = analytics.payload()
+    assert doc["triage"]["dossiers"] == rows
+    md = report.render_markdown(doc)
+    assert "## Triage" in md and d["signature"] in md
+    # ... and the fold vanishes with the store (payload parity)
+    triage_store.reset_store()
+    assert "triage" not in analytics.payload()
+
+
+def test_rest_triage_routes(tmp_path):
+    from namazu_tpu.endpoint.hub import EndpointHub
+    from namazu_tpu.endpoint.rest import RestEndpoint
+
+    st_dir = _campaign(tmp_path / "st")
+    d = triage.minimize_run(
+        st_dir, budget=triage.MinimizeBudget(max_replays=0))
+    hub = EndpointHub()
+    ep = RestEndpoint(port=0)
+    hub.add_endpoint(ep)
+    hub.start()
+    try:
+        base = f"http://127.0.0.1:{ep.port}"
+        with urllib.request.urlopen(f"{base}/triage", timeout=10) as r:
+            listing = json.loads(r.read())
+        assert [row["signature"] for row in listing["dossiers"]] \
+            == [d["signature"]]
+        with urllib.request.urlopen(
+                f"{base}/triage/{d['signature']}", timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["dossier"]["schema"] == triage.SCHEMA_DOSSIER
+        assert doc["dossier"]["minimal_flips"] == d["minimal_flips"]
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/triage/nope", timeout=10)
+        assert exc.value.code == 404
+    finally:
+        hub.shutdown()
+
+
+def test_cli_minimize_json_and_md(tmp_path, capsys):
+    from namazu_tpu.cli import cli_main
+
+    st_dir = _campaign(tmp_path / "st")
+    out = tmp_path / "dossier.json"
+    assert cli_main(["tools", "minimize", st_dir, "--no-replay",
+                     "--format", "json", "--out", str(out)]) == 0
+    d = json.loads(out.read_text())
+    assert d["schema"] == triage.SCHEMA_DOSSIER
+    assert 1 <= d["minimal_flips"] <= 3
+    capsys.readouterr()
+    assert cli_main(["tools", "minimize", st_dir, "--no-replay"]) == 0
+    md = capsys.readouterr().out
+    assert "Triage dossier" in md and "Minimal delay table" in md
+
+
+# -- the knowledge wire (v3 triage ops) ----------------------------------
+
+
+def _served(tmp_path):
+    from namazu_tpu.knowledge import KnowledgeClient, KnowledgeService
+    from namazu_tpu.sidecar import SidecarServer
+
+    svc = KnowledgeService(str(tmp_path / "pool"))
+    srv = SidecarServer(port=0, knowledge=svc)
+    srv.start()
+    return srv, svc
+
+
+def test_triage_wire_serves_cold_tenant(tmp_path):
+    """The cross-tenant payoff: tenant t1 pays the minimization once,
+    a COLD tenant t2 pulls the dossier by failure signature instead of
+    re-paying the replays (counters asserted on the service)."""
+    from namazu_tpu.knowledge import KnowledgeClient
+
+    st_dir = _campaign(tmp_path / "st")
+    d = triage.minimize_run(st_dir, replay=lambda t: t[_bucket()] > 0)
+    srv, svc = _served(tmp_path)
+    c1 = KnowledgeClient(f"127.0.0.1:{srv.port}", tenant="t1",
+                         scenario="s", cooldown_s=0.0)
+    c2 = KnowledgeClient(f"127.0.0.1:{srv.port}", tenant="t2-cold",
+                         scenario="s", cooldown_s=0.0)
+    try:
+        # a miss before any push: None, counted as a pull without a hit
+        assert c2.triage_pull(d["signature"]) is None
+        r = c1.triage_push(d)
+        assert r and r.get("ok")
+        pulled = c2.triage_pull(d["signature"])
+        assert pulled is not None
+        assert pulled["signature"] == d["signature"]
+        assert pulled["flips"] == d["flips"]
+        assert pulled["table"]["delays"] == {
+            k: pytest.approx(v) for k, v in d["table"]["delays"].items()}
+        stats = c1.stats()["triage"]
+        assert stats["dossiers"] == 1
+        assert stats["pulls"] == 2 and stats["hits"] == 1
+        assert stats["signatures"] == [d["signature"]]
+        # a WORSE late arrival (unvalidated, more flips) never clobbers
+        worse = dict(d, validated=False,
+                     minimal_flips=d["minimal_flips"] + 4)
+        c1.triage_push(worse)
+        again = c2.triage_pull(d["signature"])
+        assert again["validated"] is True
+        assert again["minimal_flips"] == d["minimal_flips"]
+        # the pull outcome metric counted the miss and the hits
+        reg = metrics.registry()
+        assert reg.sample(spans.TRIAGE_DOSSIER_PULLS,
+                          ok="true").value == 2.0
+        assert reg.sample(spans.TRIAGE_DOSSIER_PULLS,
+                          ok="false").value == 1.0
+    finally:
+        c1.close()
+        c2.close()
+        srv.shutdown()
+
+
+def test_triage_push_rejects_signatureless(tmp_path):
+    srv, _ = _served(tmp_path)
+    from namazu_tpu.knowledge import KnowledgeClient
+
+    client = KnowledgeClient(f"127.0.0.1:{srv.port}", tenant="t1",
+                             scenario="s", cooldown_s=0.0)
+    try:
+        assert client.triage_push({"no": "signature"}) is None
+        r = client._request({"op": "triage_push", "dossier": {}})
+        assert r is None or not r.get("ok", True)
+    finally:
+        client.close()
+        srv.shutdown()
+
+
+def test_triage_pull_degrades_to_none():
+    """Nobody listening: the degradation contract — None, no raise."""
+    from namazu_tpu.knowledge import KnowledgeClient
+
+    client = KnowledgeClient("127.0.0.1:1", tenant="t1", scenario="s",
+                             cooldown_s=0.0, timeout=0.2)
+    try:
+        assert client.triage_pull("sig") is None
+        assert client.triage_push({"signature": "sig"}) is None
+    finally:
+        client.close()
+
+
+def test_triage_dossiers_survive_service_restart(tmp_path):
+    from namazu_tpu.knowledge import KnowledgeService
+
+    svc = KnowledgeService(str(tmp_path / "pool"))
+    resp = svc.handle({"op": "triage_push", "tenant": "t1",
+                       "dossier": {"signature": "cafe", "minimal_flips": 1,
+                                   "validated": True}})
+    assert resp.get("ok")
+    svc.close()
+    svc2 = KnowledgeService(str(tmp_path / "pool"))
+    got = svc2.handle({"op": "triage_pull", "tenant": "t2",
+                       "signature": "cafe"})
+    assert got["dossier"]["minimal_flips"] == 1
+    svc2.close()
+
+
+# -- fleet surface: PROP99 + SIGS columns --------------------------------
+
+
+def test_fleet_propagation_and_sigs_columns():
+    from namazu_tpu.cli.tools_cmd import render_top
+    from namazu_tpu.obs import federation
+
+    reg = metrics.registry()
+    spans.table_propagation(0.25)
+    spans.table_propagation(0.02)
+    obs.triage_signatures(3)
+    agg = federation.FleetAggregator()
+    federation.TelemetryRelay("orchestrator", instance="i1",
+                              push=agg.note_push, registry=reg).flush()
+    row = agg.payload()["instances"][0]
+    assert row["table_propagation_p99_s"] is not None
+    assert row["table_propagation_p99_s"] >= 0.25
+    assert row["triage_signatures"] == 3
+    text = render_top(agg.payload())
+    assert "PROP99" in text and "SIGS" in text and "3" in text
+
+
+# -- relation_flips minimality budget (satellite) ------------------------
+
+
+def _perm_docs(perm):
+    """docs_a in identity order, docs_b realizing ``perm`` — the
+    inversion count of ``perm`` is exactly the inverted-pair count."""
+    n = len(perm)
+    docs_a = [{"entity": "e", "event_class": "c", "hint": f"h{i:03d}",
+               "t": {"dispatched": 1.0 + i}} for i in range(n)]
+    pos = {v: i for i, v in enumerate(perm)}
+    docs_b = [{"entity": "e", "event_class": "c", "hint": f"h{i:03d}",
+               "t": {"dispatched": 1.0 + pos[i]}} for i in range(n)]
+    return docs_a, docs_b
+
+
+def _perm_with_inversions(extra_swaps):
+    """130 elements: the first 64 reversed (64*63/2 = 2016 inversions)
+    plus ``extra_swaps`` disjoint adjacent swaps in the tail."""
+    assert extra_swaps <= 33
+    perm = list(range(63, -1, -1)) + list(range(64, 130))
+    for m in range(extra_swaps):
+        i = 64 + 2 * m
+        perm[i], perm[i + 1] = perm[i + 1], perm[i]
+    return perm
+
+
+def test_relation_flips_minimality_budget_boundary():
+    """``minimality_bounded`` flips strictly PAST the budget: 2047 and
+    exactly-2048 inverted pairs reduce exhaustively, 2049 bounds."""
+    for swaps, want_pairs, want_bounded in ((31, 2047, False),
+                                            (32, 2048, False),
+                                            (33, 2049, True)):
+        docs_a, docs_b = _perm_docs(_perm_with_inversions(swaps))
+        diff = causality.relation_flips(docs_a, docs_b)
+        assert diff["inverted_pairs"] == want_pairs, swaps
+        assert diff["minimality_bounded"] is want_bounded, swaps
+        assert diff["flips_minimal"] >= 1
+        # the budget never hides the tail swaps' minimal flips count
+        # being a reduction: bounded or not, flips are score-sorted
+        scores = [f["score"] for f in diff["flips"]]
+        assert scores == sorted(scores, reverse=True)
+
+
+def test_relation_flips_bounded_reduction_is_stable():
+    """Past the budget the top-scored reduction must be deterministic:
+    two passes over the same pair give identical flips."""
+    docs_a, docs_b = _perm_docs(_perm_with_inversions(33))
+    d1 = causality.relation_flips(docs_a, docs_b)
+    d2 = causality.relation_flips(docs_a, docs_b)
+    assert d1["minimality_bounded"] is True
+    assert d1["flips"] == d2["flips"]
+    assert d1["inverted_pairs"] == d2["inverted_pairs"]
+
+
+# -- namespaced control ops (satellite regression) -----------------------
+
+
+def test_namespaced_control_cannot_touch_siblings(tmp_path):
+    """PR 13 follow-up pin: disable scoped by X-Nmz-Run suspends THAT
+    tenant only — the sibling namespace and the process default keep
+    orchestrating."""
+    from namazu_tpu.policy import create_policy
+    from namazu_tpu.tenancy.client import TenancyClient
+    from namazu_tpu.tenancy.host import TenantOrchestrator
+    from namazu_tpu.utils.config import Config
+
+    pparam = {"seed": 7, "min_interval": "0ms", "max_interval": "0ms",
+              "fault_action_probability": 0.0,
+              "shell_action_interval": 0}
+    cfg = Config({"rest_port": 0,
+                  "uds_path": str(tmp_path / "endpoint.sock"),
+                  "run_id": "host-default", "explore_policy": "random",
+                  "explore_policy_param": pparam})
+    policy = create_policy("random")
+    policy.load_config(cfg)
+    host = TenantOrchestrator(cfg, policy, collect_trace=True)
+    host.start()
+    try:
+        base = f"http://127.0.0.1:{host.hub.endpoint('rest').port}"
+        cli = TenancyClient(base)
+        for run in ("exp-a", "exp-b"):
+            cli.lease(run, ttl_s=30, policy_param=pparam)
+
+        def control(op, run=""):
+            req = urllib.request.Request(
+                f"{base}/api/v3/control?op={op}", data=b"",
+                headers={tenancy.RUN_HEADER: run} if run else {},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 200
+
+        def ns(name):
+            with host._ns_lock:
+                return host._namespaces[name]
+
+        control("disableOrchestration", run="exp-a")
+        deadline = time.monotonic() + 5.0
+        while ns("exp-a").enabled and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert ns("exp-a").enabled is False
+        # the sibling and the process default are untouched
+        assert ns("exp-b").enabled is True
+        assert host.enabled is True
+        control("enableOrchestration", run="exp-a")
+        deadline = time.monotonic() + 5.0
+        while not ns("exp-a").enabled and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert ns("exp-a").enabled is True
+        assert host.enabled is True
+        # an UNSCOPED disable still flips the process default
+        control("disableOrchestration")
+        deadline = time.monotonic() + 5.0
+        while host.enabled and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert host.enabled is False
+        # ... without marking any namespace disabled
+        assert ns("exp-a").enabled is True
+        assert ns("exp-b").enabled is True
+        control("enableOrchestration")
+    finally:
+        host.shutdown()
