@@ -24,6 +24,14 @@ import time
 ROUNDS = 150
 DEADLINE_S = 8.0
 
+# the create->write preemption window, in busy-loop iterations — the
+# scenario's one timing knob, calibrated (not hand-tuned) into the
+# 2-10% baseline-repro band by `nmz-tpu tools calibrate`: the value
+# rides in from calibration.json as environment (NMZ_CALIB_<NAME>,
+# namazu_tpu/calibrate), [calibration] table in ../config.toml
+INIT_WINDOW_ITERS = int(os.environ.get("NMZ_CALIB_INIT_WINDOW_ITERS",
+                                       "400"))
+
 
 def writer(path: str, ack: str) -> None:
     for _ in range(ROUNDS):
@@ -31,7 +39,7 @@ def writer(path: str, ack: str) -> None:
         fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o644)
         # ... the preemption window: some "initialization work" ...
         x = 0
-        for i in range(400):
+        for i in range(INIT_WINDOW_ITERS):
             x += i * i
         # phase 2: fill in the content
         os.write(fd, b"ready=1 checksum=%d\n" % (x % 997))

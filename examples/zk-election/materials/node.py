@@ -15,26 +15,25 @@ Usage: node.py SID ZXID LISTEN_PORT OUT_FILE PEER[,PEER...]
        PEER = sid:host:port  (proxy-side address of that peer's listener)
 """
 
+import os
 import socket
 import struct
 import sys
 import threading
 import time
 
-# Must exceed start stagger + uninspected RTTs. Also calibrated to
-# exceed the policies' max single-message delay (400 ms): one delayed
-# notification can no longer starve a decider directly, so reproducing
-# the election race requires compounding effects across messages --
-# stream desynchronization from reordered link traffic forcing
-# reconnect/resend cycles, the same connection-churn mechanism behind
-# the real ZOOKEEPER-2212. That keeps the random policy's repro rate in
-# the reference's "rare" regime (its ZK-2212 row: 0% traditional /
-# 21.8% namazu, README.md:43) instead of the ~60% a shorter window
-# drifts to on a fast machine. At 0.42 s a direct starve needs >335 ms
-# on BOTH zk3 links at once (P ~ 3% for U[0,400] draws), so random
-# lands in the rare-repro regime while a searched table still has
-# deterministic room.
-DECISION_WINDOW_S = 0.42
+# The decision window, in milliseconds — the scenario's one timing
+# knob. Must exceed start stagger + uninspected RTTs; a LONGER window
+# makes a direct starve rarer (a delayed notification must outlast it),
+# so the knob's direction is "down": shrinking it raises the random
+# baseline's repro rate. The value is CALIBRATED, not hand-tuned: it
+# rides in from calibration.json as $NMZ_CALIB_DECISION_WINDOW_MS
+# (namazu_tpu/calibrate; [calibration] table in ../config.toml), landing
+# the random policy in the reference's rare-repro band (its ZK-2212
+# row: 0% traditional / 21.8% namazu, README.md:43) where a searched
+# table still has deterministic room.
+DECISION_WINDOW_S = float(os.environ.get("NMZ_CALIB_DECISION_WINDOW_MS",
+                                         "420")) / 1000.0
 STATE_LOOKING = 0
 QUORUM = 2
 

@@ -1,0 +1,31 @@
+"""Calibration plane: land every scenario's random-baseline repro rate
+in the band where search pays (doc/observability.md "Calibration &
+progress").
+
+* :mod:`namazu_tpu.calibrate.artifact` — the ``calibration.json``
+  contract (``nmz-calib-v1``): knob values as provenance, the probe
+  journal, the sequential-vs-fixed-N budget ledger, and the
+  ``NMZ_CALIB_*`` environment transport every experiment script reads;
+* :mod:`namazu_tpu.calibrate.harness` — the ``tools calibrate`` sweep:
+  per-probe supervised campaigns early-stopped by the band SPRT
+  (obs/stats.py), log-space bisection over the declared knob axis.
+
+Only the artifact module is imported eagerly — the harness pulls in the
+campaign supervisor, which ``run``-path consumers (cli/run_cmd.py) must
+not pay for just to read an artifact.
+"""
+
+from namazu_tpu.calibrate.artifact import (  # noqa: F401
+    ARTIFACT_NAME,
+    ENV_PREFIX,
+    SCHEMA,
+    env_name,
+    knob_env,
+    load_calibration,
+    validate,
+)
+
+__all__ = [
+    "ARTIFACT_NAME", "ENV_PREFIX", "SCHEMA",
+    "env_name", "knob_env", "load_calibration", "validate",
+]

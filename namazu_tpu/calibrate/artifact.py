@@ -1,0 +1,106 @@
+"""The calibration artifact: ``calibration.json`` (``nmz-calib-v1``).
+
+One document is the whole contract between the calibration harness
+(calibrate/harness.py) and every consumer of a calibrated scenario:
+
+* ``tools calibrate`` writes it into the example dir (crash-safe: the
+  probe journal is atomically rewritten after every probe, so a killed
+  sweep leaves a readable ``status: "in_progress"`` document, never a
+  torn file);
+* ``init`` copies it beside the config into the storage dir;
+* ``run`` exports its knob values as ``NMZ_CALIB_<NAME>`` environment
+  to every experiment script (utils/cmd.py ``CmdFactory.extra_env``) —
+  calibrated timing is PROVENANCE carried by the artifact, never an
+  edited source constant;
+* the progress surface (obs/analytics.progress_stats) reads its band
+  so the live verdict is judged against the calibrated regime;
+* the A/B gates read its measured rate + CI instead of magic numbers.
+
+Top-level fields: ``schema``, ``example``, ``status`` ("calibrated" /
+"in_progress" / "failed"), ``band``, ``alpha``/``beta``/
+``max_runs_per_probe`` (the per-probe BandSPRT parameters), ``seed``,
+``knobs`` (name -> calibrated value), the landed probe's ``rate`` /
+``rate_ci95`` / ``runs`` / ``failures`` / ``verdict`` / ``decided_by``,
+the full ``probes`` journal, and the budget ledger: ``runs_spent``
+(all probes), ``fixed_n_equivalent`` (probes x the fixed-sample size of
+equal discriminating power — ``runs_for_ci_width`` at the band's
+geometric midpoint for the band's width), ``runs_saved``,
+``runs_saved_pct``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("calibrate.artifact")
+
+SCHEMA = "nmz-calib-v1"
+ARTIFACT_NAME = "calibration.json"
+
+#: the environment-variable prefix knob values ride into experiment
+#: scripts on (``NMZ_CALIB_<NAME_UPPER>``)
+ENV_PREFIX = "NMZ_CALIB_"
+
+
+def env_name(knob_name: str) -> str:
+    """The environment variable carrying one knob's calibrated value."""
+    return ENV_PREFIX + knob_name.upper()
+
+
+def knob_env(calib: Dict[str, Any]) -> Dict[str, str]:
+    """The artifact's knob values as the ``NMZ_CALIB_*`` environment
+    block experiment scripts read (integral floats render as integers —
+    a shell script comparing ``$NMZ_CALIB_ROUNDS`` wants ``400``, not
+    ``400.0``)."""
+    out: Dict[str, str] = {}
+    for name, value in (calib.get("knobs") or {}).items():
+        if isinstance(value, float) and value == int(value):
+            value = int(value)
+        out[env_name(str(name))] = str(value)
+    return out
+
+
+def validate(calib: Any) -> Optional[str]:
+    """None when ``calib`` is a usable artifact, else what is wrong."""
+    if not isinstance(calib, dict):
+        return "not a JSON object"
+    if calib.get("schema") != SCHEMA:
+        return (f"schema {calib.get('schema')!r} is not {SCHEMA!r}")
+    knobs = calib.get("knobs")
+    if not isinstance(knobs, dict) or not knobs:
+        return "no knobs"
+    for name, value in knobs.items():
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            return f"knob {name!r} value {value!r} is not a number"
+    band = calib.get("band")
+    if (not isinstance(band, (list, tuple)) or len(band) != 2
+            or not all(isinstance(b, (int, float)) for b in band)):
+        return f"band {band!r} is not [lo, hi]"
+    return None
+
+
+def load_calibration(path_or_dir: str) -> Optional[Dict[str, Any]]:
+    """Read an artifact from a file path or a directory holding
+    ``calibration.json``. None when absent; a present-but-unusable
+    artifact is logged and ignored (a torn or foreign file must degrade
+    a run to its uncalibrated defaults, not kill it)."""
+    path = path_or_dir
+    if os.path.isdir(path):
+        path = os.path.join(path, ARTIFACT_NAME)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            calib = json.load(f)
+    except (OSError, ValueError) as e:
+        log.warning("unreadable calibration artifact %s: %s", path, e)
+        return None
+    problem = validate(calib)
+    if problem is not None:
+        log.warning("ignoring calibration artifact %s: %s", path, problem)
+        return None
+    return calib

@@ -1,0 +1,418 @@
+"""The calibration harness: sweep an example's timing knobs until the
+random-policy baseline repro rate lands in the target band.
+
+RESULTS.md's cross-scenario finding is the motivation: searched
+schedules pay ~15x where the random baseline's repro rate is RARE
+(the 2-10% band) and lose where random trivially repros — so a
+scenario's value depends on timing constants nobody wants to hand-tune
+(the zk-election decision window was hand-calibrated across four
+commits). ``nmz-tpu tools calibrate <example>`` automates that search:
+
+* the example declares its knobs in a ``[calibration]`` config table
+  (``[[calibration.knob]]``: name, min, max, direction) — see
+  examples/template/config.toml;
+* each probe point runs a short supervised campaign
+  (namazu_tpu/campaign.py) with the knob candidates exported as
+  ``NMZ_CALIB_<NAME>`` environment, feeding every run outcome into a
+  :class:`~namazu_tpu.obs.stats.BandSPRT`; the campaign early-stops the
+  moment the SPRT concludes (the ``on_slot`` hook), so cheap verdicts
+  ("this knob value trivially repros") cost ~10 runs, not the full cap;
+* the sweep walks ONE shared effort axis ``e in [0, 1]`` mapped through
+  each knob's range in log space (``direction = "up"``: a larger value
+  means more contention, a higher repro rate; ``"down"``: smaller means
+  higher) — probe the midpoint first, jump coarse to the indicated
+  endpoint when the midpoint is out of band, then bisect the bracketing
+  interval. **Monotone assumption**, documented and load-bearing: the
+  repro rate is assumed monotone in the effort axis; a non-monotone
+  knob (a resonance window) can defeat the bisection, which is why the
+  artifact journals every probe — a failed sweep shows its work;
+* after every probe the artifact (calibrate/artifact.py) is atomically
+  rewritten with ``status: "in_progress"`` — a killed sweep leaves a
+  readable journal, and rerunning resumes from scratch deterministically
+  (same seed, same probes).
+
+The budget ledger in the artifact compares ``runs_spent`` against
+``fixed_n_equivalent``: probes x :func:`~namazu_tpu.obs.stats.
+runs_for_ci_width` at the band's geometric midpoint for the band's
+width — the fixed-sample size a test of the same discriminating power
+would burn per probe. The SPRT's early stopping is what makes
+calibration affordable; CI asserts the savings stay >= 30%.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import shutil
+import sys
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from namazu_tpu.calibrate import artifact
+from namazu_tpu.obs import stats
+from namazu_tpu.utils.atomic import atomic_write_json
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("calibrate.harness")
+
+#: probe-count cap: bisection over a 1-D effort axis converges in
+#: log2(range resolution) steps; 8 probes resolve the axis to 1/64
+DEFAULT_MAX_PROBES = 8
+#: per-probe run cap (the BandSPRT's point-estimate fallback budget)
+DEFAULT_MAX_RUNS = 40
+
+
+class CalibrationError(Exception):
+    pass
+
+
+@dataclass
+class KnobSpec:
+    """One tunable timing knob from ``[[calibration.knob]]``."""
+
+    name: str
+    lo: float
+    hi: float
+    #: "up" = a larger value raises the repro rate (a wider preemption
+    #: window), "down" = a smaller value raises it (a tighter decision
+    #: deadline)
+    direction: str = "up"
+    #: render calibrated values as integers (iteration counts, ms)
+    integer: bool = True
+
+    def __post_init__(self) -> None:
+        if not (0 < self.lo < self.hi):
+            raise CalibrationError(
+                f"knob {self.name!r} needs 0 < min < max, got "
+                f"[{self.lo}, {self.hi}]")
+        if self.direction not in ("up", "down"):
+            raise CalibrationError(
+                f"knob {self.name!r} direction must be 'up' or 'down', "
+                f"got {self.direction!r}")
+
+    def value_at(self, effort: float):
+        """The knob value at effort ``e in [0, 1]`` (0 = lowest
+        expected repro rate, 1 = highest), interpolated in log space."""
+        e = min(1.0, max(0.0, effort))
+        if self.direction == "down":
+            e = 1.0 - e
+        v = math.exp(math.log(self.lo)
+                     + e * (math.log(self.hi) - math.log(self.lo)))
+        return int(round(v)) if self.integer else round(v, 6)
+
+
+@dataclass
+class CalibrationSpec:
+    """Everything the ``[calibration]`` config table declares."""
+
+    knobs: List[KnobSpec]
+    band: Tuple[float, float] = stats.DEFAULT_BAND
+    alpha: float = stats.DEFAULT_ALPHA
+    beta: float = stats.DEFAULT_BETA
+    max_runs_per_probe: int = DEFAULT_MAX_RUNS
+    max_probes: int = DEFAULT_MAX_PROBES
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+def parse_calibration(cfg) -> CalibrationSpec:
+    """The example config's ``[calibration]`` table as a spec
+    (raises :class:`CalibrationError` when absent or malformed)."""
+    table = cfg.get("calibration")
+    if not isinstance(table, dict):
+        raise CalibrationError(
+            "the config declares no [calibration] table; add one with "
+            "[[calibration.knob]] entries (see examples/template)")
+    raw_knobs = table.get("knob") or []
+    if not isinstance(raw_knobs, list) or not raw_knobs:
+        raise CalibrationError(
+            "[calibration] declares no [[calibration.knob]] entries")
+    knobs = []
+    for raw in raw_knobs:
+        try:
+            knobs.append(KnobSpec(
+                name=str(raw["name"]),
+                lo=float(raw["min"]), hi=float(raw["max"]),
+                direction=str(raw.get("direction", "up")),
+                integer=bool(raw.get("integer", True))))
+        except KeyError as e:
+            raise CalibrationError(
+                f"[[calibration.knob]] entry missing {e}") from None
+    band = table.get("band") or list(stats.DEFAULT_BAND)
+    if len(band) != 2 or not (0.0 < band[0] < band[1] < 1.0):
+        raise CalibrationError(f"bad calibration band {band!r}")
+    return CalibrationSpec(
+        knobs=knobs,
+        band=(float(band[0]), float(band[1])),
+        alpha=float(table.get("alpha", stats.DEFAULT_ALPHA)),
+        beta=float(table.get("beta", stats.DEFAULT_BETA)),
+        max_runs_per_probe=int(table.get("max_runs_per_probe",
+                                         DEFAULT_MAX_RUNS)),
+        max_probes=int(table.get("max_probes", DEFAULT_MAX_PROBES)))
+
+
+#: a probe runner feeds one probe's run outcomes into the given
+#: BandSPRT (stopping when its verdict lands or the budget is gone)
+ProbeRunner = Callable[[Dict[str, Any], "stats.BandSPRT"], None]
+
+
+class Calibrator:
+    """One calibration sweep over one example's knob axis."""
+
+    def __init__(self, spec: CalibrationSpec, runner: ProbeRunner,
+                 example: str = "", seed: Optional[int] = None,
+                 out_path: str = ""):
+        self.spec = spec
+        self.runner = runner
+        self.example = example
+        self.seed = seed
+        self.out_path = out_path
+        self.probes: List[Dict[str, Any]] = []
+        self.runs_spent = 0
+
+    # -- the artifact ----------------------------------------------------
+
+    def _fixed_n_equivalent(self) -> int:
+        """Per-probe fixed-sample budget of equal discriminating power:
+        the runs a target-CI-width test at the band's geometric midpoint
+        would burn without sequential stopping."""
+        lo, hi = self.spec.band
+        per_probe = stats.runs_for_ci_width(math.sqrt(lo * hi),
+                                            width=hi - lo)
+        return (per_probe or self.spec.max_runs_per_probe) \
+            * max(1, len(self.probes))
+
+    def _doc(self, status: str,
+             landed: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        fixed_n = self._fixed_n_equivalent()
+        saved = max(0, fixed_n - self.runs_spent)
+        doc: Dict[str, Any] = {
+            "schema": artifact.SCHEMA,
+            "example": self.example,
+            "status": status,
+            "band": [self.spec.band[0], self.spec.band[1]],
+            "alpha": self.spec.alpha,
+            "beta": self.spec.beta,
+            "max_runs_per_probe": self.spec.max_runs_per_probe,
+            "seed": self.seed,
+            "knobs": (landed or {}).get("knobs") or {},
+            "rate": (landed or {}).get("rate"),
+            "rate_ci95": (landed or {}).get("rate_ci95"),
+            "runs": (landed or {}).get("runs"),
+            "failures": (landed or {}).get("failures"),
+            "verdict": (landed or {}).get("verdict"),
+            "decided_by": (landed or {}).get("decided_by"),
+            "probes": self.probes,
+            "runs_spent": self.runs_spent,
+            "fixed_n_equivalent": fixed_n,
+            "runs_saved": saved,
+            "runs_saved_pct": (round(100.0 * saved / fixed_n, 1)
+                               if fixed_n else 0.0),
+        }
+        return doc
+
+    def _journal(self, status: str,
+                 landed: Optional[Dict[str, Any]] = None) -> None:
+        if self.out_path:
+            atomic_write_json(self.out_path, self._doc(status, landed),
+                              indent=2, sort_keys=True)
+
+    # -- probing ---------------------------------------------------------
+
+    def _values_at(self, effort: float) -> Dict[str, Any]:
+        return {k.name: k.value_at(effort) for k in self.spec.knobs}
+
+    def _probe(self, effort: float) -> Dict[str, Any]:
+        values = self._values_at(effort)
+        sprt = stats.BandSPRT(lo=self.spec.band[0], hi=self.spec.band[1],
+                              alpha=self.spec.alpha, beta=self.spec.beta,
+                              max_runs=self.spec.max_runs_per_probe)
+        log.info("probe %d: effort %.3f -> %s", len(self.probes) + 1,
+                 effort, values)
+        self.runner(values, sprt)
+        if sprt.runs == 0:
+            raise CalibrationError(
+                f"probe at {values} completed 0 runs (infra trouble?)")
+        if sprt.verdict is None:
+            # the campaign budget ran dry before the cap (infra-class
+            # slots ate it): classify the point estimate, same fallback
+            # semantics as the cap
+            rate = sprt.failures / sprt.runs
+            sprt.verdict = ("below" if rate < self.spec.band[0]
+                            else "above" if rate > self.spec.band[1]
+                            else "in_band")
+            sprt.decided_by = "cap"
+        probe = dict(sprt.to_jsonable(), effort=round(effort, 4),
+                     knobs=values)
+        self.probes.append(probe)
+        self.runs_spent += sprt.runs
+        log.info("probe %d: rate %s over %d run(s) -> %s (%s)",
+                 len(self.probes), probe["rate"], probe["runs"],
+                 probe["verdict"], probe["decided_by"])
+        self._journal("in_progress")
+        return probe
+
+    def run(self) -> Dict[str, Any]:
+        """The sweep: midpoint, coarse endpoint jump, then bisection.
+        Returns the final artifact document (also written to
+        ``out_path`` when set); ``status`` is "calibrated" with the
+        landed probe's knob values, or "failed" with the journal."""
+        self._journal("in_progress")
+        lo_e, hi_e = 0.0, 1.0
+        effort = 0.5
+        landed = None
+        while len(self.probes) < self.spec.max_probes:
+            probe = self._probe(effort)
+            if probe["verdict"] == "in_band":
+                landed = probe
+                break
+            if probe["verdict"] == "below":
+                # rate below the band: more effort. Coarse-jump to the
+                # max-effort endpoint before bisecting — if even that is
+                # below the band, the knob range cannot reach it
+                if effort >= 1.0:
+                    break
+                lo_e = effort
+                effort = 1.0 if hi_e >= 1.0 and effort == 0.5 \
+                    else (lo_e + hi_e) / 2.0
+            else:  # above
+                if effort <= 0.0:
+                    break
+                hi_e = effort
+                effort = 0.0 if lo_e <= 0.0 and effort == 0.5 \
+                    else (lo_e + hi_e) / 2.0
+            if self._values_at(effort) == probe["knobs"]:
+                # the axis has collapsed to quantized-identical values;
+                # another probe cannot say anything new
+                break
+        status = "calibrated" if landed is not None else "failed"
+        doc = self._doc(status, landed)
+        self._journal(status, landed)
+        if landed is None:
+            log.warning("calibration failed: no in-band point in %d "
+                        "probe(s); journal: %s", len(self.probes),
+                        self.out_path or "(not written)")
+        else:
+            log.info("calibrated: %s at rate %s (saved %s%% of runs vs "
+                     "fixed-N %d)", landed["knobs"], landed["rate"],
+                     doc["runs_saved_pct"], doc["fixed_n_equivalent"])
+        return doc
+
+
+# -- probe runners ----------------------------------------------------------
+
+def synthetic_runner(rate_fn: Callable[[Dict[str, Any]], float],
+                     seed: int = 0) -> ProbeRunner:
+    """A deterministic in-process probe runner for tests: outcomes are
+    Bernoulli draws at ``rate_fn(knob_values)`` from a seeded RNG (one
+    RNG across the whole sweep — probe order matters, as it does for
+    real campaigns)."""
+    import random
+
+    rng = random.Random(seed)
+
+    def run_probe(values: Dict[str, Any], sprt: stats.BandSPRT) -> None:
+        rate = rate_fn(values)
+        while sprt.verdict is None and sprt.runs < sprt.max_runs:
+            sprt.update(rng.random() < rate)
+
+    return run_probe
+
+
+def campaign_probe_runner(example_dir: str,
+                          config_name: str = "config.toml",
+                          workdir: Optional[str] = None,
+                          python: str = sys.executable,
+                          seed: Optional[int] = None,
+                          run_wall_deadline_s: float = 0.0,
+                          keep_storages: bool = False) -> ProbeRunner:
+    """The real probe runner: each probe inits a throwaway storage from
+    the example and drives a supervised campaign
+    (namazu_tpu/campaign.py) with the knob candidates exported as
+    ``NMZ_CALIB_*`` environment; every completed run feeds the probe's
+    SPRT through the ``on_slot`` hook, which stops the campaign the
+    moment the verdict lands."""
+    from namazu_tpu.campaign import Campaign, CampaignSpec
+
+    example_dir = os.path.abspath(example_dir)
+    config_path = os.path.join(example_dir, config_name)
+    materials_dir = os.path.join(example_dir, "materials")
+    if not os.path.exists(config_path):
+        raise CalibrationError(f"no {config_name} in {example_dir}")
+    if not os.path.isdir(materials_dir):
+        raise CalibrationError(f"no materials/ in {example_dir}")
+
+    def run_probe(values: Dict[str, Any], sprt: stats.BandSPRT) -> None:
+        from namazu_tpu.cli import cli_main
+
+        probe_dir = tempfile.mkdtemp(prefix="nmz-calib-", dir=workdir)
+        storage_dir = os.path.join(probe_dir, "storage")
+        try:
+            rc = cli_main(["init", "--force", config_path, materials_dir,
+                           storage_dir])
+            if rc != 0:
+                raise CalibrationError(
+                    f"init failed ({rc}) for probe {values}")
+            extra_env = artifact.knob_env({"knobs": values})
+            seen = {"runs": 0, "failures": 0}
+
+            def on_slot(slot, progress) -> bool:
+                if progress is None:
+                    return False
+                new_runs = progress["runs"] - seen["runs"]
+                new_fails = progress["failures"] - seen["failures"]
+                seen["runs"] = progress["runs"]
+                seen["failures"] = progress["failures"]
+                # feed the diff in order failures-last within a slot
+                # (a slot contributes at most one outcome in practice)
+                for _ in range(max(0, new_runs - new_fails)):
+                    sprt.update(False)
+                for _ in range(max(0, new_fails)):
+                    sprt.update(True)
+                return sprt.verdict is not None
+
+            campaign = Campaign(CampaignSpec(
+                storage_dir=storage_dir,
+                runs=sprt.max_runs,
+                run_wall_deadline_s=run_wall_deadline_s,
+                python=python,
+                seed=seed,
+                telemetry_collector="",  # probes are throwaway fleets
+                extra_env=extra_env,
+                on_slot=on_slot))
+            campaign.run(resume=False)
+        finally:
+            if not keep_storages:
+                shutil.rmtree(probe_dir, ignore_errors=True)
+
+    return run_probe
+
+
+def calibrate_example(example_dir: str, out_path: str = "",
+                      config_name: str = "config.toml",
+                      workdir: Optional[str] = None,
+                      seed: Optional[int] = None,
+                      band: Optional[Tuple[float, float]] = None,
+                      max_runs: Optional[int] = None,
+                      run_wall_deadline_s: float = 0.0) -> Dict[str, Any]:
+    """``tools calibrate``'s engine: parse the example's
+    ``[calibration]`` table, sweep with the campaign runner, write the
+    artifact. CLI overrides (band, per-probe cap) win over the table."""
+    from namazu_tpu.utils.config import Config
+
+    example_dir = os.path.abspath(example_dir)
+    cfg = Config.from_file(os.path.join(example_dir, config_name))
+    spec = parse_calibration(cfg)
+    if band is not None:
+        spec.band = (float(band[0]), float(band[1]))
+    if max_runs is not None:
+        spec.max_runs_per_probe = int(max_runs)
+    runner = campaign_probe_runner(
+        example_dir, config_name=config_name, workdir=workdir, seed=seed,
+        run_wall_deadline_s=run_wall_deadline_s)
+    out_path = out_path or os.path.join(example_dir,
+                                        artifact.ARTIFACT_NAME)
+    calibrator = Calibrator(
+        spec, runner, example=os.path.basename(example_dir.rstrip("/")),
+        seed=seed, out_path=out_path)
+    return calibrator.run()

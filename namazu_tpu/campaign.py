@@ -42,7 +42,7 @@ import sys
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from namazu_tpu.cli.run_cmd import EXIT_TIMEOUT
 from namazu_tpu.obs import spans as obs_spans
@@ -115,6 +115,17 @@ class CampaignSpec:
     serve_entities: int = 2
     serve_policy: str = "random"
     serve_policy_param: Dict[str, Any] = field(default_factory=dict)
+    # extra environment exported to every run child — the calibration
+    # plane's knob transport (NMZ_CALIB_<NAME>, namazu_tpu/calibrate):
+    # a probe's candidate knob values ride the environment into the
+    # experiment scripts
+    extra_env: Dict[str, str] = field(default_factory=dict)
+    # called after every finished slot with (slot, progress-or-None);
+    # returning True stops the campaign gracefully (stopped_reason
+    # "callback", exit 0) — how the calibration harness early-stops a
+    # probe the moment its band SPRT concludes
+    on_slot: Optional[Callable[[Dict[str, Any],
+                                Optional[Dict[str, Any]]], bool]] = None
 
 
 class Campaign:
@@ -233,7 +244,7 @@ class Campaign:
     def _child_env(self) -> Dict[str, str]:
         # the child must be able to import the framework even when it is
         # not installed site-wide; CmdFactory.env() owns that logic
-        env = CmdFactory().env()
+        env = CmdFactory(extra_env=self.spec.extra_env).env()
         if self._telemetry_path:
             # run children push their metrics (and forward their
             # inspectors') to the supervisor's collector — the one
@@ -557,6 +568,12 @@ class Campaign:
             else:
                 state["consecutive_infra"] += 1
             self._checkpoint()
+            progress = self._publish_progress()
+            if spec.on_slot is not None and spec.on_slot(slot, progress):
+                # the caller has seen enough (calibration probe SPRT
+                # concluded, A/B budget reached): graceful stop, the
+                # completed prefix stands
+                return self._finish("callback", EXIT_OK)
         if (spec.max_consecutive_infra > 0
                 and state["consecutive_infra"]
                 >= spec.max_consecutive_infra):
@@ -597,6 +614,44 @@ class Campaign:
                         attempt["class"], attempt["exit_status"], delay)
             if self._stop_requested.wait(delay):
                 return slot
+
+    def _publish_progress(self) -> Optional[Dict[str, Any]]:
+        """The live progress surface's supervisor face: after every
+        slot, recompute the storage's sequential statistics
+        (obs/analytics.progress_stats), publish the nmz_campaign_*
+        gauges the fleet federates, and stash the document in the
+        in-memory state for the on_slot callback. Best-effort — a
+        mid-write storage or a stats bug degrades to None, never kills
+        the campaign loop."""
+        try:
+            from namazu_tpu.obs import analytics
+            from namazu_tpu.storage import load_storage
+
+            st = load_storage(self.spec.storage_dir)
+            try:
+                calib, ckpt = analytics._progress_inputs(
+                    self.spec.storage_dir)
+                progress = analytics.progress_stats(
+                    st, calibration=calib, checkpoint=ckpt)
+            finally:
+                st.close()
+        except Exception:
+            log.warning("progress publication failed; continuing",
+                        exc_info=True)
+            return None
+        obs_spans.campaign_progress(
+            rate=progress["repro_rate"],
+            ci=progress["rate_ci95"],
+            repros_per_hour=progress["repros_per_hour"],
+            eta_next_repro_s=progress["eta_next_repro_s"],
+            runs_to_ci=(progress["runs_to_ci_width"] or {}).get(
+                "more_runs"),
+            in_band=(1 if progress["band_verdict"] == "in_band"
+                     else 0 if progress["band_verdict"] in
+                     ("below", "above") else None),
+        )
+        self.state["progress"] = progress
+        return progress
 
     def _checkpoint_partial(self, slot: Dict[str, Any]) -> None:
         """Checkpoint with the in-progress slot appended provisionally

@@ -48,6 +48,14 @@ def run(args) -> int:
     cfg.dump_json(os.path.join(args.storage, "config.json"))
     shutil.copy2(args.config,
                  os.path.join(args.storage, os.path.basename(args.config)))
+    # a calibration artifact beside the config (namazu_tpu/calibrate:
+    # `tools calibrate` writes it into the example dir) travels with the
+    # storage — `run` exports its knob values to the experiment scripts
+    calib_src = os.path.join(os.path.dirname(os.path.abspath(args.config)),
+                             "calibration.json")
+    if os.path.exists(calib_src):
+        shutil.copy2(calib_src, os.path.join(args.storage,
+                                             "calibration.json"))
     materials_dst = os.path.join(args.storage, "materials")
     shutil.copytree(args.materials, materials_dst)
 

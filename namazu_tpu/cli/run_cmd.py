@@ -113,6 +113,20 @@ def run(args) -> int:
         # and fsck/quarantine semantics over the storage stay untouched
         cfg.set("event_journal_dir", working_dir)
     factory = CmdFactory(working_dir=working_dir, materials_dir=materials_dir)
+    # calibration plane (namazu_tpu/calibrate): a committed
+    # calibration.json in the storage (copied by init from the example
+    # dir) exports its knob values as NMZ_CALIB_<NAME> to every
+    # experiment script — calibrated timing is provenance the scripts
+    # read from the environment, never an edited source constant.
+    # Explicit environment (a calibration probe's candidate values,
+    # exported by the campaign supervisor) wins over the artifact.
+    from namazu_tpu.calibrate import artifact as _calib_artifact
+
+    calib = _calib_artifact.load_calibration(storage_dir)
+    if calib is not None:
+        env_knobs = _calib_artifact.knob_env(calib)
+        factory.extra_env.update(
+            {k: v for k, v in env_knobs.items() if k not in os.environ})
     # record the run script's process group while a phase is in flight:
     # if THIS process is SIGKILLed mid-run (the orchestrator crash the
     # chaos plane injects), the campaign supervisor sweeps the group so

@@ -88,6 +88,38 @@ def register(sub) -> None:
                           "models/failure_pool.py)")
     pab.set_defaults(func=ab)
 
+    pc = tsub.add_parser(
+        "calibrate",
+        help="sweep an example's [calibration] timing knobs until the "
+             "random-baseline repro rate lands in the target band "
+             "(namazu_tpu/calibrate; writes calibration.json beside "
+             "the config — `init` copies it, `run` exports the knobs "
+             "as NMZ_CALIB_* environment)",
+    )
+    pc.add_argument("example", help="example dir with a [calibration] "
+                                    "table in its config")
+    pc.add_argument("--out", default="",
+                    help="artifact path (default: "
+                         "EXAMPLE/calibration.json)")
+    pc.add_argument("--config", default="config.toml",
+                    help="config file (in EXAMPLE) to calibrate "
+                         "(default config.toml)")
+    pc.add_argument("--band", default="",
+                    help="target rate band LO,HI (overrides the "
+                         "config's; default 0.02,0.10)")
+    pc.add_argument("--max-runs", type=int, default=0,
+                    help="per-probe run cap (overrides the config's; "
+                         "0 = keep)")
+    pc.add_argument("--seed", type=int, default=0,
+                    help="campaign jitter seed (deterministic retries)")
+    pc.add_argument("--workdir", default="",
+                    help="where probe storages live (default: a temp "
+                         "dir, removed per probe)")
+    pc.add_argument("--run-wall-deadline", type=float, default=0.0,
+                    help="per-run wall-clock deadline forwarded to the "
+                         "probe campaigns (seconds; 0 = none)")
+    pc.set_defaults(func=calibrate)
+
     pv2 = tsub.add_parser(
         "ab-variance",
         help="run the ab measurement N times (independent batches, "
@@ -452,6 +484,11 @@ def render_top(payload: dict) -> str:
         # instance (nmz_triage_signatures; doc/observability.md
         # "Triage")
         ("triage_signatures", "SIGS", ""),
+        # campaign progress (nmz_campaign_*; doc/observability.md
+        # "Calibration & progress"): measured repro rate and the
+        # next-repro ETA forecast
+        ("repro_rate", "RATE", ""),
+        ("eta_next_repro_s", "ETA", "s"),
         ("last_seen_age_s", "AGE", "s"), ("stale", "STALE", ""),
     )
     rows = [[header for _, header, _ in cols]]
@@ -808,6 +845,51 @@ def report(args) -> int:
         print(f"wrote {args.out}")
     else:
         sys.stdout.write(text)
+    return 0
+
+
+def calibrate(args) -> int:
+    """Calibration sweep over one example (namazu_tpu/calibrate): land
+    the random-baseline repro rate in the target band by bisecting the
+    declared knob axis, each probe a short SPRT-early-stopped campaign.
+    Exit 0 only when an in-band point landed; the artifact (with the
+    full probe journal either way) is written beside the config."""
+    from namazu_tpu.calibrate.harness import (
+        CalibrationError,
+        calibrate_example,
+    )
+
+    band = None
+    if args.band:
+        try:
+            lo, hi = (float(x) for x in args.band.split(","))
+            band = (lo, hi)
+        except ValueError:
+            print(f"error: bad --band {args.band!r} (want LO,HI)",
+                  file=sys.stderr)
+            return 2
+    try:
+        doc = calibrate_example(
+            args.example,
+            out_path=args.out,
+            config_name=args.config,
+            workdir=args.workdir or None,
+            seed=args.seed,
+            band=band,
+            max_runs=args.max_runs or None,
+            run_wall_deadline_s=args.run_wall_deadline)
+    except CalibrationError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    out = args.out or os.path.join(args.example, "calibration.json")
+    print(json.dumps({k: doc[k] for k in (
+        "status", "knobs", "rate", "rate_ci95", "runs_spent",
+        "fixed_n_equivalent", "runs_saved_pct")}, sort_keys=True))
+    print(f"wrote {out}")
+    if doc["status"] != "calibrated":
+        print("error: no in-band knob point found (see the probe "
+              "journal in the artifact)", file=sys.stderr)
+        return 1
     return 0
 
 

@@ -955,6 +955,8 @@ class RestEndpoint(QueuedEndpoint):
                     })
                 if url.path == "/analytics":
                     return self._get_analytics(parse_qs(url.query))
+                if url.path == "/progress":
+                    return self._get_progress()
                 if url.path == "/fleet":
                     return self._get_fleet(parse_qs(url.query))
                 if _POLICY_TABLE_RE.match(url.path):
@@ -1067,6 +1069,19 @@ class RestEndpoint(QueuedEndpoint):
                     return self._reply_raw(
                         200, obs.report.render_ndjson(payload).encode(),
                         "application/x-ndjson")
+                self._reply(200, payload)
+
+            def _get_progress(self) -> None:
+                """Campaign-progress surface (obs/stats.py via
+                obs/analytics.progress_stats): the registered storage's
+                sequential repro-rate statistics, band verdict, and ETA
+                forecasts — always 200, zeros before the first run."""
+                try:
+                    payload = obs.progress_payload()
+                except Exception as e:  # never let a stats bug kill ops
+                    log.exception("progress payload failed")
+                    return self._reply(
+                        500, {"error": f"progress failed: {e}"})
                 self._reply(200, payload)
 
             def _get_fleet(self, query) -> None:

@@ -74,6 +74,7 @@ from namazu_tpu.obs.metrics import (  # noqa: F401
 from namazu_tpu.obs.spans import (  # noqa: F401
     action_dispatched,
     action_unroutable,
+    campaign_progress,
     campaign_slot,
     carry,
     chaos_fault_injected,
@@ -203,6 +204,14 @@ def analytics_payload(top: int = analytics.DEFAULT_TOP,
     """The experiment-analytics document (the ``GET /analytics`` body):
     the registered storage joined with this process's recorded runs."""
     return analytics.payload(top=top, window=window)
+
+
+def progress_payload() -> dict:
+    """The campaign-progress document (the ``GET /progress`` body):
+    sequential repro-rate statistics, band verdict, and ETA forecasts
+    over the registered storage — always served, zeros before the first
+    run lands."""
+    return analytics.progress_payload()
 
 
 def causality_run_payload(run_id: str):

@@ -40,12 +40,15 @@ doc/observability.md ("Experiment analytics").
 
 from __future__ import annotations
 
-import math
+import json
+import os
 import threading
 from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
-from namazu_tpu.obs import spans
+from namazu_tpu.obs import spans, stats
+from namazu_tpu.obs.stats import wilson_interval  # noqa: F401 (canonical
+# home moved to obs/stats.py; re-exported here for compatibility)
 from namazu_tpu.utils.log import get_logger
 
 log = get_logger("obs.analytics")
@@ -57,6 +60,7 @@ __all__ = [
     "relation_bits_of",
     "coverage_stats", "reproduction_stats", "entity_stats",
     "convergence_stats", "suspicious_branches", "compute_payload",
+    "progress_stats", "progress_payload",
     "payload", "set_storage_dir", "storage_dir",
     "set_knowledge_address", "knowledge_address",
     "StallDetector", "note_search_round", "reset_stall_detector",
@@ -85,19 +89,6 @@ RELATION_WINDOW = 16
 
 
 # -- building blocks -------------------------------------------------------
-
-def wilson_interval(k: int, n: int, z: float = 1.96) -> Tuple[float, float]:
-    """Wilson score interval for a proportion of ``k`` hits in ``n``
-    trials. Correct at the tiny n this system lives at (10-run
-    experiments), where the normal approximation collapses to [p, p]."""
-    if n <= 0:
-        return (0.0, 0.0)
-    p = k / n
-    denom = 1.0 + z * z / n
-    center = (p + z * z / (2 * n)) / denom
-    half = (z / denom) * math.sqrt(p * (1 - p) / n + z * z / (4 * n * n))
-    return (max(0.0, center - half), min(1.0, center + half))
-
 
 def detect_stall(fitness: List[float],
                  novelty: Optional[List[float]] = None,
@@ -381,6 +372,134 @@ def reproduction_stats(storage) -> Dict[str, Any]:
     return stats
 
 
+def _run_outcomes(storage) -> List[bool]:
+    """The storage's completed-run outcome sequence in campaign order
+    (True = failure = repro), quarantined runs excluded — what the
+    progress surface replays through the band SPRT."""
+    n = storage.nr_stored_histories()
+    is_quarantined = getattr(storage, "is_quarantined", None)
+    outcomes: List[bool] = []
+    for i in range(n):
+        if is_quarantined is not None and is_quarantined(i):
+            continue
+        try:
+            outcomes.append(not storage.is_successful(i))
+        except Exception:
+            continue
+    return outcomes
+
+
+def progress_stats(storage, coverage: Optional[Dict[str, Any]] = None,
+                   calibration: Optional[Dict[str, Any]] = None,
+                   checkpoint: Optional[Dict[str, Any]] = None
+                   ) -> Dict[str, Any]:
+    """The live campaign-progress document (obs/stats.py machinery over
+    one storage): measured rate + CI, repros/hour, ETA forecasts, the
+    sequential band verdict, and the search-pays/random-suffices regime
+    call. Pure function of its inputs — no wall-clock reads — so the
+    REST ``/progress`` body, the ``/analytics`` fold, and ``tools
+    report`` all agree byte-for-byte. Every field is ``None`` rather
+    than NaN on a young campaign (0 or 1 completed runs, no failures
+    yet): the document must always survive ``json.dumps(...,
+    allow_nan=False)``."""
+    repro = reproduction_stats(storage)
+    outcomes = _run_outcomes(storage)
+    runs = len(outcomes)
+    failures = sum(outcomes)
+    band = tuple(stats.DEFAULT_BAND)
+    band_source = "default"
+    if calibration and isinstance(calibration.get("band"), (list, tuple)) \
+            and len(calibration["band"]) == 2:
+        band = (float(calibration["band"][0]),
+                float(calibration["band"][1]))
+        band_source = "calibration"
+    # cap out of reach: live progress reads "undecided" until the SPRT
+    # genuinely concludes (the budget-capped point-estimate fallback is
+    # the calibration harness's semantics, not a scrape's)
+    sprt = stats.BandSPRT.replay(outcomes, lo=band[0], hi=band[1],
+                                 max_runs=runs + 1)
+    rate = failures / runs if runs else None
+    rph = repro.get("repros_per_hour") or None
+    runs_to_ci = stats.runs_for_ci_width(rate if failures else None)
+    doc: Dict[str, Any] = {
+        "runs": runs,
+        "failures": failures,
+        "runs_quarantined": repro.get("runs_quarantined", 0),
+        "repro_rate": round(rate, 4) if rate is not None else None,
+        "rate_ci95": repro.get("failure_rate_ci95") if runs else None,
+        "repros_per_hour": rph,
+        "total_time_s": repro.get("total_time_s", 0.0),
+        # forecasters (obs/stats.py): None = nothing to extrapolate yet
+        "eta_next_repro_s": stats.eta_next_repro_s(rph),
+        "eta_10_repros_s": stats.eta_to_n_repros_s(rph, failures, 10),
+        "runs_to_ci_width": ({
+            "width": stats.DEFAULT_CI_WIDTH,
+            "runs": runs_to_ci,
+            "more_runs": max(0, runs_to_ci - runs),
+        } if runs_to_ci is not None else None),
+        # the sequential band verdict, replayed deterministically over
+        # the outcome sequence (max_runs = what actually ran, so a live
+        # campaign reads "undecided" until the SPRT truly concludes)
+        "band": [band[0], band[1]],
+        "band_source": band_source,
+        "band_verdict": sprt.verdict or "undecided",
+        "band_decided_by": sprt.decided_by,
+        "regime": stats.regime_verdict(
+            rate, runs, band=band,
+            digests_saturated_relations_growing=bool(
+                (coverage or {}).get(
+                    "digests_saturated_relations_growing"))),
+    }
+    if calibration is not None:
+        doc["calibration"] = {
+            "schema": calibration.get("schema"),
+            "status": calibration.get("status"),
+            "knobs": calibration.get("knobs"),
+            "rate": calibration.get("rate"),
+            "rate_ci95": calibration.get("rate_ci95"),
+            "runs_saved_pct": calibration.get("runs_saved_pct"),
+        }
+    if checkpoint is not None:
+        requested = int(checkpoint.get("requested_runs", 0) or 0)
+        slots = [s for s in checkpoint.get("slots", [])
+                 if not s.get("in_progress")]
+        remaining = max(0, requested - len(slots))
+        mean_run_s = (repro["total_time_s"] / runs) if runs else None
+        doc["campaign"] = {
+            "requested_runs": requested,
+            "completed_slots": len(slots),
+            "stopped_reason": checkpoint.get("stopped_reason"),
+            "eta_completion_s": (round(remaining * mean_run_s, 1)
+                                 if mean_run_s is not None else None),
+        }
+    return doc
+
+
+def _progress_inputs(dir_path: Optional[str]
+                     ) -> Tuple[Optional[Dict[str, Any]],
+                                Optional[Dict[str, Any]]]:
+    """Best-effort read of a storage dir's calibration artifact
+    (calibration.json, namazu_tpu/calibrate) and campaign checkpoint
+    (campaign.json) — (None, None) when absent or unreadable, so a torn
+    file degrades the fold instead of failing the payload."""
+    calib = ckpt = None
+    if dir_path:
+        for name, slot in (("calibration.json", "calib"),
+                           ("campaign.json", "ckpt")):
+            path = os.path.join(dir_path, name)
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+                if isinstance(doc, dict):
+                    if slot == "calib":
+                        calib = doc
+                    else:
+                        ckpt = doc
+            except (OSError, ValueError):
+                continue
+    return calib, ckpt
+
+
 def entity_stats(storage,
                  max_rows: int = MAX_ENTITY_ROWS) -> List[Dict[str, Any]]:
     """Per-entity event totals across all recorded traces, busiest
@@ -543,6 +662,19 @@ def compute_payload(storage=None, recorder_runs=None,
         "convergence": convergence,
         "suspicious": suspicious,
     }
+    # progress fold (obs/stats.py): the sequential-statistics surface,
+    # folded in only when the storage dir carries a calibration artifact
+    # or a campaign checkpoint — file-driven so the CLI report and the
+    # REST route agree byte-for-byte (parity test), and golden storages
+    # (neither file) render unchanged
+    progress = None
+    st_dir = getattr(storage, "dir", None)
+    if st_dir:
+        calib, ckpt = _progress_inputs(st_dir)
+        if calib is not None or ckpt is not None:
+            progress = progress_stats(storage, coverage=coverage,
+                                      calibration=calib, checkpoint=ckpt)
+            doc["progress"] = progress
     if publish:
         # the relation-coverage gauge's storage-derived face; the live
         # per-campaign face is published by the ingest path with the
@@ -562,6 +694,18 @@ def compute_payload(storage=None, recorder_runs=None,
             time_to_first_failure_s=repro["time_to_first_failure_s"],
             mean_runs_to_reproduce=repro["mean_runs_to_reproduce"],
         )
+        if progress is not None:
+            spans.campaign_progress(
+                rate=progress["repro_rate"],
+                ci=progress["rate_ci95"],
+                repros_per_hour=progress["repros_per_hour"],
+                eta_next_repro_s=progress["eta_next_repro_s"],
+                runs_to_ci=(progress["runs_to_ci_width"] or {}).get(
+                    "more_runs"),
+                in_band=(1 if progress["band_verdict"] == "in_band"
+                         else 0 if progress["band_verdict"] in
+                         ("below", "above") else None),
+            )
     return doc
 
 
@@ -708,6 +852,33 @@ def payload(top: int = DEFAULT_TOP,
     except Exception:
         log.warning("triage fold failed; payload served without it",
                     exc_info=True)
+    return doc
+
+
+def progress_payload() -> Dict[str, Any]:
+    """The live ``GET /progress`` body: progress_stats over the
+    registered storage, always served (default band, all-None
+    forecasts) even before the first run lands — a young campaign
+    scrape returns zeros, never a 404 or NaN."""
+    st = None
+    d = _storage_dir
+    if d:
+        try:
+            from namazu_tpu.storage import load_storage
+
+            st = load_storage(d)
+        except Exception:
+            log.warning("progress storage %s unreadable; serving "
+                        "zero-run payload", d, exc_info=True)
+    calib, ckpt = _progress_inputs(d)
+    try:
+        doc = progress_stats(st if st is not None else _EmptyStorage(),
+                             calibration=calib, checkpoint=ckpt)
+    finally:
+        if st is not None:
+            st.close()
+    doc["schema"] = "nmz-progress-v1"
+    doc["storage"] = d
     return doc
 
 

@@ -865,6 +865,18 @@ class FleetAggregator:
                     # column; doc/observability.md "Triage")
                     "triage_signatures": self._gauge_max(
                         st, spans.TRIAGE_SIGNATURES),
+                    # campaign progress plane (obs/stats.py via the
+                    # supervisor's per-slot publication): measured
+                    # repro rate, pace, next-repro ETA, and the band
+                    # SPRT verdict — the tools-top RATE/ETA columns
+                    "repro_rate": self._gauge_max(
+                        st, spans.CAMPAIGN_RATE),
+                    "repros_per_hour": self._gauge_max(
+                        st, spans.CAMPAIGN_REPROS_PER_HOUR),
+                    "eta_next_repro_s": self._gauge_max(
+                        st, spans.CAMPAIGN_ETA_NEXT),
+                    "campaign_in_band": self._gauge_max(
+                        st, spans.CAMPAIGN_IN_BAND),
                     "edge_table_staleness_s": self._gauge_max(
                         st, spans.EDGE_TABLE_STALENESS),
                     "edge_parked": self._gauge_sum(

@@ -206,6 +206,53 @@ def render_markdown(payload: Dict[str, Any]) -> str:
             out("- no objectives declared")
         out("")
 
+    progress = payload.get("progress")
+    if progress is not None:
+        # present only when the storage dir carries a calibration
+        # artifact or a campaign checkpoint (obs/analytics.py progress
+        # fold); omitted otherwise so pre-calibration payloads render
+        # byte-identically
+        out("## Calibration & progress")
+        out("")
+        band = progress.get("band") or []
+        out(f"- repro rate: {_num(progress.get('repro_rate'))} "
+            f"(CI {_ci(progress.get('rate_ci95'))}) over "
+            f"{_num(progress.get('runs'))} runs")
+        out(f"- band [{_num(band[0] if len(band) > 1 else None)}, "
+            f"{_num(band[1] if len(band) > 1 else None)}] "
+            f"({_num(progress.get('band_source'))}): "
+            f"{_num(progress.get('band_verdict'))}"
+            + (f" (decided by {progress['band_decided_by']})"
+               if progress.get("band_decided_by") else ""))
+        eta = progress.get("eta_next_repro_s")
+        out(f"- repros/hour: {_num(progress.get('repros_per_hour'))}; "
+            f"next repro ETA: "
+            + (f"{_num(eta)} s" if eta is not None
+               else "- (no pace yet)"))
+        rtc = progress.get("runs_to_ci_width")
+        if rtc:
+            out(f"- runs to a {_num(rtc.get('width'))}-wide CI: "
+                f"{_num(rtc.get('runs'))} "
+                f"({_num(rtc.get('more_runs'))} more)")
+        camp = progress.get("campaign")
+        if camp:
+            out(f"- campaign: {_num(camp.get('completed_slots'))} / "
+                f"{_num(camp.get('requested_runs'))} slots; "
+                f"completion ETA: {_num(camp.get('eta_completion_s'))} s")
+        regime = progress.get("regime") or {}
+        out(f"- regime: {_num(regime.get('verdict'))} — "
+            f"{regime.get('reason', '-')}")
+        calib = progress.get("calibration")
+        if calib:
+            knobs = ", ".join(f"{k}={_num(v)}" for k, v in
+                              (calib.get("knobs") or {}).items()) or "-"
+            out(f"- calibration ({_num(calib.get('status'))}): {knobs}; "
+                f"rate {_num(calib.get('rate'))} "
+                f"(CI {_ci(calib.get('rate_ci95'))}), "
+                f"{_num(calib.get('runs_saved_pct'))}% runs saved vs "
+                "fixed-N")
+        out("")
+
     triage = payload.get("triage")
     if triage is not None:
         # present only when this process holds triage dossiers

@@ -191,6 +191,21 @@ EXPERIMENT_NOVELTY = "nmz_experiment_novelty_last_window"
 EXPERIMENT_TTFF = "nmz_experiment_time_to_first_failure_seconds"
 EXPERIMENT_RUNS_TO_REPRO = "nmz_experiment_mean_runs_to_reproduce"
 
+# campaign progress plane (obs/stats.py sequential statistics, published
+# live by the campaign supervisor after every slot and by the analytics
+# fold — doc/observability.md "Calibration & progress"): the measured
+# repro rate with its Wilson bounds, throughput in repros/hour, the
+# next-repro ETA forecast, how many more runs a target-width CI needs,
+# and the band SPRT's in/out-of-band verdict (1 in band, 0 out, unset
+# while undecided). Federated through /fleet as the RATE and ETA columns
+CAMPAIGN_RATE = "nmz_campaign_repro_rate"
+CAMPAIGN_RATE_CI_LOW = "nmz_campaign_repro_rate_ci_low"
+CAMPAIGN_RATE_CI_HIGH = "nmz_campaign_repro_rate_ci_high"
+CAMPAIGN_REPROS_PER_HOUR = "nmz_campaign_repros_per_hour"
+CAMPAIGN_ETA_NEXT = "nmz_campaign_eta_next_repro_seconds"
+CAMPAIGN_RUNS_TO_CI = "nmz_campaign_runs_to_ci_width"
+CAMPAIGN_IN_BAND = "nmz_campaign_in_band"
+
 
 #: distinct ``entity`` label values admitted per registry before new
 #: entities fold into "_other" — inspectors can mint an entity per
@@ -878,6 +893,47 @@ def experiment_stats(runs: int, failures: int, failure_rate: float,
         reg.gauge(EXPERIMENT_RUNS_TO_REPRO,
                   "runs per reproduction (inverse failure rate)",
                   ).set(mean_runs_to_reproduce)
+
+
+def campaign_progress(rate: Optional[float],
+                      ci: Optional[Any] = None,
+                      repros_per_hour: Optional[float] = None,
+                      eta_next_repro_s: Optional[float] = None,
+                      runs_to_ci: Optional[float] = None,
+                      in_band: Optional[int] = None) -> None:
+    """Publish one campaign-progress document's live face (obs/stats.py
+    via obs/analytics.progress_stats) as ``nmz_campaign_*`` gauges. A
+    None value leaves its gauge untouched rather than faking a 0 — a
+    young campaign has no rate yet, not a zero rate; an undecided SPRT
+    has no in/out-of-band verdict."""
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    if rate is not None:
+        reg.gauge(CAMPAIGN_RATE,
+                  "measured repro (failure) rate of the campaign's "
+                  "storage").set(rate)
+    if ci is not None and len(ci) == 2:
+        reg.gauge(CAMPAIGN_RATE_CI_LOW,
+                  "Wilson 95% lower bound of the repro rate").set(ci[0])
+        reg.gauge(CAMPAIGN_RATE_CI_HIGH,
+                  "Wilson 95% upper bound of the repro rate").set(ci[1])
+    if repros_per_hour is not None:
+        reg.gauge(CAMPAIGN_REPROS_PER_HOUR,
+                  "reproductions per hour of run time").set(
+                      repros_per_hour)
+    if eta_next_repro_s is not None:
+        reg.gauge(CAMPAIGN_ETA_NEXT,
+                  "forecast seconds of run time to the next repro",
+                  ).set(eta_next_repro_s)
+    if runs_to_ci is not None:
+        reg.gauge(CAMPAIGN_RUNS_TO_CI,
+                  "additional runs forecast to reach the target CI "
+                  "width").set(runs_to_ci)
+    if in_band is not None:
+        reg.gauge(CAMPAIGN_IN_BAND,
+                  "band SPRT verdict (1 = measured rate in the target "
+                  "band, 0 = out of band)").set(in_band)
 
 
 def relation_coverage(scenario: str, covered: int, width: int,

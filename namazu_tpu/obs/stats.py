@@ -1,0 +1,293 @@
+"""Sequential binomial statistics for repro-rate campaigns.
+
+The repo's headline metric is a reproduction PROBABILITY (PAPER.md), and
+every consumer of it — the calibration harness (namazu_tpu/calibrate),
+the live ``GET /progress`` surface, the A/B gates — faces the same two
+questions: *how sure are we about the rate so far* and *how much longer
+until we know enough*. This module is the one pure, seed-deterministic
+answer shared by all of them:
+
+* :func:`wilson_interval` — the small-n confidence interval (canonical
+  home; ``obs.analytics.wilson_interval`` re-exports it);
+* :class:`BandSPRT` — a sequential band test over a stream of run
+  outcomes: early-accept "rate is inside [lo, hi]", early-reject "rate
+  is below/above the band", with a hard run cap that falls back to the
+  point estimate (``decided_by: "cap"``);
+* forecasters — expected runs to a target CI width, ETA to the next
+  reproduction and to N reproductions from repros/hour;
+* :func:`regime_verdict` — search-pays vs random-suffices, combining
+  the measured baseline rate with the coverage plane's
+  ``digests_saturated_relations_growing`` flag (RESULTS.md: search pays
+  ~15x where random repro is rare and loses where random trivially
+  repros).
+
+Everything here is stdlib-only and wall-clock free: two computations
+over the same outcome sequence compare equal, which the calibration
+journal and the /progress parity lean on. Degenerate inputs (no runs,
+no failures, zero elapsed time) yield ``None``, never NaN or a
+ZeroDivisionError — a young campaign's progress document must always
+be JSON-serializable with ``allow_nan=False``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "DEFAULT_BAND", "DEFAULT_ALPHA", "DEFAULT_BETA",
+    "DEFAULT_CI_WIDTH",
+    "wilson_interval", "BandSPRT",
+    "runs_for_ci_width", "eta_next_repro_s", "eta_to_n_repros_s",
+    "regime_verdict",
+]
+
+#: the target baseline-rate band (ROADMAP item 1): rare enough that
+#: search pays ~15x, common enough that a bounded campaign measures it
+DEFAULT_BAND: Tuple[float, float] = (0.02, 0.10)
+#: SPRT error rates: P(reject band | rate at a band edge) and
+#: P(accept band | rate at the indifference midpoint) targets
+DEFAULT_ALPHA = 0.05
+DEFAULT_BETA = 0.05
+#: default CI-width target the runs-to-width forecaster answers for
+DEFAULT_CI_WIDTH = 0.10
+
+
+def wilson_interval(k: int, n: int, z: float = 1.96) -> Tuple[float, float]:
+    """Wilson score interval for a proportion of ``k`` hits in ``n``
+    trials. Correct at the tiny n this system lives at (10-run
+    experiments), where the normal approximation collapses to [p, p]."""
+    if n <= 0:
+        return (0.0, 0.0)
+    p = k / n
+    denom = 1.0 + z * z / n
+    center = (p + z * z / (2 * n)) / denom
+    half = (z / denom) * math.sqrt(p * (1 - p) / n + z * z / (4 * n * n))
+    return (max(0.0, center - half), min(1.0, center + half))
+
+
+def _llr_terms(p0: float, p1: float) -> Tuple[float, float]:
+    """Per-observation log-likelihood-ratio increments for H1: p=p1 vs
+    H0: p=p0 — (on a failure, on a success)."""
+    return (math.log(p1 / p0), math.log((1.0 - p1) / (1.0 - p0)))
+
+
+class BandSPRT:
+    """Sequential test of "repro rate is inside [lo, hi]" over a stream
+    of per-run outcomes (``update(failed)``; a failure IS a repro).
+
+    Two one-sided Wald SPRTs around the band's geometric midpoint
+    ``mid = sqrt(lo * hi)``:
+
+    * the LOW test distinguishes p = lo from p = mid; concluding for
+      mid ("the rate clears the band floor") is half of in-band,
+      concluding for lo is read as **below the band**;
+    * the HIGH test distinguishes p = mid from p = hi; concluding for
+      mid ("the rate stays under the band ceiling") is the other half,
+      concluding for hi is **above the band**.
+
+    Each sub-test freezes once concluded (its verdict never flips on
+    later data). ``verdict`` is ``None`` while undecided, then one of
+    ``"in_band"`` / ``"below"`` / ``"above"`` with ``decided_by:
+    "sprt"``. The semantics are deliberately mid-seeking: a true rate
+    sitting exactly on a band edge may be rejected either way — the
+    calibration sweep WANTS probes pushed toward mid-band, not parked
+    on an edge.
+
+    Distinguishing a near-zero rate from the band floor (or floor from
+    midpoint) is inherently sample-hungry, so a ``max_runs`` cap bounds
+    every probe: at the cap the verdict falls back to classifying the
+    point estimate against the band, marked ``decided_by: "cap"`` —
+    honest provenance for a budget-bounded answer.
+    """
+
+    def __init__(self, lo: float = DEFAULT_BAND[0],
+                 hi: float = DEFAULT_BAND[1],
+                 alpha: float = DEFAULT_ALPHA, beta: float = DEFAULT_BETA,
+                 max_runs: int = 40):
+        if not (0.0 < lo < hi < 1.0):
+            raise ValueError(f"need 0 < lo < hi < 1, got [{lo}, {hi}]")
+        if not (0.0 < alpha < 1.0 and 0.0 < beta < 1.0):
+            raise ValueError("alpha and beta must be in (0, 1)")
+        if max_runs < 1:
+            raise ValueError(f"max_runs must be >= 1, got {max_runs}")
+        self.lo = lo
+        self.hi = hi
+        self.mid = math.sqrt(lo * hi)
+        self.alpha = alpha
+        self.beta = beta
+        self.max_runs = max_runs
+        self.accept_llr = math.log((1.0 - beta) / alpha)
+        self.reject_llr = math.log(beta / (1.0 - alpha))
+        self._low_fail, self._low_pass = _llr_terms(lo, self.mid)
+        self._high_fail, self._high_pass = _llr_terms(self.mid, hi)
+        self.llr_low = 0.0
+        self.llr_high = 0.0
+        #: frozen sub-verdicts: None undecided, True = the rate cleared
+        #: this sub-test toward the band, False = it left the band here
+        self._above_floor: Optional[bool] = None
+        self._under_ceiling: Optional[bool] = None
+        self.runs = 0
+        self.failures = 0
+        self.verdict: Optional[str] = None
+        self.decided_by: Optional[str] = None
+
+    # -- feeding ---------------------------------------------------------
+
+    def update(self, failed: bool) -> Optional[str]:
+        """Feed one run outcome (in campaign order); returns the
+        verdict, still ``None`` while undecided. Outcomes past a
+        decision are counted (runs/failures/rate stay truthful) but no
+        longer move the frozen verdict."""
+        self.runs += 1
+        self.failures += int(failed)
+        if self.verdict is not None:
+            return self.verdict
+        if self._above_floor is None:
+            self.llr_low += self._low_fail if failed else self._low_pass
+            if self.llr_low >= self.accept_llr:
+                self._above_floor = True
+            elif self.llr_low <= self.reject_llr:
+                self._above_floor = False
+        if self._under_ceiling is None:
+            self.llr_high += self._high_fail if failed else self._high_pass
+            if self.llr_high >= self.accept_llr:
+                self._under_ceiling = False
+            elif self.llr_high <= self.reject_llr:
+                self._under_ceiling = True
+        if self._above_floor is False:
+            self.verdict, self.decided_by = "below", "sprt"
+        elif self._under_ceiling is False:
+            self.verdict, self.decided_by = "above", "sprt"
+        elif self._above_floor and self._under_ceiling:
+            self.verdict, self.decided_by = "in_band", "sprt"
+        elif self.runs >= self.max_runs:
+            rate = self.failures / self.runs
+            self.verdict = ("below" if rate < self.lo
+                            else "above" if rate > self.hi else "in_band")
+            self.decided_by = "cap"
+        return self.verdict
+
+    # -- reading ---------------------------------------------------------
+
+    @property
+    def rate(self) -> Optional[float]:
+        return self.failures / self.runs if self.runs else None
+
+    @property
+    def ci95(self) -> Optional[Tuple[float, float]]:
+        if not self.runs:
+            return None
+        return wilson_interval(self.failures, self.runs)
+
+    def to_jsonable(self) -> Dict[str, Any]:
+        ci = self.ci95
+        return {
+            "band": [self.lo, self.hi],
+            "runs": self.runs,
+            "failures": self.failures,
+            "rate": (round(self.failures / self.runs, 4)
+                     if self.runs else None),
+            "rate_ci95": ([round(ci[0], 4), round(ci[1], 4)]
+                          if ci else None),
+            "verdict": self.verdict,
+            "decided_by": self.decided_by,
+            "llr_low": round(self.llr_low, 4),
+            "llr_high": round(self.llr_high, 4),
+            "max_runs": self.max_runs,
+        }
+
+    @classmethod
+    def replay(cls, outcomes: List[bool], **kwargs) -> "BandSPRT":
+        """A BandSPRT fed an outcome sequence (True = repro) — how the
+        progress surface re-derives the live band verdict from a
+        storage's completed runs, deterministically."""
+        t = cls(**kwargs)
+        for failed in outcomes:
+            t.update(bool(failed))
+        return t
+
+
+# -- forecasters -----------------------------------------------------------
+
+def runs_for_ci_width(rate: Optional[float],
+                      width: float = DEFAULT_CI_WIDTH,
+                      z: float = 1.96) -> Optional[int]:
+    """Expected total runs for the rate's 95% CI to shrink to
+    ``width``, from the normal-width inversion n = (2z/w)^2 p(1-p).
+    ``None`` when the estimate is degenerate (no rate yet, rate 0 or 1
+    — Wilson still shrinks there, but a variance-based forecast has
+    nothing to stand on) or the target width is not positive."""
+    if rate is None or width <= 0.0:
+        return None
+    var = rate * (1.0 - rate)
+    if var <= 0.0:
+        return None
+    return max(1, math.ceil((2.0 * z / width) ** 2 * var))
+
+
+def eta_next_repro_s(repros_per_hour: Optional[float]) -> Optional[float]:
+    """Expected seconds to the next reproduction at the measured pace;
+    ``None`` before any repro (no pace to extrapolate)."""
+    if not repros_per_hour or repros_per_hour <= 0.0:
+        return None
+    return round(3600.0 / repros_per_hour, 1)
+
+
+def eta_to_n_repros_s(repros_per_hour: Optional[float], current: int,
+                      target: int) -> Optional[float]:
+    """Expected seconds until the campaign holds ``target`` repros
+    (0.0 when already there; ``None`` with no measured pace)."""
+    if target <= current:
+        return 0.0
+    if not repros_per_hour or repros_per_hour <= 0.0:
+        return None
+    return round((target - current) * 3600.0 / repros_per_hour, 1)
+
+
+# -- the regime verdict ----------------------------------------------------
+
+#: completed runs below which no regime call is made: with fewer, the
+#: Wilson interval spans most of [0, 1] and any verdict is noise
+MIN_REGIME_RUNS = 8
+
+
+def regime_verdict(rate: Optional[float], runs: int,
+                   band: Tuple[float, float] = DEFAULT_BAND,
+                   digests_saturated_relations_growing: bool = False,
+                   min_runs: int = MIN_REGIME_RUNS) -> Dict[str, Any]:
+    """Does search pay on this workload, or does random suffice?
+
+    RESULTS.md's cross-scenario finding: searched schedules pay ~15x
+    where the random baseline's repro rate is rare (the band) and LOSE
+    where random trivially repros (the search spends its budget
+    re-finding what random stumbles into). The verdict combines the
+    measured baseline rate with the coverage plane's
+    ``digests_saturated_relations_growing`` flag — random replaying
+    known interleavings while the ordering frontier is open is the
+    strongest "search still has something to chase" signal there is.
+    """
+    lo, hi = band
+    if rate is None or runs < min_runs:
+        return {
+            "verdict": "insufficient_data",
+            "reason": (f"{runs} completed run(s) < {min_runs}; the rate "
+                       "interval is too wide to call a regime"),
+        }
+    if rate > hi:
+        reason = (f"baseline repro rate {rate:.3f} is above the "
+                  f"[{lo:g}, {hi:g}] band: random already reproduces "
+                  "the bug cheaply, a searched schedule has little to "
+                  "add")
+        if digests_saturated_relations_growing:
+            reason += (" (relation frontier is still open, but repros "
+                       "are not the bottleneck)")
+        return {"verdict": "random_suffices", "reason": reason}
+    reason = (f"baseline repro rate {rate:.3f} is "
+              + ("inside" if rate >= lo else "below")
+              + f" the [{lo:g}, {hi:g}] band: repros are rare under "
+              "random, the regime where searched schedules pay")
+    if digests_saturated_relations_growing:
+        reason += ("; digests have saturated while relations still "
+                   "grow — guided search has an open frontier")
+    return {"verdict": "search_pays", "reason": reason}

@@ -65,7 +65,8 @@ def kill_process_group(proc: subprocess.Popen,
 
 
 class CmdFactory:
-    def __init__(self, working_dir: str = "", materials_dir: str = ""):
+    def __init__(self, working_dir: str = "", materials_dir: str = "",
+                 extra_env: Optional[dict] = None):
         self.working_dir = working_dir
         self.materials_dir = materials_dir
         # when set, deadline-mode phases write their process-group id
@@ -74,9 +75,15 @@ class CmdFactory:
         # kill of this process — SIGKILL skips every finally, so the
         # group's pgid must already be on disk (doc/robustness.md)
         self.pgid_file: str = ""
+        # extra variables exported to every script — the calibration
+        # plane's knob transport (NMZ_CALIB_<NAME>, namazu_tpu/calibrate):
+        # a calibrated timing value reaches the experiment scripts as
+        # environment, never as an edited source constant
+        self.extra_env: dict = dict(extra_env or {})
 
     def env(self) -> dict:
         env = dict(os.environ)
+        env.update({str(k): str(v) for k, v in self.extra_env.items()})
         if self.working_dir:
             env["NMZ_WORKING_DIR"] = self.working_dir
             env["NMZ_TPU_WORKING_DIR"] = self.working_dir

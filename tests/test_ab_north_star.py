@@ -4,38 +4,35 @@ at least the random policy's, measured through the REAL experiment loop
 counterpart of the committed ABRESULT artifacts (BASELINE.md: the
 reference's product is its repro-rate table, README.md:41-65).
 
-Phase A records under a random config chosen to produce failures often
-enough for a bounded test (max_interval 500 ms can starve a decider
-directly, unlike the example's headline 400 ms config where random is in
-the rare-repro regime); phase B swaps in the example's tpu_search config,
-which trains on phase A's history.
+Phase A records under the example's own calibrated regime — the
+committed ``examples/zk-election/calibration.json`` artifact supplies
+both the rare-repro band and the knob values (``init`` ships the
+artifact with the storage, ``run`` exports ``NMZ_CALIB_*``), so this
+file carries no hand-tuned timing constants. Phase A is budgeted off
+the band (enough runs that a band-rate scenario shows repros) and
+early-stopped by the same BandSPRT the calibration harness uses; phase
+B swaps in the example's tpu_search config, which trains on phase A's
+history.
 """
 
+import math
 import os
 import shutil
 
 import pytest
 
+from namazu_tpu.calibrate.artifact import load_calibration
 from namazu_tpu.cli import cli_main
+from namazu_tpu.obs import stats
 from namazu_tpu.storage import load_storage
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLE = os.path.join(REPO, "examples", "zk-election")
 
-RECORD_CONFIG = """\
-explore_policy = "random"
-rest_port = 10982
-run = "sh $NMZ_MATERIALS_DIR/run.sh"
-validate = "sh $NMZ_MATERIALS_DIR/validate.sh"
-
-[explore_policy_param]
-min_interval = 0
-max_interval = 500
-seed = 0
-"""
-
-PHASE_A_RUNS = 10
-PHASE_B_MAX_RUNS = 8
+#: phase-A run cap: at the band's geometric-mid rate, two repros are
+#: expected well inside this budget; also the SPRT's cap
+PHASE_A_MAX_RUNS = 40
+PHASE_B_MAX_RUNS = 12
 
 
 # slow: the comparison is stochastic THROUGH the real timing-sensitive
@@ -47,35 +44,56 @@ PHASE_B_MAX_RUNS = 8
 # run this on a quiet machine: pytest tests/test_ab_north_star.py -m ''
 @pytest.mark.slow
 def test_tpu_search_repro_rate_at_least_random(tmp_path):
-    cfg = tmp_path / "config.toml"
-    cfg.write_text(RECORD_CONFIG)
+    calib = load_calibration(EXAMPLE)
+    if calib is None:
+        pytest.skip("no calibrated artifact for zk-election; run "
+                    "`nmz-tpu tools calibrate examples/zk-election`")
+    lo, hi = (float(x) for x in calib["band"])
     storage = str(tmp_path / "ab")
-    assert cli_main(["init", str(cfg),
+    assert cli_main(["init", os.path.join(EXAMPLE, "config.toml"),
                      os.path.join(EXAMPLE, "materials"), storage]) == 0
+    # the calibrated knobs travel with the storage and reach the
+    # experiment scripts as NMZ_CALIB_* on every `run`
+    assert load_calibration(storage) is not None
     st = load_storage(storage)
 
-    for _ in range(PHASE_A_RUNS):
+    # phase A under the calibrated random baseline, sized off the band:
+    # at the geometric-mid rate the expected runs to a repro is
+    # 1/sqrt(lo*hi), so the cap leaves room for two of them
+    max_a = min(PHASE_A_MAX_RUNS,
+                math.ceil(2.0 / math.sqrt(lo * hi)) + 2)
+    sprt = stats.BandSPRT(lo=lo, hi=hi, max_runs=max_a)
+    runs_a = repros_a = 0
+    while runs_a < max_a:
         assert cli_main(["run", storage]) == 0
-    repros_a = sum(not st.is_successful(i) for i in range(PHASE_A_RUNS))
+        failed = not st.is_successful(runs_a)
+        sprt.update(failed)
+        runs_a += 1
+        repros_a += int(failed)
+        # stop when the rate question is answered: the SPRT concluded
+        # with at least one repro recorded (the search needs a failure
+        # signature to train on), or two repros pin the estimate
+        if repros_a >= 2 or (sprt.verdict is not None and repros_a >= 1):
+            break
     if repros_a == 0:
-        # P ~ a few percent at calibration; without a recorded failure
-        # the search has no signature to chase and the comparison is
-        # undefined — the committed ABRESULT artifacts carry the metric
+        # P in the band per run; without a recorded failure the search
+        # has no signature to chase and the comparison is undefined —
+        # the committed ABRESULT artifacts carry the metric
         pytest.skip("random produced no repro in phase A on this machine")
-    rate_a = repros_a / PHASE_A_RUNS
+    rate_a = repros_a / runs_a
 
     shutil.copy(os.path.join(EXAMPLE, "config_tpu.toml"),
                 os.path.join(storage, "config.toml"))
     repros_b = 0
     for n in range(1, PHASE_B_MAX_RUNS + 1):
         assert cli_main(["run", storage]) == 0
-        repros_b = sum(not st.is_successful(PHASE_A_RUNS + i)
+        repros_b = sum(not st.is_successful(runs_a + i)
                        for i in range(n))
         if repros_b / n >= rate_a and repros_b >= 2:
             break
     assert repros_b / n >= rate_a, (
         f"tpu_search reproduced {repros_b}/{n}; random did "
-        f"{repros_a}/{PHASE_A_RUNS} — the searched schedule must not be "
+        f"{repros_a}/{runs_a} — the searched schedule must not be "
         "worse than the policy it trained on (measured 19/20 vs 1/20 at "
         "calibration, ABRESULT_r04.json)"
     )

@@ -1,0 +1,269 @@
+"""Calibration harness (ISSUE 17): ``[calibration]`` config parsing,
+the knob axis and its env transport, the bisection sweep with
+SPRT-early-stopped probes (synthetic runner), the >=30% run-savings
+ledger, the crash-safe probe journal, and the artifact's travel path
+(``init`` copies it into the storage, ``run`` exports the knobs)."""
+
+import json
+import os
+
+import pytest
+
+from namazu_tpu.calibrate import artifact
+from namazu_tpu.calibrate.harness import (
+    CalibrationError,
+    Calibrator,
+    CalibrationSpec,
+    KnobSpec,
+    parse_calibration,
+    synthetic_runner,
+)
+from namazu_tpu.utils.config import Config
+
+
+def _spec(lo=10.0, hi=1000.0, direction="up", **kw):
+    return CalibrationSpec(knobs=[KnobSpec("w", lo, hi,
+                                           direction=direction)], **kw)
+
+
+# -- config parsing --------------------------------------------------------
+
+
+def test_parse_calibration_table():
+    cfg = Config({"calibration": {
+        "band": [0.05, 0.2], "max_runs_per_probe": 25,
+        "knob": [{"name": "window_ms", "min": 100, "max": 900,
+                  "direction": "down"}],
+    }})
+    spec = parse_calibration(cfg)
+    assert spec.band == (0.05, 0.2)
+    assert spec.max_runs_per_probe == 25
+    k = spec.knobs[0]
+    assert k.name == "window_ms" and k.direction == "down"
+    assert (k.lo, k.hi) == (100.0, 900.0)
+
+
+def test_parse_calibration_rejects_malformed():
+    with pytest.raises(CalibrationError):
+        parse_calibration(Config({}))  # no table at all
+    with pytest.raises(CalibrationError):
+        parse_calibration(Config({"calibration": {"knob": []}}))
+    with pytest.raises(CalibrationError):
+        parse_calibration(Config({"calibration": {
+            "knob": [{"name": "w", "min": 1}]}}))  # max missing
+    with pytest.raises(CalibrationError):
+        parse_calibration(Config({"calibration": {
+            "band": [0.5, 0.1],
+            "knob": [{"name": "w", "min": 1, "max": 2}]}}))
+    with pytest.raises(CalibrationError):
+        KnobSpec("w", 10, 5)  # min >= max
+    with pytest.raises(CalibrationError):
+        KnobSpec("w", 1, 2, direction="sideways")
+
+
+def test_shipped_examples_declare_calibration():
+    root = os.path.join(os.path.dirname(__file__), "..", "examples")
+    for example, knob in (("flaky-init", "init_window_iters"),
+                          ("zk-election", "decision_window_ms")):
+        cfg = Config.from_file(os.path.join(root, example, "config.toml"))
+        spec = parse_calibration(cfg)
+        assert [k.name for k in spec.knobs] == [knob]
+        assert spec.band == (0.02, 0.10)
+
+
+# -- the knob axis ---------------------------------------------------------
+
+
+def test_knob_axis_log_space_and_direction():
+    up = KnobSpec("w", 10, 1000, direction="up")
+    assert up.value_at(0.0) == 10 and up.value_at(1.0) == 1000
+    assert up.value_at(0.5) == 100  # log-space midpoint, not 505
+    down = KnobSpec("w", 10, 1000, direction="down")
+    assert down.value_at(0.0) == 1000 and down.value_at(1.0) == 10
+    # effort is clamped, values stay in range
+    assert up.value_at(-3.0) == 10 and up.value_at(7.0) == 1000
+    frac = KnobSpec("w", 0.1, 10.0, integer=False)
+    assert frac.value_at(0.5) == 1.0
+
+
+# -- the artifact ----------------------------------------------------------
+
+
+def test_artifact_env_transport():
+    assert artifact.env_name("init_window_iters") \
+        == "NMZ_CALIB_INIT_WINDOW_ITERS"
+    env = artifact.knob_env({"knobs": {"iters": 400.0, "ratio": 1.5}})
+    # integral floats render as ints: scripts int() them blindly
+    assert env == {"NMZ_CALIB_ITERS": "400", "NMZ_CALIB_RATIO": "1.5"}
+
+
+def test_artifact_validate():
+    good = {"schema": artifact.SCHEMA, "knobs": {"w": 7},
+            "band": [0.02, 0.10]}
+    assert artifact.validate(good) is None
+    assert artifact.validate({**good, "schema": "v0"}) is not None
+    assert artifact.validate({**good, "knobs": {}}) is not None
+    assert artifact.validate({**good, "band": [0.1]}) is not None
+
+
+def test_load_calibration_paths(tmp_path):
+    doc = {"schema": artifact.SCHEMA, "knobs": {"w": 3},
+           "band": [0.02, 0.10]}
+    with open(tmp_path / "calibration.json", "w") as f:
+        json.dump(doc, f)
+    # a directory resolves to its calibration.json
+    assert artifact.load_calibration(str(tmp_path))["knobs"] == {"w": 3}
+    assert artifact.load_calibration(
+        str(tmp_path / "calibration.json"))["knobs"] == {"w": 3}
+    assert artifact.load_calibration(str(tmp_path / "missing")) is None
+    (tmp_path / "torn").write_text("{nope")
+    assert artifact.load_calibration(str(tmp_path / "torn")) is None
+
+
+# -- the sweep -------------------------------------------------------------
+
+
+def test_sweep_bisects_into_band(tmp_path):
+    # monotone synthetic scenario: rate = (w/1000)^3 — the midpoint
+    # (w=100) is far below the band, the top endpoint trivially repros,
+    # the in-band point sits between; the sweep must bisect to it
+    out = str(tmp_path / "calibration.json")
+    cal = Calibrator(_spec(), synthetic_runner(
+        lambda k: min(0.95, (k["w"] / 1000.0) ** 3), seed=7),
+        example="synthetic", seed=7, out_path=out)
+    doc = cal.run()
+    assert doc["status"] == "calibrated"
+    assert doc["verdict"] == "in_band" and doc["knobs"]["w"] > 100
+    assert 3 <= len(doc["probes"]) <= 8
+    # the artifact on disk is the returned doc, valid and loadable
+    assert artifact.validate(doc) is None
+    assert artifact.load_calibration(out) == doc
+    assert artifact.knob_env(doc) \
+        == {"NMZ_CALIB_W": str(doc["knobs"]["w"])}
+
+
+def test_sweep_savings_ledger(tmp_path):
+    cal = Calibrator(_spec(), synthetic_runner(
+        lambda k: min(0.95, (k["w"] / 1000.0) ** 3), seed=7),
+        out_path=str(tmp_path / "c.json"))
+    doc = cal.run()
+    # the whole point of the SPRT: sequential stopping beats the
+    # fixed-N test of equal discriminating power by >= 30% (CI gate)
+    assert doc["runs_spent"] < doc["fixed_n_equivalent"]
+    assert doc["runs_saved_pct"] >= 30.0
+    assert doc["runs_saved"] \
+        == doc["fixed_n_equivalent"] - doc["runs_spent"]
+
+
+def test_sweep_deterministic(tmp_path):
+    def run(seed):
+        return Calibrator(_spec(), synthetic_runner(
+            lambda k: min(0.95, (k["w"] / 1000.0) ** 3),
+            seed=seed)).run()
+
+    assert run(3) == run(3)  # same seed, same journal, same landing
+
+
+def test_sweep_unreachable_band_fails_with_journal(tmp_path):
+    out = str(tmp_path / "c.json")
+    cal = Calibrator(_spec(), synthetic_runner(lambda k: 0.0, seed=0),
+                     out_path=out)
+    doc = cal.run()
+    # even max effort cannot reach the band: failed, journal intact
+    assert doc["status"] == "failed" and doc["knobs"] == {}
+    assert len(doc["probes"]) == 2  # midpoint, then the top endpoint
+    assert [p["verdict"] for p in doc["probes"]] == ["below", "below"]
+    assert json.load(open(out))["status"] == "failed"
+    # and the consumption path refuses it: no knobs landed, nothing for
+    # `run` to export
+    assert artifact.load_calibration(out) is None
+
+
+def test_sweep_stops_on_quantize_collapse():
+    # a 2-value integer axis that jumps straight over the band: the
+    # bisection collapses to an already-probed point and must stop
+    spec = CalibrationSpec(knobs=[KnobSpec("w", 100, 101)])
+    cal = Calibrator(spec, synthetic_runner(
+        lambda k: 0.5 if k["w"] >= 101 else 0.0, seed=0))
+    doc = cal.run()
+    assert doc["status"] == "failed"
+    assert len(doc["probes"]) == 2
+
+
+def test_journal_survives_a_mid_sweep_crash(tmp_path):
+    out = str(tmp_path / "c.json")
+    calls = {"n": 0}
+
+    def crashy(values, sprt):
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise RuntimeError("probe infra died")
+        for _ in range(12):
+            sprt.update(False)  # probe 1: clean "below"-ish data
+
+    with pytest.raises(RuntimeError):
+        Calibrator(_spec(), crashy, out_path=out).run()
+    # the journal holds everything the crashed sweep learned
+    doc = json.load(open(out))
+    assert doc["status"] == "in_progress"
+    assert len(doc["probes"]) == 1 and doc["runs_spent"] == 12
+
+
+def test_probe_with_zero_runs_is_an_error():
+    cal = Calibrator(_spec(), lambda values, sprt: None)
+    with pytest.raises(CalibrationError):
+        cal.run()
+
+
+# -- the travel path -------------------------------------------------------
+
+
+def test_cmd_factory_extra_env_wins():
+    from namazu_tpu.utils.cmd import CmdFactory
+
+    os.environ["NMZ_CALIB_W"] = "1"
+    try:
+        env = CmdFactory(extra_env={"NMZ_CALIB_W": "7"}).env()
+        assert env["NMZ_CALIB_W"] == "7"  # probe env beats the ambient
+    finally:
+        del os.environ["NMZ_CALIB_W"]
+
+
+def test_init_ships_the_artifact_with_the_storage(tmp_path):
+    from namazu_tpu.cli import cli_main
+
+    example = tmp_path / "example"
+    materials = example / "materials"
+    materials.mkdir(parents=True)
+    (materials / "run.sh").write_text("true\n")
+    (example / "config.toml").write_text(
+        'run = "sh $NMZ_MATERIALS_DIR/run.sh"\n')
+    json.dump({"schema": artifact.SCHEMA, "knobs": {"w": 9},
+               "band": [0.02, 0.10], "status": "calibrated"},
+              open(example / "calibration.json", "w"))
+    storage = str(tmp_path / "storage")
+    assert cli_main(["init", str(example / "config.toml"),
+                     str(materials), storage]) == 0
+    calib = artifact.load_calibration(storage)
+    assert calib is not None and calib["knobs"] == {"w": 9}
+    assert artifact.knob_env(calib) == {"NMZ_CALIB_W": "9"}
+
+
+def test_tools_calibrate_rejects_bad_band(tmp_path, capsys):
+    from namazu_tpu.cli import cli_main
+
+    rc = cli_main(["tools", "calibrate", str(tmp_path),
+                   "--band", "bogus"])
+    assert rc == 2
+    assert "bad --band" in capsys.readouterr().err
+
+
+def test_tools_calibrate_requires_a_calibration_table(tmp_path, capsys):
+    from namazu_tpu.cli import cli_main
+
+    example = tmp_path / "bare"
+    (example / "materials").mkdir(parents=True)
+    (example / "config.toml").write_text('run = "true"\n')
+    rc = cli_main(["tools", "calibrate", str(example)])
+    assert rc == 2
+    assert "[calibration]" in capsys.readouterr().err

@@ -1,0 +1,381 @@
+"""Calibration & progress plane (ISSUE 17): the sequential binomial
+machinery (obs/stats.py), the repro-statistics boundaries (0/1 runs,
+all-fail/all-pass Wilson, quarantine exclusion), the progress document's
+no-NaN guarantee on young campaigns, the ``/analytics`` progress fold,
+the REST ``GET /progress`` route, and the ``tools top`` RATE/ETA
+columns."""
+
+import json
+import math
+import os
+import urllib.request
+
+import pytest
+
+from namazu_tpu.obs import analytics, metrics, recorder, report, stats
+from namazu_tpu.obs.metrics import MetricsRegistry
+from namazu_tpu.signal import PacketEvent
+from namazu_tpu.storage import new_storage
+from namazu_tpu.utils.trace import SingleTrace
+
+
+@pytest.fixture(autouse=True)
+def fresh_obs():
+    old_reg = metrics.set_registry(MetricsRegistry())
+    metrics.configure(True)
+    old_rec = recorder.set_recorder(recorder.FlightRecorder())
+    analytics.reset_stall_detector()
+    analytics.set_storage_dir(None)
+    yield
+    metrics.set_registry(old_reg)
+    metrics.configure(True)
+    recorder.set_recorder(old_rec)
+    analytics.reset_stall_detector()
+    analytics.set_storage_dir(None)
+
+
+def _trace(hints, entity="n0"):
+    t = SingleTrace()
+    for h in hints:
+        a = PacketEvent.create(entity, entity, "peer",
+                               hint=h).default_action()
+        a.mark_triggered()
+        t.append(a)
+    return t
+
+
+def _storage(tmp_path, outcomes, times=None, name="st"):
+    """A storage with the given run outcomes (True = success)."""
+    st = new_storage("naive", str(tmp_path / name))
+    st.create()
+    times = times or [1.0] * len(outcomes)
+    for i, (ok, t) in enumerate(zip(outcomes, times)):
+        st.create_new_working_dir()
+        st.record_new_trace(_trace([f"h{i}"]))
+        st.record_result(ok, t)
+    return st
+
+
+# -- Wilson boundaries -----------------------------------------------------
+
+
+def test_wilson_zero_and_one_run():
+    assert stats.wilson_interval(0, 0) == (0.0, 0.0)
+    lo, hi = stats.wilson_interval(0, 1)  # one pass: upside remains
+    assert lo == 0.0 and 0.0 < hi < 1.0
+    lo, hi = stats.wilson_interval(1, 1)  # one fail: downside remains
+    assert 0.0 < lo < 1.0 and hi == 1.0
+
+
+def test_wilson_all_fail_all_pass():
+    lo, hi = stats.wilson_interval(10, 10)
+    assert hi == 1.0 and 0.6 < lo < 1.0
+    lo, hi = stats.wilson_interval(0, 10)
+    assert lo == 0.0 and 0.0 < hi < 0.4
+    # interval is always inside [0, 1] and finite
+    for k, n in ((0, 0), (0, 1), (1, 1), (5, 5), (0, 1000), (999, 1000)):
+        lo, hi = stats.wilson_interval(k, n)
+        assert 0.0 <= lo <= hi <= 1.0
+        assert math.isfinite(lo) and math.isfinite(hi)
+
+
+# -- BandSPRT --------------------------------------------------------------
+
+
+def test_band_sprt_concludes_above_on_constant_failures():
+    s = stats.BandSPRT()
+    n = 0
+    while s.verdict is None:
+        s.update(True)
+        n += 1
+    assert s.verdict == "above" and s.decided_by == "sprt"
+    assert n < 10  # a trivially-reproducing probe is cheap
+
+
+def test_band_sprt_caps_to_point_estimate_on_all_passes():
+    # distinguishing near-zero from the band floor needs ~100+ runs;
+    # the cap answers with the point estimate and says so
+    s = stats.BandSPRT(max_runs=40)
+    for _ in range(40):
+        s.update(False)
+    assert s.verdict == "below" and s.decided_by == "cap"
+    assert s.runs == 40 and s.failures == 0
+
+
+def test_band_sprt_verdict_freezes_and_counts_stay_truthful():
+    s = stats.BandSPRT()
+    while s.verdict is None:
+        s.update(True)
+    verdict, runs = s.verdict, s.runs
+    for _ in range(10):
+        s.update(False)
+    assert s.verdict == verdict and s.decided_by == "sprt"
+    assert s.runs == runs + 10  # outcomes past the decision still count
+
+
+def test_band_sprt_replay_matches_incremental():
+    outcomes = [False] * 9 + [True] + [False] * 5 + [True, True, False]
+    inc = stats.BandSPRT(max_runs=18)
+    for o in outcomes:
+        inc.update(o)
+    assert stats.BandSPRT.replay(outcomes,
+                                 max_runs=18).to_jsonable() \
+        == inc.to_jsonable()
+
+
+def test_band_sprt_rejects_bad_parameters():
+    with pytest.raises(ValueError):
+        stats.BandSPRT(lo=0.1, hi=0.02)
+    with pytest.raises(ValueError):
+        stats.BandSPRT(alpha=0.0)
+    with pytest.raises(ValueError):
+        stats.BandSPRT(max_runs=0)
+
+
+# -- forecasters -----------------------------------------------------------
+
+
+def test_forecasters_degenerate_inputs_yield_none():
+    assert stats.runs_for_ci_width(None) is None
+    assert stats.runs_for_ci_width(0.0) is None  # no variance to shrink
+    assert stats.runs_for_ci_width(1.0) is None
+    assert stats.runs_for_ci_width(0.5, width=0.0) is None
+    assert stats.eta_next_repro_s(None) is None
+    assert stats.eta_next_repro_s(0.0) is None
+    assert stats.eta_to_n_repros_s(None, 0, 10) is None
+    assert stats.eta_to_n_repros_s(None, 10, 10) == 0.0  # already there
+
+
+def test_forecasters_nominal():
+    assert stats.eta_next_repro_s(12.0) == 300.0
+    assert stats.eta_to_n_repros_s(12.0, 3, 10) == 2100.0
+    # n = (2z/w)^2 p(1-p): more runs for a tighter target width
+    wide = stats.runs_for_ci_width(0.06, width=0.2)
+    tight = stats.runs_for_ci_width(0.06, width=0.05)
+    assert wide < tight
+
+
+# -- the regime verdict ----------------------------------------------------
+
+
+def test_regime_verdict_rules():
+    assert stats.regime_verdict(None, 0)["verdict"] == "insufficient_data"
+    assert stats.regime_verdict(0.5, 3)["verdict"] == "insufficient_data"
+    assert stats.regime_verdict(0.5, 20)["verdict"] == "random_suffices"
+    assert stats.regime_verdict(0.05, 20)["verdict"] == "search_pays"
+    assert stats.regime_verdict(0.0, 20)["verdict"] == "search_pays"
+    # the coverage flag strengthens the search-pays reasoning
+    v = stats.regime_verdict(0.05, 20,
+                             digests_saturated_relations_growing=True)
+    assert v["verdict"] == "search_pays" and "frontier" in v["reason"]
+
+
+# -- reproduction statistics at the boundaries -----------------------------
+
+
+def test_reproduction_stats_zero_and_one_run(tmp_path):
+    st0 = _storage(tmp_path, [], name="zero")
+    rep = analytics.reproduction_stats(st0)
+    assert rep["runs"] == 0 and rep["failure_rate"] == 0.0
+    assert rep["repros_per_hour"] == 0.0
+    assert rep["mean_runs_to_reproduce"] is None
+    st0.close()
+
+    st1 = _storage(tmp_path, [True], name="one")
+    rep = analytics.reproduction_stats(st1)
+    assert rep["runs"] == 1 and rep["failures"] == 0
+    assert rep["time_to_first_failure_s"] is None
+    st1.close()
+
+
+def test_repros_per_hour_excludes_quarantined(tmp_path):
+    st = _storage(tmp_path, [False, True], times=[10.0] * 2)
+    # a crashed slot mid-campaign: its partial state must not count as
+    # a reproduction nor contribute run time to the pace
+    st.create_new_working_dir()
+    st.record_new_trace(_trace(["crash"]))
+    st.quarantine_current_run("crashed")
+    st.create_new_working_dir()
+    st.record_new_trace(_trace(["tail"]))
+    st.record_result(False, 10.0)
+    rep = analytics.reproduction_stats(st)
+    assert rep["runs"] == 3 and rep["runs_quarantined"] == 1
+    assert rep["failures"] == 2
+    assert rep["total_time_s"] == 30.0
+    assert rep["repros_per_hour"] == round(2 / (30.0 / 3600.0), 1)
+    assert analytics._run_outcomes(st) == [True, False, True]
+    st.close()
+
+
+# -- the progress document -------------------------------------------------
+
+
+def test_progress_stats_zero_runs_is_json_clean():
+    doc = analytics.progress_stats(analytics._EmptyStorage())
+    json.dumps(doc, allow_nan=False)  # no NaN, no Infinity, ever
+    assert doc["runs"] == 0 and doc["repro_rate"] is None
+    assert doc["eta_next_repro_s"] is None
+    assert doc["band_verdict"] == "undecided"
+    assert doc["regime"]["verdict"] == "insufficient_data"
+
+
+def test_progress_stats_young_campaign_no_div_zero(tmp_path):
+    # 1 completed run, no failures: every ratio-shaped field must be
+    # None or 0, never a ZeroDivisionError or NaN
+    st = _storage(tmp_path, [True], times=[0.0])
+    doc = analytics.progress_stats(st)
+    json.dumps(doc, allow_nan=False)
+    assert doc["runs"] == 1 and doc["failures"] == 0
+    assert doc["repros_per_hour"] is None
+    assert doc["runs_to_ci_width"] is None  # no failures -> no variance
+    st.close()
+
+
+def test_progress_stats_live_fields(tmp_path):
+    st = _storage(tmp_path, [True, False] + [True] * 18,
+                  times=[10.0] * 20)
+    doc = analytics.progress_stats(st)
+    assert doc["repro_rate"] == 0.05
+    assert doc["repros_per_hour"] == 18.0
+    assert doc["eta_next_repro_s"] == 200.0
+    assert doc["runs_to_ci_width"]["runs"] >= doc["runs"] - 20
+    assert doc["band"] == [0.02, 0.10]
+    assert doc["band_source"] == "default"
+    assert doc["regime"]["verdict"] == "search_pays"
+    st.close()
+
+
+def test_progress_stats_consumes_calibration_and_checkpoint(tmp_path):
+    st = _storage(tmp_path, [False] * 3 + [True] * 7, times=[2.0] * 10)
+    calib = {"schema": "nmz-calib-v1", "status": "calibrated",
+             "band": [0.1, 0.5], "knobs": {"w": 7}, "rate": 0.3,
+             "rate_ci95": [0.2, 0.4], "runs_saved_pct": 55.0}
+    ckpt = {"requested_runs": 20,
+            "slots": [{"slot": i, "class": "experiment"}
+                      for i in range(10)],
+            "stopped_reason": None}
+    doc = analytics.progress_stats(st, calibration=calib,
+                                   checkpoint=ckpt)
+    assert doc["band"] == [0.1, 0.5]
+    assert doc["band_source"] == "calibration"
+    assert doc["calibration"]["knobs"] == {"w": 7}
+    camp = doc["campaign"]
+    assert camp["requested_runs"] == 20 and camp["completed_slots"] == 10
+    # 10 remaining slots at 2 s measured mean
+    assert camp["eta_completion_s"] == 20.0
+    st.close()
+
+
+# -- the /analytics fold ---------------------------------------------------
+
+
+def test_compute_payload_fold_is_file_driven(tmp_path):
+    st = _storage(tmp_path, [False, True, True, True])
+    # no calibration.json / campaign.json in the dir: no progress key —
+    # golden and parity payloads render unchanged
+    doc = analytics.compute_payload(storage=st, publish=False)
+    assert "progress" not in doc
+    with open(os.path.join(st.dir, "calibration.json"), "w") as f:
+        json.dump({"schema": "nmz-calib-v1", "band": [0.02, 0.10],
+                   "knobs": {"w": 3}, "status": "calibrated"}, f)
+    doc = analytics.compute_payload(storage=st, publish=False)
+    assert doc["progress"]["band_source"] == "calibration"
+    json.dumps(doc, allow_nan=False)
+    # deterministic: same inputs, same document (the parity invariant)
+    assert doc == analytics.compute_payload(storage=st, publish=False)
+    st.close()
+
+
+def test_progress_fold_publishes_campaign_gauges(tmp_path):
+    from namazu_tpu.obs import spans
+
+    st = _storage(tmp_path, [False] * 2 + [True] * 8, times=[5.0] * 10)
+    with open(os.path.join(st.dir, "campaign.json"), "w") as f:
+        json.dump({"requested_runs": 10, "slots": []}, f)
+    analytics.compute_payload(storage=st, publish=True)
+    st.close()
+    doc = metrics.registry().to_jsonable()
+    gauges = {m["name"]: m for m in doc["metrics"]}
+    assert spans.CAMPAIGN_RATE in gauges
+    assert spans.CAMPAIGN_REPROS_PER_HOUR in gauges
+
+
+def test_torn_calibration_file_degrades_not_fails(tmp_path):
+    st = _storage(tmp_path, [True, False])
+    with open(os.path.join(st.dir, "calibration.json"), "w") as f:
+        f.write("{torn")
+    doc = analytics.compute_payload(storage=st, publish=False)
+    assert "progress" not in doc  # unreadable artifact = no fold
+    st.close()
+
+
+# -- the live surfaces -----------------------------------------------------
+
+
+def test_progress_payload_without_storage_is_zero_run():
+    doc = analytics.progress_payload()
+    json.dumps(doc, allow_nan=False)
+    assert doc["schema"] == "nmz-progress-v1"
+    assert doc["runs"] == 0 and doc["storage"] is None
+
+
+def test_rest_progress_route(tmp_path):
+    from namazu_tpu.orchestrator import Orchestrator
+    from namazu_tpu.policy import create_policy
+    from namazu_tpu.utils.config import Config
+
+    st = _storage(tmp_path, [False, True, True, True], times=[2.0] * 4)
+    st.close()
+    analytics.set_storage_dir(str(tmp_path / "st"))
+    cfg = Config({"rest_port": 0, "run_id": "progress-e2e"})
+    orc = Orchestrator(cfg, create_policy("dumb"))
+    orc.start()
+    try:
+        port = orc.hub.endpoint("rest").port
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/progress", timeout=10) as r:
+            doc = json.loads(r.read())
+    finally:
+        orc.shutdown()
+    assert doc["schema"] == "nmz-progress-v1"
+    assert doc["runs"] == 4 and doc["failures"] == 1
+    assert doc["repro_rate"] == 0.25
+    assert doc["repros_per_hour"] == 450.0
+
+
+def test_report_renders_progress_section(tmp_path):
+    st = _storage(tmp_path, [False] + [True] * 9, times=[3.0] * 10)
+    with open(os.path.join(st.dir, "calibration.json"), "w") as f:
+        json.dump({"schema": "nmz-calib-v1", "band": [0.02, 0.10],
+                   "knobs": {"window": 420}, "status": "calibrated",
+                   "rate": 0.06, "rate_ci95": [0.02, 0.1],
+                   "runs_saved_pct": 61.0}, f)
+    payload = analytics.compute_payload(storage=st, publish=False)
+    st.close()
+    text = report.render_markdown(payload)
+    assert "## Calibration & progress" in text
+    assert "window=420" in text
+    assert "61" in text
+    # and the section is absent without the fold
+    assert "## Calibration & progress" not in report.render_markdown(
+        {k: v for k, v in payload.items() if k != "progress"})
+
+
+def test_tools_top_rate_and_eta_columns():
+    from namazu_tpu.cli.tools_cmd import render_top
+
+    payload = {
+        "instance_count": 1, "stale_instances": 0,
+        "fleet_table_version": 0,
+        "instances": [{
+            "job": "campaign", "instance": "pid-1",
+            "events_per_sec": 10.0, "events_total": 100,
+            "last_seen_age_s": 0.5, "stale": False,
+            "repro_rate": 0.06, "eta_next_repro_s": 120.0,
+        }],
+    }
+    text = render_top(payload)
+    header = text.splitlines()[0]
+    assert "RATE" in header and "ETA" in header
+    row = text.splitlines()[1]
+    assert "0.06" in row and "120" in row
