@@ -171,6 +171,88 @@ def append_history(record: dict, path: str = HISTORY_PATH) -> None:
         f.write(json.dumps(record, sort_keys=True) + "\n")
 
 
+def _profiles_path(history_path: str) -> str:
+    """Sidecar file to the bench history: the last healthy run's
+    sampling profile per (metric, platform, transport mode) — what a
+    failing ``--gate`` diffs against so the failure NAMES the
+    regressing frames instead of just quoting a number."""
+    return history_path + ".profiles.json"
+
+
+def _profile_key(record: dict) -> str:
+    return "|".join((_record_metric(record),
+                     str(record.get("platform")),
+                     str(record.get("transport_mode")
+                         or record.get("mode") or "")))
+
+
+def load_baseline_profile(record: dict,
+                          history_path: str = HISTORY_PATH):
+    try:
+        with open(_profiles_path(history_path)) as f:
+            doc = json.load(f)
+        return doc.get(_profile_key(record)) \
+            if isinstance(doc, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def store_baseline_profile(record: dict, prof: dict,
+                           history_path: str = HISTORY_PATH) -> None:
+    """Record ``prof`` (an ``nmz-profile-v1`` payload) as the baseline
+    profile for ``record``'s gate key — called after a healthy
+    (gate-passing or ungated) non-smoke pipeline round."""
+    path = _profiles_path(history_path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict):
+            doc = {}
+    except (OSError, ValueError):
+        doc = {}
+    doc[_profile_key(record)] = prof
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def emit_gate_profdiff(record: dict, prof,
+                       history_path: str = HISTORY_PATH):
+    """A failed ``--gate`` should say WHERE the time went: diff this
+    run's profile against the stored baseline profile and write the
+    ranked self-time frame deltas beside the history (JSON + text),
+    echoing the top entries to stderr. Returns the artifact path, or
+    None when either profile is missing (profiler off, first gated
+    round). Never raises — the gate's exit code is the contract."""
+    try:
+        base = load_baseline_profile(record, history_path)
+        if not base or not prof:
+            print("# gate profdiff: no stored baseline profile or "
+                  "profiler off; cannot name regressing frames",
+                  file=sys.stderr)
+            return None
+        from namazu_tpu.obs import profdiff as _profdiff
+
+        d = _profdiff.diff(base, prof)
+        out_path = history_path + ".gate_profdiff.json"
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(d, f)
+            f.write("\n")
+        os.replace(tmp, out_path)
+        with open(history_path + ".gate_profdiff.txt", "w") as f:
+            f.write(_profdiff.render_text(d) + "\n")
+        print(f"# gate profdiff written: {out_path}", file=sys.stderr)
+        for line in _profdiff.render_text(d, limit=5).splitlines():
+            print(f"# {line}", file=sys.stderr)
+        return out_path
+    except Exception as e:
+        print(f"# gate profdiff failed: {e}", file=sys.stderr)
+        return None
+
+
 #: the scorer bench's metric name — also the implied metric of history
 #: records that predate the ``metric`` field
 SCORER_METRIC = "interleavings_scored_per_sec_per_chip"
@@ -226,10 +308,13 @@ def gate_record(current: dict, history: list,
     # loop (score+select+mutate in one scan'd dispatch) and the plain
     # scorer chain time DIFFERENT work per schedule — a fused figure
     # must never baseline an unfused one, in either direction
+    # "profile" joined with the profiling plane: the sampling profiler
+    # rides the pipeline bench by default (budgeted <=2%), and the
+    # --no-profile A/B figure must never cross-gate a profiled one
     CONFIG_KEYS = ("n_events", "n_entities", "batch_max",
                    "flush_window", "poll_linger", "gc_disabled",
                    "telemetry", "codec", "edge_shards", "edge_events",
-                   "runs", "fused")
+                   "runs", "fused", "profile")
 
     def _mode(rec):
         return rec.get("transport_mode") or rec.get("mode")
@@ -685,9 +770,24 @@ def pipeline_main(args: argparse.Namespace) -> None:
     # --no-telemetry measures the disabled plane — one global read on
     # the relay seams, the obs_enabled cost contract.
     telemetry_on = not getattr(args, "no_telemetry", False)
-    from namazu_tpu.obs import federation
+    from namazu_tpu.obs import federation, profiling
 
     federation.configure(telemetry_on)
+    # the sampling profiler rides the bench like production: always-on
+    # is the plane's design contract (doc/observability.md
+    # "Profiling"), and --no-profile is the A/B arm of its <=2%
+    # overhead budget. A gate config key like telemetry — profiled and
+    # unprofiled figures never cross-compare.
+    profile_on = not getattr(args, "no_profile", False)
+    if profile_on:
+        profiling.ensure_profiler("bench")
+    # seeded fault plans reach the bench like any other process class
+    # (doc/robustness.md): a no-op unless NMZ_CHAOS is set. CI's
+    # seeded-slowdown smoke leans on this — inject a stage slowdown
+    # into one arm and profdiff it against a clean arm.
+    from namazu_tpu import chaos as _chaos
+
+    _chaos.install_from_env()
     edge_shards = max(0, int(getattr(args, "edge_shards", 0)))
     runs = max(1, int(getattr(args, "runs", 1)))
     if runs > 1:
@@ -706,6 +806,7 @@ def pipeline_main(args: argparse.Namespace) -> None:
         "flush_window": args.flush_window,
         "poll_linger": args.poll_linger,
         "telemetry": telemetry_on,
+        "profile": profile_on,
         "codec": args.codec,
         "edge_shards": edge_shards,
         "edge_events": edge_events,
@@ -810,6 +911,8 @@ def pipeline_main(args: argparse.Namespace) -> None:
         # relay ran during the timed window (the gate must not compare
         # relay-on vs relay-off records, however small the budgeted gap)
         "telemetry": telemetry_on,
+        # same again for the sampling profiler (the --no-profile A/B)
+        "profile": profile_on,
         "batch_max": args.batch_max,
         "flush_window": args.flush_window,
         "poll_linger": args.poll_linger,
@@ -827,6 +930,7 @@ def pipeline_main(args: argparse.Namespace) -> None:
         record["edge_speedup_vs_batched"] = \
             out["edge_speedup_vs_batched"]
         record["batched_events_per_sec"] = out["batched_events_per_sec"]
+    prof_payload = _capture_bench_profile(args, profile_on)
     if not args.smoke:
         try:
             append_history(record, args.history)
@@ -840,11 +944,46 @@ def pipeline_main(args: argparse.Namespace) -> None:
                        "baseline": baseline, "reasons": reasons}
         print(json.dumps(out))
         if not ok:
+            # name the regressing frames, not just the number
+            emit_gate_profdiff(record, prof_payload, args.history)
             for reason in reasons:
                 print(f"# GATE FAILED: {reason}", file=sys.stderr)
             raise SystemExit(1)
+        if prof_payload and not args.smoke:
+            store_baseline_profile(record, prof_payload, args.history)
         return
     print(json.dumps(out))
+    if prof_payload and not args.smoke:
+        store_baseline_profile(record, prof_payload, args.history)
+
+
+def _capture_bench_profile(args, profile_on: bool):
+    """Drain + snapshot the bench's own sampling profile after the
+    measured runs: returns the ``nmz-profile-v1`` payload (None when
+    off) and honors ``--profile-out`` (speedscope JSON artifact — the
+    flamegraph CI uploads from the pipeline smoke)."""
+    if not profile_on:
+        return None
+    from namazu_tpu.obs import profiling
+
+    prof = profiling.profiler()
+    if prof is not None:
+        prof.drain()  # fold the tail so short smokes aren't empty
+    payload = profiling.payload()
+    out_path = getattr(args, "profile_out", None)
+    if out_path:
+        doc = profiling.speedscope_doc()
+        if doc is not None:
+            try:
+                with open(out_path, "w") as f:
+                    json.dump(doc, f)
+                    f.write("\n")
+                print(f"# profile written: {out_path}",
+                      file=sys.stderr)
+            except OSError as e:
+                print(f"# could not write profile: {e}",
+                      file=sys.stderr)
+    return payload
 
 
 def multi_run_main(args: argparse.Namespace, runs: int,
@@ -854,6 +993,7 @@ def multi_run_main(args: argparse.Namespace, runs: int,
     concurrent namespaced batched pipelines on ONE orchestrator,
     reported per-run + aggregate and gated under its own ``runs``
     config key (multi-run figures never baseline single-run ones)."""
+    profile_on = not getattr(args, "no_profile", False)
     edge = bool(args.edge or args.pipeline_mode == "edge")
     edge_shards = max(0, int(getattr(args, "edge_shards", 0)))
     edge_events = n_events if args.smoke or not args.edge_events \
@@ -891,6 +1031,7 @@ def multi_run_main(args: argparse.Namespace, runs: int,
         "flush_window": args.flush_window,
         "poll_linger": args.poll_linger,
         "telemetry": telemetry_on,
+        "profile": profile_on,
         "codec": args.codec,
         "value": round(aggregate, 1),
         "transport_mode": "edge" if edge_agg is not None else "batched",
@@ -932,6 +1073,7 @@ def multi_run_main(args: argparse.Namespace, runs: int,
         "n_entities": n_entities,
         "gc_disabled": True,
         "telemetry": telemetry_on,
+        "profile": profile_on,
         "batch_max": args.batch_max,
         "flush_window": args.flush_window,
         "poll_linger": args.poll_linger,
@@ -939,6 +1081,7 @@ def multi_run_main(args: argparse.Namespace, runs: int,
         "unit": out["unit"],
         "platform": out["platform"],
     }
+    prof_payload = _capture_bench_profile(args, profile_on)
     if not args.smoke:
         try:
             append_history(record, args.history)
@@ -952,11 +1095,16 @@ def multi_run_main(args: argparse.Namespace, runs: int,
                        "baseline": baseline, "reasons": reasons}
         print(json.dumps(out))
         if not ok:
+            emit_gate_profdiff(record, prof_payload, args.history)
             for reason in reasons:
                 print(f"# GATE FAILED: {reason}", file=sys.stderr)
             raise SystemExit(1)
+        if prof_payload and not args.smoke:
+            store_baseline_profile(record, prof_payload, args.history)
         return
     print(json.dumps(out))
+    if prof_payload and not args.smoke:
+        store_baseline_profile(record, prof_payload, args.history)
 
 
 def parse_args(argv=None) -> argparse.Namespace:
@@ -1045,6 +1193,17 @@ def parse_args(argv=None) -> argparse.Namespace:
                          "(grouped verdicts; reports per-shard and "
                          "aggregate events/s, 1M-criterion gated); "
                          "0 = the round-7/8 per-entity dispatchers")
+    ap.add_argument("--no-profile", action="store_true",
+                    help="with --pipeline: run WITHOUT the sampling "
+                         "profiler (the A/B arm of its <=2% overhead "
+                         "budget, doc/observability.md \"Profiling\"); "
+                         "records carry `profile` so the gate never "
+                         "compares across the switch")
+    ap.add_argument("--profile-out", default="", metavar="PATH",
+                    help="with --pipeline: write the bench process's "
+                         "sampling profile as speedscope JSON to PATH "
+                         "after the run (the flamegraph artifact CI "
+                         "uploads from the pipeline smoke)")
     ap.add_argument("--no-telemetry", action="store_true",
                     help="with --pipeline: disable the fleet-telemetry "
                          "relay for the timed window (the no-op-plane "

@@ -309,6 +309,11 @@ class Campaign:
         # collector occupancy): its registry merges straight into the
         # local aggregator it hosts
         federation.ensure_self_relay("campaign")
+        # continuous profiling: the supervisor samples itself too, so
+        # `tools top` shows where campaign overhead goes between slots
+        from namazu_tpu.obs import profiling
+
+        profiling.ensure_profiler("campaign")
         log.info("fleet view: nmz-tpu tools top --url uds://%s", path)
 
     def _stop_telemetry(self) -> None:

@@ -37,6 +37,7 @@ from namazu_tpu.chaos.plan import FaultPlan
 __all__ = [
     "FaultPlan", "ENV_VAR", "decide", "enabled", "plan",
     "install", "clear", "install_from_env", "env_value",
+    "stage_slowdown",
 ]
 
 #: the cross-process channel: a JSON {"seed": S, "faults": {...}}
@@ -60,6 +61,27 @@ def decide(point: str) -> Optional[Dict[str, Any]]:
     if p is None:
         return None
     return p.decide(point)
+
+
+def stage_slowdown(point: str = "orchestrator.stage.slow") -> None:
+    """Profiling-plane seeded slowdown (doc/observability.md
+    "Profiling"): a fault at ``point`` parks the calling stage inside
+    the distinctively-named frame below, which the sampling profiler
+    must localize as the #1 profdiff entry against a clean run — the
+    CI seeded-slowdown smoke. Disabled = the one global read of
+    :func:`decide`."""
+    fault = decide(point)
+    if fault is not None:
+        _chaos_injected_stage_slowdown(
+            float(fault.get("delay_s", 0.002)))
+
+
+def _chaos_injected_stage_slowdown(delay_s: float) -> None:
+    # a deliberate sleep under a name no real code path shares, so the
+    # profiler's collapsed stacks pin the injected time to THIS frame
+    import time
+
+    time.sleep(max(0.0, delay_s))
 
 
 def install(new_plan: FaultPlan) -> FaultPlan:

@@ -136,6 +136,11 @@ def _make_transceiver(args, default_entity: str):
     federation.ensure_self_relay(
         "inspector", push_url=push_url,
         instance=federation.default_instance(entity))
+    # continuous profiling: the inspector's profile (edge decide /
+    # release hot paths) rides the same relay as a delta payload
+    from namazu_tpu.obs import profiling
+
+    profiling.ensure_profiler("inspector")
     return new_transceiver(
         url, entity,
         edge=bool(getattr(args, "edge", False)),

@@ -161,6 +161,10 @@ def run(args) -> int:
                   or os.environ.get("NMZ_TELEMETRY_URL", "")
                   or str(cfg.get("telemetry_url", "") or "")),
         interval_s=float(cfg.get("telemetry_interval_s", 2.0) or 2.0))
+    # continuous profiling (doc/observability.md "Profiling"): same
+    # claim-before-the-orchestrator rule as the relay above, so the
+    # profile rides this child's telemetry as job "run"
+    obs.profiling.ensure_profiler("run", cfg=cfg)
 
     run_deadline = _deadline(args.run_deadline, cfg, "run_deadline_s")
     validate_deadline = _deadline(args.validate_deadline, cfg,

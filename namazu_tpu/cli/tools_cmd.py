@@ -183,6 +183,27 @@ def register(sub) -> None:
                           "of the table")
     ptp.set_defaults(func=top)
 
+    ppd = tsub.add_parser(
+        "profdiff",
+        help="differential profiling (doc/observability.md "
+             "\"Profiling\"): align two sampling profiles — files "
+             "(nmz-profile-v1 JSON, speedscope JSON, or collapsed "
+             "folded text) or live obs endpoints (http:// / uds:// / "
+             "tcp://) — and rank frames by self-time share delta; "
+             "the #1 entry names what got slower between A and B",
+    )
+    ppd.add_argument("profile_a",
+                     help="baseline profile: a file or a live obs url")
+    ppd.add_argument("profile_b",
+                     help="candidate profile: a file or a live obs url")
+    ppd.add_argument("--format", choices=("text", "md", "json"),
+                     default="text", help="output rendering")
+    ppd.add_argument("--limit", type=int, default=15,
+                     help="frames shown (text/md; default 15)")
+    ppd.add_argument("--out", default="",
+                     help="write to this file instead of stdout")
+    ppd.set_defaults(func=profdiff_cmd)
+
     pt = tsub.add_parser(
         "trace",
         help="flight-recorder traces (doc/observability.md): list "
@@ -454,6 +475,20 @@ def _fmt_codec(by_codec: dict) -> Optional[str]:
     return f"{top}+" if len(by_codec) > 1 else top
 
 
+def _fmt_prof(frame, share) -> Optional[str]:
+    """The dominant self-time frame of one instance's sampling profile
+    (obs/profiling.py via the federated profile delta), rendered
+    ``file.py:func(NN%)`` — the basename keeps the column narrow."""
+    if not frame:
+        return None
+    short = str(frame).rsplit("/", 1)[-1]
+    try:
+        pct = f"({float(share) * 100:.0f}%)" if share is not None else ""
+    except (TypeError, ValueError):
+        pct = ""
+    return f"{short}{pct}"
+
+
 def _fmt_hot_stage(stage_p99: dict) -> Optional[str]:
     """The dominant lifecycle segment of one instance — the stage with
     the largest federated p99 from ``nmz_event_stage_seconds``
@@ -489,13 +524,19 @@ def render_top(payload: dict) -> str:
         # next-repro ETA forecast
         ("repro_rate", "RATE", ""),
         ("eta_next_repro_s", "ETA", "s"),
+        # dominant self-time frame from the instance's continuous
+        # sampling profile (obs/profiling.py; doc/observability.md
+        # "Profiling")
+        ("prof", "PROF", ""),
         ("last_seen_age_s", "AGE", "s"), ("stale", "STALE", ""),
     )
     rows = [[header for _, header, _ in cols]]
     for inst in payload.get("instances", []):
         inst = dict(inst,
                     hot_stage=_fmt_hot_stage(inst.get("stage_p99_s")),
-                    codec=_fmt_codec(inst.get("wire_bytes_by_codec")))
+                    codec=_fmt_codec(inst.get("wire_bytes_by_codec")),
+                    prof=_fmt_prof(inst.get("prof_top_frame"),
+                                   inst.get("prof_top_share")))
         rows.append([_fmt_cell(inst.get(key), unit)
                      for key, _, unit in cols])
     widths = [max(len(r[i]) for r in rows) for i in range(len(cols))]
@@ -534,6 +575,33 @@ def render_top(payload: dict) -> str:
                          f"{_fmt_cell(row.get('breached', False)):<10}"
                          f"{_fmt_cell(row.get('breaches', 0))}")
     return "\n".join(lines) + "\n"
+
+
+def profdiff_cmd(args) -> int:
+    """Differential profiling (obs/profdiff.py): load two profiles
+    from files or live obs endpoints and rank frames by self-time
+    share delta."""
+    from namazu_tpu.obs import profdiff
+
+    try:
+        a = profdiff.load_profile(args.profile_a)
+        b = profdiff.load_profile(args.profile_b)
+    except (OSError, ValueError, RuntimeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    d = profdiff.diff(a, b)
+    if args.format == "json":
+        text = json.dumps(d, sort_keys=True) + "\n"
+    elif args.format == "md":
+        text = profdiff.render_md(d, limit=args.limit)
+    else:
+        text = profdiff.render_text(d, limit=args.limit)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+    return 0
 
 
 def top(args) -> int:

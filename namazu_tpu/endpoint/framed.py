@@ -274,6 +274,9 @@ class FramedServer:
     # -- selector core -----------------------------------------------------
 
     def _selector_loop(self) -> None:
+        from namazu_tpu.obs import profiling
+
+        profiling.tag_current_thread("wire")
         sel = selectors.DefaultSelector()
         srv = self._server
         if srv is None:
@@ -411,6 +414,12 @@ class FramedServer:
     # -- workers -----------------------------------------------------------
 
     def _worker_loop(self) -> None:
+        # profiling plane: a worker parked on the task queue has no
+        # namazu frame on its stack — pin it to the wire plane so its
+        # samples classify (obs/profiling.py taxonomy)
+        from namazu_tpu.obs import profiling
+
+        profiling.tag_current_thread("wire")
         while True:
             task = self._work.get()
             if task is None:

@@ -959,6 +959,8 @@ class RestEndpoint(QueuedEndpoint):
                     return self._get_progress()
                 if url.path == "/fleet":
                     return self._get_fleet(parse_qs(url.query))
+                if url.path == "/profile":
+                    return self._get_profile(parse_qs(url.query))
                 if _POLICY_TABLE_RE.match(url.path):
                     return self._get_policy_table()
                 m = _TRACES_RE.match(url.path)
@@ -1107,6 +1109,37 @@ class RestEndpoint(QueuedEndpoint):
                     return self._reply(
                         500, {"error": f"fleet failed: {e}"})
                 self._reply(200, payload)
+
+            def _get_profile(self, query) -> None:
+                """Profiling surface (obs/profiling.py): this process's
+                sampling profile — speedscope JSON by default (open the
+                body in speedscope.app), ``?format=collapsed`` for
+                folded flamegraph text, ``?format=json`` for the raw
+                ``nmz-profile-v1`` payload profdiff consumes. 404 when
+                the profiler is off (``profile_enabled = false`` /
+                ``NMZ_PROFILE=0`` / obs disabled)."""
+                fmt = (query.get("format") or ["speedscope"])[0]
+                if fmt not in ("speedscope", "collapsed", "json"):
+                    return self._reply(
+                        400, {"error": f"unknown format {fmt!r}; known: "
+                              "speedscope, collapsed, json"})
+                try:
+                    if not obs.profiling.enabled():
+                        return self._reply(
+                            404, {"error": "profiler disabled in this "
+                                  "process (profile_enabled=false, "
+                                  "NMZ_PROFILE=0, or obs off)"})
+                    if fmt == "collapsed":
+                        return self._reply_raw(
+                            200, obs.profile_collapsed().encode(),
+                            "text/plain; charset=utf-8")
+                    if fmt == "json":
+                        return self._reply(200, obs.profile_payload())
+                    return self._reply(200, obs.profile_speedscope())
+                except Exception as e:  # never let a profile bug kill ops
+                    log.exception("profile payload failed")
+                    return self._reply(
+                        500, {"error": f"profile failed: {e}"})
 
             def _get_causality(self, run_a, run_b, query) -> None:
                 """Causality surface (obs/causality.py): one run's

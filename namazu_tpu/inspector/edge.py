@@ -581,6 +581,11 @@ class EdgeDispatcher:
                 self._release_thread = t
 
     def _release_loop(self) -> None:
+        # profiling plane: a worker parked on the heap condition shows
+        # no classifiable frame — pin it to the edge plane
+        from namazu_tpu.obs import profiling
+
+        profiling.tag_current_thread("edge")
         while True:
             if chaos.decide("edge.shard.die") is not None:
                 # simulated shard-worker death: the thread exits, the
@@ -620,6 +625,9 @@ class EdgeDispatcher:
                 self._bh_thread = t
 
     def _backhaul_loop(self) -> None:
+        from namazu_tpu.obs import profiling
+
+        profiling.tag_current_thread("edge")
         backoff = 0.0
         while True:
             if chaos.decide("edge.shard.die") is not None:

@@ -74,6 +74,15 @@ class SearchConfig(NamedTuple):
     # DCN ring every dcn_migrate_every (1 = the pre-cadence behavior)
     migrate_every: int = 1
     dcn_migrate_every: int = 1
+    # device-trace capture knob (doc/observability.md "Profiling"):
+    # when non-empty, the FIRST fused run() of this search records a
+    # jax.profiler device trace of its evolve section into
+    # <device_trace_dir>/device_trace (open in perfetto / xprof) —
+    # one capture per search, not continuous, so the dump cost never
+    # taxes the loop it measures. The host-vs-device split stays in
+    # nmz_search_phase_seconds; the trace is the per-op zoom-in.
+    # "" disables (the default).
+    device_trace_dir: str = ""
 
 
 class BestSchedule(NamedTuple):
@@ -726,6 +735,8 @@ class ScheduleSearch(SearchBase):
         # dispatch leaves self._state pointing at deleted buffers, and
         # this (a few KB) is what _recover_state rebuilds the best from
         self._best_snapshot = None
+        # one-shot device-trace capture latch (cfg.device_trace_dir)
+        self._device_traced = False
 
     def _reset_best(self) -> None:
         import jax.numpy as jnp
@@ -928,6 +939,40 @@ class ScheduleSearch(SearchBase):
         with obs.search_phase("extract"):
             return self.best()
 
+    def _maybe_start_device_trace(self) -> bool:
+        """Start the one-shot ``jax.profiler`` device-trace capture
+        when ``cfg.device_trace_dir`` is set and nothing was captured
+        yet. Fail-open: a profiler the runtime can't start (no jax, a
+        capture already live elsewhere) degrades to no trace, never an
+        error into the search."""
+        if not self.cfg.device_trace_dir or self._device_traced:
+            return False
+        self._device_traced = True
+        out = os.path.join(self.cfg.device_trace_dir, "device_trace")
+        try:
+            import jax
+
+            os.makedirs(out, exist_ok=True)
+            jax.profiler.start_trace(out)
+        except Exception as e:
+            log.warning("device-trace capture unavailable (%s); "
+                        "search continues untraced", e)
+            return False
+        log.info("capturing device trace of this evolve section "
+                 "into %s", out)
+        return True
+
+    def _stop_device_trace(self) -> None:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:  # stop must never mask the evolve outcome
+            log.debug("device-trace stop failed", exc_info=True)
+            return
+        obs.search_device_trace(
+            os.path.join(self.cfg.device_trace_dir, "device_trace"))
+
     def _run_fused(self, encoded, generations: int) -> BestSchedule:
         """The device-resident loop (doc/performance.md "Fused search
         loop"): generations run in fused_chunk-sized scans — one jitted
@@ -951,6 +996,7 @@ class ScheduleSearch(SearchBase):
         host_io_s = 0.0
         fit_curve: list = []
         pending = None
+        tracing = self._maybe_start_device_trace()
         t0 = time.perf_counter()
         with obs.search_phase("evolve"):
             # the whole evolve section recovers as one unit: dispatch
@@ -990,6 +1036,9 @@ class ScheduleSearch(SearchBase):
             except Exception:
                 self._recover_state()
                 raise
+            finally:
+                if tracing:
+                    self._stop_device_trace()
         elapsed = time.perf_counter() - t0
         self.generations_run += generations
         # recovery snapshot (tiny: two [H] rows + a scalar): the newest

@@ -37,6 +37,8 @@ from namazu_tpu.obs import (  # noqa: F401
     export,
     federation,
     metrics,
+    profdiff,
+    profiling,
     recorder,
     report,
     slo,
@@ -113,6 +115,7 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     schedule_install,
     scorer_throughput,
     scorer_throughput_value,
+    search_device_trace,
     search_phase,
     search_progress,
     search_round,
@@ -152,6 +155,10 @@ def configure_from_config(config) -> None:
     the counters a live ``/metrics`` is serving)."""
     if config.is_set("obs_enabled"):
         metrics.configure(bool(config.get("obs_enabled")))
+        if not metrics.enabled():
+            # the profiler rides the obs switch: turning the plane off
+            # also stops an already-started sampler (obs/profiling.py)
+            profiling.reset()
     # fleet telemetry federation keys (telemetry_enabled, SLO specs,
     # staleness/eviction windows) — same explicit-keys-only rule
     federation.configure_from_config(config)
@@ -291,3 +298,23 @@ def fleet_prometheus() -> str:
     """The whole fleet as one Prometheus text exposition (the
     ``GET /fleet?format=prom`` body)."""
     return federation.aggregator().prometheus()
+
+
+def profile_payload():
+    """This process's sampling profile as the ``nmz-profile-v1``
+    payload (the ``GET /profile?format=json`` body), or None when the
+    profiler is off."""
+    return profiling.payload()
+
+
+def profile_collapsed() -> str:
+    """This process's profile as folded collapsed-stack text (the
+    ``GET /profile?format=collapsed`` body); empty when off."""
+    return profiling.render_collapsed()
+
+
+def profile_speedscope():
+    """This process's profile as a speedscope JSON document (the
+    default ``GET /profile`` body), or None when the profiler is
+    off."""
+    return profiling.speedscope_doc()

@@ -215,6 +215,9 @@ class TelemetryRelay:
         if forward_source is not None and push is not None:
             forward_source.enable_forwarding()
         self._encoder = DeltaEncoder(registry)
+        # profile delta (obs/profiling.py): lazily bound so a process
+        # without a profiler pays one None check per cycle
+        self._prof_delta = None
         self._seq = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -236,6 +239,8 @@ class TelemetryRelay:
             # it, so the next cycle must re-send full state (quiescent
             # series would otherwise stay invisible upstream forever)
             self._encoder.reset()
+            if self._prof_delta is not None:
+                self._prof_delta.reset()
             if target_desc:
                 self._target_desc = target_desc
             if forward_source is not None:
@@ -270,16 +275,34 @@ class TelemetryRelay:
         except Exception:  # pragma: no cover - defensive
             log.debug("telemetry cycle failed", exc_info=True)
 
+    def _profile_delta(self):
+        """Encode the profiler's changed stacks (obs/profiling.py) —
+        same differential-selection contract as the metric encoder:
+        absolutes on the wire, fingerprints advance only on ack."""
+        from namazu_tpu.obs import profiling
+
+        prof = profiling.profiler()
+        if prof is None:
+            return None, {}
+        enc = self._prof_delta
+        if enc is None or enc._prof is not prof:
+            enc = self._prof_delta = profiling.ProfileDelta(prof)
+        return enc.encode()
+
     def _cycle(self) -> None:
         families: List[dict] = []
         fps: Dict = {}
+        prof_payload, prof_fps = None, {}
         if metrics.enabled():
             run_collectors()
             families, fps = self._encoder.encode()
+            prof_payload, prof_fps = self._profile_delta()
         self._seq += 1
         doc = {"schema": SCHEMA, "job": self.job,
                "instance": self.instance, "seq": self._seq,
                "interval_s": self.interval_s, "families": families}
+        if prof_payload is not None:
+            doc["profile"] = prof_payload
         if metrics.enabled():
             # causality plane (obs/context.py): stamp the push so the
             # aggregator's logical clock merges every producer's —
@@ -297,6 +320,8 @@ class TelemetryRelay:
                 log.debug("local telemetry merge failed", exc_info=True)
         if self._push is None:
             self._encoder.mark_acked(fps)
+            if self._prof_delta is not None:
+                self._prof_delta.mark_acked(prof_fps)
             return
         try:
             # chaos seam (doc/robustness.md): a dropped push must
@@ -318,6 +343,8 @@ class TelemetryRelay:
             return
         self._warned = False
         self._encoder.mark_acked(fps)
+        if self._prof_delta is not None:
+            self._prof_delta.mark_acked(prof_fps)
         spans.telemetry_push(True)
         src = self.forward_source
         if src is not None:
@@ -349,7 +376,8 @@ class TelemetryRelay:
 # -- consumer side ---------------------------------------------------------
 
 class _FamilyState:
-    __slots__ = ("type", "help", "labelnames", "uppers", "samples")
+    __slots__ = ("type", "help", "labelnames", "uppers", "samples",
+                 "alt")
 
     def __init__(self, typ: str, help: str, labelnames: Tuple[str, ...],
                  uppers: Optional[List[float]]) -> None:
@@ -360,12 +388,21 @@ class _FamilyState:
         #: labelkey tuple -> float (counter/gauge) or
         #: (raw counts, sum, count) (histogram)
         self.samples: "OrderedDict[Tuple[str, ...], Any]" = OrderedDict()
+        #: mixed-bucket-layout segregation (doc/observability.md):
+        #: a push whose histogram layout differs from the first-seen
+        #: one lands here, keyed by its uppers tuple — warned about and
+        #: counted, NEVER blended into the primary samples (quantiles
+        #: over mixed layouts would be fiction). Lazy: None until a
+        #: mismatch actually happens.
+        self.alt: Optional[Dict[Tuple[float, ...],
+                                "OrderedDict[Tuple[str, ...], Any]"]] \
+            = None
 
 
 class _InstanceState:
     __slots__ = ("job", "instance", "last_seq", "last_seen", "first_seen",
                  "interval_s", "pushes", "duplicates", "families",
-                 "rates", "run_rates")
+                 "rates", "run_rates", "profile")
 
     def __init__(self, job: str, instance: str, now: float) -> None:
         self.job = job
@@ -377,6 +414,10 @@ class _InstanceState:
         self.pushes = 0
         self.duplicates = 0
         self.families: Dict[str, _FamilyState] = {}
+        #: profiling plane (obs/profiling.py): last-write absolute
+        #: collapsed-stack counts from the instance's profile deltas,
+        #: or None for producers without a profiler
+        self.profile: Optional[Dict[str, Any]] = None
         #: counter name -> (t, total, rate) for the summary rates
         self.rates: Dict[str, Tuple[float, float, Optional[float]]] = {}
         #: tenancy plane: run namespace -> (t, total, rate) derived
@@ -395,6 +436,8 @@ class FleetAggregator:
     MAX_SAMPLES_PER_FAMILY = 128
     #: federation-hop buffer bound (docs, not samples)
     FORWARD_CAP = 256
+    #: distinct collapsed stacks held per instance's profile state
+    MAX_PROFILE_STACKS = 1024
     #: counters whose per-instance rate the summary derives
     RATE_COUNTERS = (spans.EVENTS_INTERCEPTED, spans.EDGE_DECISIONS)
 
@@ -410,6 +453,8 @@ class FleetAggregator:
         self._forwarding = False
         self._forward_dropped = 0
         self._series_folded = 0
+        self._layouts_segregated = 0
+        self._layout_warned: set = set()
         self._slo = slo.SLOEvaluator(slo.DEFAULT_SLOS, explicit=False)
         self._last_slo_eval = 0.0
 
@@ -473,6 +518,7 @@ class FleetAggregator:
             st.last_seq = seq
             st.pushes += 1
             self._merge(st, doc.get("families") or [], hist_deltas)
+            self._merge_profile(st, doc.get("profile"))
             self._update_rates(st, now)
             # evict on INGEST too, not only when /fleet is read: an
             # unattended aggregator (a supervisor nobody scrapes) must
@@ -521,14 +567,43 @@ class FleetAggregator:
                 continue
             labelnames = tuple(str(n) for n in f.get("labelnames") or ())
             uppers = f.get("uppers")
+            try:
+                inc_uppers = ([float(u) for u in uppers]
+                              if uppers else None)
+            except (TypeError, ValueError):
+                inc_uppers = None
             fs = st.families.get(name)
             if fs is None:
                 fs = st.families[name] = _FamilyState(
                     str(f.get("type") or "gauge"),
-                    str(f.get("help") or ""), labelnames,
-                    [float(u) for u in uppers] if uppers else None)
+                    str(f.get("help") or ""), labelnames, inc_uppers)
+            # mixed bucket layouts (a fleet mid-rollout: old producers
+            # on the pre-sub-ms nmz_event_stage_seconds bounds replay
+            # through a forward hop into an instance slot that already
+            # saw the new layout): WARN AND SEGREGATE — the layout's
+            # samples are kept in a side table keyed by its uppers,
+            # counted in /fleet as hist_layouts_segregated, and never
+            # blended into primary quantiles
+            alt_samples = None
+            if (fs.type == "histogram" and fs.uppers is not None
+                    and inc_uppers is not None
+                    and inc_uppers != fs.uppers):
+                wkey = (st.job, st.instance, name)
+                if wkey not in self._layout_warned:
+                    self._layout_warned.add(wkey)
+                    log.warning(
+                        "telemetry: %s/%s pushed %s with a different "
+                        "bucket layout (%d vs %d bounds); segregating "
+                        "— mixed layouts are never blended into one "
+                        "quantile", st.job, st.instance, name,
+                        len(inc_uppers), len(fs.uppers))
+                if fs.alt is None:
+                    fs.alt = {}
+                alt_samples = fs.alt.setdefault(
+                    tuple(inc_uppers), OrderedDict())
             watched = fs.type == "histogram" \
-                and self._slo.watches(name) and fs.uppers
+                and self._slo.watches(name) and fs.uppers \
+                and alt_samples is None
             fam_delta = [0] * (len(fs.uppers) + 1) if watched else None
             for s in f.get("samples") or []:
                 if not isinstance(s, dict):
@@ -552,8 +627,30 @@ class FleetAggregator:
                         hcount = int(s.get("count", 0))
                     except (TypeError, ValueError):
                         continue
+                    if alt_samples is not None:
+                        # segregated layout: last-write into its own
+                        # side table, never the primary samples
+                        if len(counts) == len(inc_uppers) + 1:
+                            if key not in alt_samples:
+                                self._layouts_segregated += 1
+                            alt_samples[key] = (counts, hsum, hcount)
+                        continue
                     if fs.uppers is None \
                             or len(counts) != len(fs.uppers) + 1:
+                        # shape mismatch without a declared layout:
+                        # still warn-and-count, never silently vanish
+                        wkey = (st.job, st.instance, name)
+                        if wkey not in self._layout_warned:
+                            self._layout_warned.add(wkey)
+                            log.warning(
+                                "telemetry: %s/%s pushed %s with "
+                                "%d bucket counts against %s bounds; "
+                                "sample segregated (counted, not "
+                                "merged)", st.job, st.instance, name,
+                                len(counts),
+                                "no" if fs.uppers is None
+                                else str(len(fs.uppers)))
+                        self._layouts_segregated += 1
                         continue
                     if fam_delta is not None:
                         prev = existing[0] if existing else [0] * len(counts)
@@ -569,6 +666,64 @@ class FleetAggregator:
                         continue
             if fam_delta is not None and any(fam_delta):
                 hist_deltas.append((name, fs.uppers, fam_delta))
+
+    def _merge_profile(self, st: _InstanceState, prof: Any) -> None:
+        """Merge one push's profile delta (obs/profiling.py wire
+        payload; caller holds the lock). Same absolute-cumulative
+        last-write semantics as counters — a full resend after a lost
+        ack merges idempotently, and the seq watermark upstream already
+        discarded duplicate docs."""
+        if not isinstance(prof, dict) \
+                or not isinstance(prof.get("stacks"), list):
+            return
+        pstate = st.profile
+        if pstate is None:
+            pstate = st.profile = {"stacks": OrderedDict(),
+                                   "samples_total": 0, "dropped": 0,
+                                   "interval_s": 0.01}
+        stacks = pstate["stacks"]
+        for s in prof["stacks"]:
+            if not isinstance(s, dict):
+                continue
+            try:
+                key = (str(s.get("plane") or "other"),
+                       tuple(str(x) for x in s.get("stack") or ()))
+                cnt = int(s.get("count", 0))
+            except (TypeError, ValueError):
+                continue
+            if not key[1]:
+                continue
+            if key not in stacks \
+                    and len(stacks) >= self.MAX_PROFILE_STACKS:
+                continue
+            stacks[key] = cnt
+        try:
+            pstate["samples_total"] = int(
+                prof.get("samples_total", pstate["samples_total"]))
+            pstate["dropped"] = int(
+                prof.get("dropped", pstate["dropped"]))
+            pstate["interval_s"] = float(
+                prof.get("interval_s", pstate["interval_s"]))
+        except (TypeError, ValueError):
+            pass
+
+    def _profile_top(self, st: _InstanceState
+                     ) -> Optional[Tuple[str, float]]:
+        """Dominant self-time frame of an instance's merged profile
+        (leaf with the most samples) — the /fleet PROF column (caller
+        holds the lock)."""
+        p = st.profile
+        if not p or not p["stacks"]:
+            return None
+        selfs: Dict[str, int] = {}
+        for (_plane, stack), c in p["stacks"].items():
+            leaf = stack[-1]
+            selfs[leaf] = selfs.get(leaf, 0) + c
+        total = sum(selfs.values())
+        if total <= 0:
+            return None
+        frame, cnt = max(selfs.items(), key=lambda kv: kv[1])
+        return frame, cnt / total
 
     def _update_rates(self, st: _InstanceState, now: float) -> None:
         for name in self.RATE_COUNTERS:
@@ -817,6 +972,7 @@ class FleetAggregator:
                         else version)
                 ev_rate = st.rates.get(spans.EVENTS_INTERCEPTED,
                                        (0, 0, None))[2]
+                prof_top = self._profile_top(st)
                 rows.append({
                     "job": st.job,
                     "instance": st.instance,
@@ -881,6 +1037,13 @@ class FleetAggregator:
                         st, spans.EDGE_TABLE_STALENESS),
                     "edge_parked": self._gauge_sum(
                         st, spans.EDGE_PARKED),
+                    # profiling plane (obs/profiling.py): the
+                    # instance's dominant self-time frame and its share
+                    # of all self samples — the tools-top PROF column
+                    "prof_top_frame": (prof_top[0] if prof_top
+                                       else None),
+                    "prof_top_share": (round(prof_top[1], 4)
+                                       if prof_top else None),
                     # tenancy plane (doc/tenancy.md): one row per run
                     # namespace this instance serves — events, rate,
                     # and parked depth per tenant, the `tools top` RUN
@@ -897,6 +1060,7 @@ class FleetAggregator:
             "fleet_table_version": fleet_version,
             "series_folded": self._series_folded,
             "forward_dropped": self._forward_dropped,
+            "hist_layouts_segregated": self._layouts_segregated,
             "instances": rows,
             "slo": {
                 "explicit": self._slo.explicit,
@@ -1043,12 +1207,14 @@ def fetch(url: str, op: str, fmt: str = "") -> Any:
     ``/metrics.json``); ``uds://`` speaks the framed obs ops — the
     same-host fleets without a TCP port. Returns the parsed JSON doc,
     or the exposition text when ``fmt == "prom"``."""
-    if op not in ("fleet", "metrics"):
-        raise ValueError(f"unknown obs read {op!r} (want fleet|metrics)")
+    if op not in ("fleet", "metrics", "profile"):
+        raise ValueError(f"unknown obs read {op!r} "
+                         "(want fleet|metrics|profile)")
     if url.startswith(("http://", "https://")):
         import urllib.request
 
-        route = {"fleet": "/fleet", "metrics": "/metrics.json"}[op]
+        route = {"fleet": "/fleet", "metrics": "/metrics.json",
+                 "profile": "/profile?format=json"}[op]
         if op == "fleet" and fmt == "prom":
             route += "?format=prom"
         with urllib.request.urlopen(url.rstrip("/") + route,
@@ -1069,7 +1235,7 @@ def fetch(url: str, op: str, fmt: str = "") -> Any:
             raise RuntimeError(resp.get("error", f"{op} refused"))
         if fmt == "prom":
             return resp.get("text", "")
-        return resp.get(op if op == "fleet" else "metrics")
+        return resp.get(op)
     raise ValueError(f"unsupported obs url {url!r} "
                      "(want http(s)://, uds:// or tcp://)")
 
@@ -1148,6 +1314,14 @@ def handle_obs_op(req: dict,
         # the relay is disabled)
         run_collectors()
         return {"ok": True, "metrics": metrics.registry().to_jsonable()}
+    if op == "profile":
+        # this process's own sampling profile (obs/profiling.py) —
+        # the framed twin of GET /profile
+        from namazu_tpu.obs import profiling
+
+        if req.get("format") == "collapsed":
+            return {"ok": True, "text": profiling.render_collapsed()}
+        return {"ok": True, "profile": profiling.payload()}
     return None
 
 

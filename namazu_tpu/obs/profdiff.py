@@ -1,0 +1,137 @@
+"""Differential profiling (doc/observability.md "Profiling").
+
+Aligns two profiles — files (nmz-profile-v1 JSON, speedscope JSON, or
+collapsed folded text) or live processes (``http://`` / ``uds://`` /
+``tcp://`` obs endpoints, fetched via the framed/REST ``profile`` op) —
+and ranks frames by **self-time share delta**: each frame's leaf-sample
+count normalized by its profile's total, B minus A. Shares (not raw
+counts) are compared so a 10-second capture diffs cleanly against a
+60-second one; raw counts ride along for scale.
+
+Surfaces: ``nmz-tpu tools profdiff <a> <b>`` and the ``bench.py
+--gate`` failure path, which emits this diff against the baseline's
+stored profile so a gate trip ships with the hot-stack explanation.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+from namazu_tpu.obs import profiling
+
+SCHEMA = "nmz-profdiff-v1"
+
+
+def load_profile(source: str) -> dict:
+    """Load a ``nmz-profile-v1`` payload from a live obs endpoint url
+    or a file in any of the three export formats."""
+    if source.startswith(("http://", "https://", "uds://", "tcp://",
+                          "shm://")):
+        from namazu_tpu.obs import federation
+        # fetch() appends the /profile route itself, but the natural
+        # thing to paste is the route URL straight from the browser —
+        # accept both
+        if source.startswith(("http://", "https://")):
+            base, _, query = source.partition("?")
+            if base.rstrip("/").endswith("/profile"):
+                source = base.rstrip("/")[:-len("/profile")]
+        doc = federation.fetch(source, "profile")
+        if not isinstance(doc, dict) or "stacks" not in doc:
+            raise ValueError(f"{source}: no profile payload (is the "
+                             "profiler enabled there?)")
+        return doc
+    with open(source, "r", encoding="utf-8", errors="replace") as f:
+        text = f.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{"):
+        doc = json.loads(text)
+        if doc.get("schema") == profiling.SCHEMA:
+            return doc
+        if "profiles" in doc and "shared" in doc:   # speedscope
+            return profiling.payload_from_speedscope(doc)
+        raise ValueError(f"{source}: unrecognized JSON profile format")
+    return profiling.payload_from_collapsed(text)
+
+
+def diff(a: dict, b: dict, *, min_share: float = 0.0) -> dict:
+    """Frame-aligned self-time diff of two payloads: positive
+    ``delta_share`` = frame got hotter in ``b``. Frames below
+    ``min_share`` in both profiles are elided."""
+    self_a = profiling.self_times(a)
+    self_b = profiling.self_times(b)
+    total_a = sum(self_a.values()) or 1
+    total_b = sum(self_b.values()) or 1
+    planes = frame_planes_merged(a, b)
+    frames = []
+    for frame in set(self_a) | set(self_b):
+        ca, cb = self_a.get(frame, 0), self_b.get(frame, 0)
+        sa, sb = ca / total_a, cb / total_b
+        if sa < min_share and sb < min_share:
+            continue
+        frames.append({"frame": frame,
+                       "plane": planes.get(frame, "other"),
+                       "self_a": ca, "self_b": cb,
+                       "share_a": sa, "share_b": sb,
+                       "delta_share": sb - sa})
+    frames.sort(key=lambda f: -f["delta_share"])
+    return {"schema": SCHEMA,
+            "a": {"job": a.get("job", ""), "samples": total_a},
+            "b": {"job": b.get("job", ""), "samples": total_b},
+            "frames": frames}
+
+
+def frame_planes_merged(a: dict, b: dict) -> Dict[str, str]:
+    planes = profiling.frame_planes(a)
+    planes.update(profiling.frame_planes(b))
+    return planes
+
+
+def top_regression(d: dict) -> Optional[dict]:
+    """The #1 frame by self-time share delta (None if nothing grew)."""
+    frames = d.get("frames") or []
+    if frames and frames[0]["delta_share"] > 0:
+        return frames[0]
+    return None
+
+
+def render_text(d: dict, limit: int = 15) -> str:
+    """Human table, regressions first; improvements (negative deltas)
+    footnoted so the output reads top-down as "what got slower"."""
+    frames = d.get("frames") or []
+    lines = [f"profdiff: A={d['a']['samples']} samples "
+             f"({d['a'].get('job') or '?'})  "
+             f"B={d['b']['samples']} samples "
+             f"({d['b'].get('job') or '?'})",
+             f"{'DELTA':>8} {'A':>7} {'B':>7} {'PLANE':<8} FRAME"]
+    shown = 0
+    for f in frames:
+        if shown >= limit:
+            break
+        lines.append(f"{f['delta_share']*100:+7.2f}% "
+                     f"{f['share_a']*100:6.2f}% {f['share_b']*100:6.2f}% "
+                     f"{f['plane']:<8} {f['frame']}")
+        shown += 1
+    hidden = len(frames) - shown
+    if hidden > 0:
+        lines.append(f"... {hidden} more frames (use --limit)")
+    return "\n".join(lines) + "\n"
+
+
+def render_md(d: dict, limit: int = 15) -> str:
+    frames = (d.get("frames") or [])[:limit]
+    lines = ["# profdiff",
+             "",
+             f"A: `{d['a'].get('job') or '?'}` "
+             f"({d['a']['samples']} samples) → "
+             f"B: `{d['b'].get('job') or '?'}` "
+             f"({d['b']['samples']} samples)",
+             "",
+             "| Δ self | A | B | plane | frame |",
+             "|---:|---:|---:|---|---|"]
+    for f in frames:
+        lines.append(f"| {f['delta_share']*100:+.2f}% "
+                     f"| {f['share_a']*100:.2f}% "
+                     f"| {f['share_b']*100:.2f}% "
+                     f"| {f['plane']} | `{f['frame']}` |")
+    return "\n".join(lines) + "\n"

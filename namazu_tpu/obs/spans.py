@@ -60,6 +60,7 @@ SEARCH_INSTALLS = "nmz_search_installs_total"
 SCORER_THROUGHPUT = "nmz_scorer_schedules_per_sec"
 SEARCH_PHASE = "nmz_search_phase_seconds"
 SEARCH_HOST_GAP = "nmz_search_host_gap_share"
+SEARCH_DEVICE_TRACES = "nmz_search_device_traces_total"
 SEARCH_STALL = "nmz_search_stall"
 SIDECAR_REQUESTS = "nmz_sidecar_requests_total"
 ENTITY_LABEL_OVERFLOW = "nmz_entity_label_overflow_total"
@@ -81,6 +82,16 @@ SHM_RING_FULL = "nmz_shm_ring_full_total"
 #: latency resolution
 BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
                  512.0, 1024.0)
+
+#: event-stage latency buckets: the decision/dispatch segments run in
+#: the tens of microseconds at edge rates, so the default 500µs floor
+#: made HOTSTAGE and stage-p99 bucket-floor artifacts — sub-millisecond
+#: bounds restore resolution where the serving plane actually lives.
+#: The federation merge segregates (warns, never blends) pushes from
+#: producers still on the old layout (obs/federation.py).
+STAGE_BUCKETS = (0.000025, 0.00005, 0.0001, 0.00025, 0.0005, 0.001,
+                 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                 1.0, 2.5)
 
 # resilience plane (doc/robustness.md): unroutable-action drops and
 # liveness-watchdog stall declarations, by entity
@@ -672,6 +683,7 @@ def event_stage(stage: str, seconds: Optional[float]) -> None:
         return
     metrics.get().histogram(
         EVENT_STAGE, _EVENT_STAGE_HELP, ("stage",),
+        buckets=STAGE_BUCKETS,
     ).labels(stage=stage).observe(max(0.0, seconds))
 
 
@@ -684,6 +696,7 @@ def event_stage_many(stage: str, values) -> None:
         return
     child = metrics.get().histogram(
         EVENT_STAGE, _EVENT_STAGE_HELP, ("stage",),
+        buckets=STAGE_BUCKETS,
     ).labels(stage=stage)
     for v in values:
         child.observe(max(0.0, v))
@@ -1032,6 +1045,21 @@ def search_phase(phase: str):
             "wall time per search-plane phase",
             ("phase",),
         ).labels(phase=phase).observe(time.perf_counter() - t0)
+
+
+def search_device_trace(path: str) -> None:
+    """One completed ``jax.profiler`` device-trace capture dumped into
+    ``path`` (the ``device_trace_dir`` knob, models/search.py): counted
+    and stamped into the flight recorder so the trace directory
+    correlates with the run that produced it."""
+    if not metrics.enabled():
+        return
+    metrics.get().counter(
+        SEARCH_DEVICE_TRACES,
+        "completed jax.profiler device-trace captures").inc()
+    from namazu_tpu.obs import recorder
+
+    recorder.record_annotation("device_trace", path=str(path))
 
 
 def sidecar_request(op: str, ok: bool) -> None:

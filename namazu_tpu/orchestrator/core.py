@@ -208,6 +208,10 @@ class Orchestrator:
             "orchestrator", push_url=push_url,
             interval_s=float(
                 self.config.get("telemetry_interval_s", 2.0) or 2.0))
+        # continuous profiling (doc/observability.md "Profiling"):
+        # idempotent like the relay — a CLI layer that already started
+        # the sampler under its own job name wins
+        obs.profiling.ensure_profiler("orchestrator", cfg=self.config)
         log.debug("orchestrator started (enabled=%s)", self.enabled)
 
     def _recover_journal(self) -> None:
@@ -370,6 +374,10 @@ class Orchestrator:
         """Journal + feed one drained central batch to its policy. The
         single-run body; TenantOrchestrator overrides to partition the
         batch by run namespace first (doc/tenancy.md)."""
+        # chaos seam (profiling plane): a seeded slowdown parks the
+        # decision stage in a distinctively-named frame the sampling
+        # profiler must localize — the CI seeded-slowdown smoke
+        chaos.stage_slowdown("orchestrator.stage.slow")
         self._journal_and_queue(batch, self.journal,
                                 self.policy if self.enabled else self.dumb)
 

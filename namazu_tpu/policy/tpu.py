@@ -66,6 +66,12 @@ class TPUSearchPolicy(QueueBackedPolicy):
         # a semantics one. fused = false restores the pre-fusion loop.
         self.fused = True
         self.fused_chunk = 16
+        # device-trace capture knob (doc/observability.md "Profiling"):
+        # non-empty = the FIRST fused evolve of this search dumps a
+        # jax.profiler device trace under <dir>/device_trace, folding
+        # device time into the nmz_search_phase_seconds host-side story.
+        # One-shot per search object; "" (default) = off.
+        self.device_trace_dir = ""
         # migration cadence, decoupled from the generation count: the
         # intra-host ICI ring permutes every migrate_every generations;
         # on a hybrid host x chip mesh (dcn_hosts > 1) the cross-host
@@ -236,6 +242,8 @@ class TPUSearchPolicy(QueueBackedPolicy):
         self.migrate_k = int(p("migrate_k", self.migrate_k))
         self.fused = bool(p("fused", self.fused))
         self.fused_chunk = max(1, int(p("fused_chunk", self.fused_chunk)))
+        self.device_trace_dir = str(
+            p("device_trace_dir", self.device_trace_dir) or "")
         self.migrate_every = max(1, int(p("migrate_every",
                                           self.migrate_every)))
         self.dcn_migrate_every = max(1, int(p("dcn_migrate_every",
@@ -699,6 +707,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
             fused_chunk=self.fused_chunk,
             migrate_every=self.migrate_every,
             dcn_migrate_every=self.dcn_migrate_every,
+            device_trace_dir=self.device_trace_dir,
         )
         mesh = None
         if self.dcn_hosts > 1:
@@ -949,6 +958,7 @@ class TPUSearchPolicy(QueueBackedPolicy):
             "migrate_k": self.migrate_k,
             "fused": self.fused,
             "fused_chunk": self.fused_chunk,
+            "device_trace_dir": self.device_trace_dir,
             "migrate_every": self.migrate_every,
             "dcn_migrate_every": self.dcn_migrate_every,
             "seed": self.seed,

@@ -95,6 +95,7 @@ def build_search_from_params(p: dict):
         fused_chunk=int(p.get("fused_chunk", 16)),
         migrate_every=int(p.get("migrate_every", 1)),
         dcn_migrate_every=int(p.get("dcn_migrate_every", 1)),
+        device_trace_dir=str(p.get("device_trace_dir", "") or ""),
     )
     n_devices = p.get("devices")
     if p.get("search_backend", "ga") == "mcts":
@@ -365,6 +366,11 @@ def serve_sidecar(host: str, port: int, pool_dir: str = "",
         "sidecar",
         push_url=(telemetry_url
                   or os.environ.get("NMZ_TELEMETRY_URL", "")))
+    # continuous profiling: where does sidecar time go (framed wire vs
+    # surrogate scoring) — served over the framed `profile` op
+    from namazu_tpu.obs import profiling
+
+    profiling.ensure_profiler("sidecar")
     try:
         threading.Event().wait()
     except KeyboardInterrupt:

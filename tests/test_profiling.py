@@ -1,0 +1,635 @@
+"""Continuous profiling plane (doc/observability.md "Profiling").
+
+The contracts this file pins:
+
+* **locking** — the sample path never takes the metrics-registry lock
+  (or any foreign lock): zero deadlocks under concurrent registry
+  hammering, and the sampler's overhead on a busy workload stays small
+  (the strict ≤2% budget is enforced by the ``bench.py --pipeline``
+  A/B against ``--no-profile``; here a tolerant smoke bound);
+* **taxonomy** — stacks classify into the plane axis the rest of the
+  obs plane speaks (edge/policy/wire/search/host_io/other), with
+  per-thread tag fallback;
+* **formats** — collapsed folded text and speedscope JSON round-trip
+  through the ``nmz-profile-v1`` payload;
+* **exactly-once** — profile delta snapshots ride the TelemetryRelay
+  wire under the PR 9 differential-selection contract: a dropped push
+  resends absolutes that land once, a replayed doc is deduped by seq;
+* **mixed layouts** — a histogram pushed with a different bucket
+  layout is warned-about and segregated, never blended into primary
+  quantiles (the ``nmz_event_stage_seconds`` re-bucketing rollout);
+* **localization** — a chaos-injected stage slowdown ranks #1 in the
+  profdiff against a clean profile (the CI seeded-slowdown smoke).
+"""
+
+import argparse
+import json
+import threading
+import time
+
+import pytest
+
+from namazu_tpu import chaos
+from namazu_tpu.chaos.plan import FaultPlan
+from namazu_tpu.obs import federation, metrics, profdiff, profiling, spans
+from namazu_tpu.obs.metrics import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def fresh_obs():
+    """Isolated registry + profiler + federation + chaos per test."""
+    old_reg = metrics.set_registry(MetricsRegistry())
+    metrics.configure(True)
+    federation.reset()
+    profiling.reset()
+    chaos.clear()
+    yield
+    chaos.clear()
+    profiling.reset()
+    federation.reset()
+    metrics.set_registry(old_reg)
+    metrics.configure(True)
+
+
+def _code(filename, funcname):
+    """A code object carrying an arbitrary co_filename (what the
+    classifier actually reads)."""
+    ns = {}
+    exec(compile(f"def {funcname}():\n    pass\n", filename, "exec"), ns)
+    return ns[funcname].__code__
+
+
+def _payload(stacks, job="t", interval_s=0.01):
+    """Hand-built nmz-profile-v1 payload from {(plane, stack): count}."""
+    rows = [{"plane": p, "stack": list(s), "count": c}
+            for (p, s), c in stacks.items()]
+    return {"schema": profiling.SCHEMA, "job": job,
+            "interval_s": interval_s,
+            "samples_total": sum(r["count"] for r in rows),
+            "dropped": 0, "stacks": rows}
+
+
+def _install_profiler(prof):
+    """Make ``prof`` the module-global profiler (without starting
+    threads — tests feed it deterministic state)."""
+    profiling._PROFILER = prof
+    return prof
+
+
+# -- classification ------------------------------------------------------
+
+
+def test_plane_classification_by_path_func_and_tag():
+    p = profiling.Profiler("t")
+    pol = _code("/x/namazu_tpu/policy/tpu.py", "decide")
+    edge = _code("/x/namazu_tpu/inspector/edge.py", "release")
+    stdlib = _code("/usr/lib/python3.11/threading.py", "run")
+    hostio = _code("/x/namazu_tpu/models/search.py", "_drain_host_lane")
+
+    # codes are passed leaf-first; the returned stack is root->leaf
+    plane, stack = p._fold_stack(1, [pol, stdlib], {})
+    assert plane == "policy"
+    assert stack == ("python3.11/threading.py:run",
+                     "namazu_tpu/policy/tpu.py:decide")
+
+    plane, _ = p._fold_stack(1, [edge], {})
+    assert plane == "edge"
+
+    # _PLANE_FUNCS override beats the module's path plane: the fused
+    # loop's host lane lives in models/ but is host_io
+    plane, _ = p._fold_stack(1, [hostio], {})
+    assert plane == "host_io"
+
+    # unclassifiable stack: per-thread tag fallback, else "other"
+    plane, _ = p._fold_stack(7, [stdlib], {7: "wire"})
+    assert plane == "wire"
+    plane, _ = p._fold_stack(8, [stdlib], {})
+    assert plane == "other"
+
+
+def test_bounded_table_overflows_visibly():
+    p = profiling.Profiler("t", max_stacks=2)
+    codes = [_code(f"/x/mod{i}.py", f"f{i}") for i in range(4)]
+    p._buf = [(1, [c]) for c in codes]
+    p._fold_once()
+    snap = p.snapshot()
+    assert snap["samples_total"] == 4
+    # two admitted stacks + the (overflow) bucket, dropped counted
+    assert snap["dropped"] == 2
+    assert any(s["stack"] == ["(overflow)"] for s in snap["stacks"])
+
+
+# -- formats -------------------------------------------------------------
+
+
+def test_collapsed_and_speedscope_round_trip():
+    src = _payload({
+        ("wire", ("a.py:f", "b.py:g")): 30,
+        ("search", ("m.py:run",)): 12,
+    })
+    collapsed = "".join(
+        ";".join([s["plane"]] + s["stack"]) + f" {s['count']}\n"
+        for s in src["stacks"])
+    back = profiling.payload_from_collapsed(collapsed)
+    assert {(s["plane"], tuple(s["stack"])): s["count"]
+            for s in back["stacks"]} == \
+        {(s["plane"], tuple(s["stack"])): s["count"]
+         for s in src["stacks"]}
+
+    doc = profiling.speedscope_from_payload(src)
+    assert doc["profiles"][0]["type"] == "sampled"
+    # plane grouping: every sample's root frame is the synthetic plane
+    frames = [f["name"] for f in doc["shared"]["frames"]]
+    for sample in doc["profiles"][0]["samples"]:
+        assert frames[sample[0]].startswith("plane:")
+    back2 = profiling.payload_from_speedscope(doc)
+    assert {(s["plane"], tuple(s["stack"])): s["count"]
+            for s in back2["stacks"]} == \
+        {(s["plane"], tuple(s["stack"])): s["count"]
+         for s in src["stacks"]}
+
+
+def test_self_times_and_top_frame():
+    pay = _payload({
+        ("wire", ("a.py:f", "b.py:g")): 30,   # leaf b.py:g
+        ("wire", ("a.py:f",)): 5,             # leaf a.py:f
+        ("search", ("c.py:h", "b.py:g")): 10,  # leaf b.py:g again
+    })
+    selfs = profiling.self_times(pay)
+    assert selfs == {"b.py:g": 40, "a.py:f": 5}
+
+    prof = profiling.Profiler("t")
+    with prof._lock:
+        for s in pay["stacks"]:
+            prof._stacks[(s["plane"], tuple(s["stack"]))] = s["count"]
+    top = prof.top_self_frame()
+    assert top["frame"] == "b.py:g"
+    assert top["share"] == pytest.approx(40 / 45)
+
+
+# -- live sampler: locking + liveness ------------------------------------
+
+
+def test_sampler_never_deadlocks_with_registry_hammering():
+    """The satellite-2 stress pin: sampler at a short interval while N
+    threads hammer the metrics registry (the lock the sample path must
+    never take). Every thread finishes; samples accumulate."""
+    prof = profiling.Profiler("t", interval_s=0.001,
+                              fold_interval_s=0.01)
+    prof.start()
+    stop = threading.Event()
+    errors = []
+
+    def hammer():
+        reg = metrics.get()
+        try:
+            for i in range(4000):
+                reg.counter("nmz_stress_total", "x",
+                            ("k",)).labels(k=str(i % 7)).inc()
+                reg.histogram("nmz_stress_seconds", "x",
+                              buckets=(0.001, 0.01)).observe(0.0005)
+        except Exception as e:  # pragma: no cover - the failure mode
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in threads), \
+            "registry hammering deadlocked against the profiler"
+        assert not errors
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            prof.drain()
+            if prof.snapshot()["samples_total"] > 0:
+                break
+            time.sleep(0.01)
+        assert prof.snapshot()["samples_total"] > 0
+    finally:
+        stop.set()
+        prof.stop()
+
+
+def test_sampler_overhead_small_on_busy_workload():
+    """Tolerant in-process bound on the sampler's drag (the strict ≤2%
+    budget is the bench A/B vs --no-profile; this only catches a
+    pathological regression like sampling taking a contended lock)."""
+    def busy():
+        t0 = time.perf_counter()
+        x = 0
+        for _ in range(3):
+            for i in range(200_000):
+                x += i
+        return time.perf_counter() - t0, x
+
+    busy()  # warm
+    base, _ = busy()
+    prof = profiling.Profiler("t", interval_s=0.01)
+    prof.start()
+    try:
+        timed, _ = busy()
+    finally:
+        prof.stop()
+    assert timed <= base * 1.5 + 0.05
+
+
+def test_module_helpers_single_check_when_off():
+    assert not profiling.enabled()
+    assert profiling.payload() is None
+    assert profiling.render_collapsed() == ""
+    assert profiling.speedscope_doc() is None
+    profiling.tag_current_thread("wire")  # no-op, no raise
+
+
+def test_ensure_profiler_honors_off_switches(monkeypatch):
+    monkeypatch.setenv("NMZ_PROFILE", "0")
+    assert profiling.ensure_profiler("t") is None
+    monkeypatch.delenv("NMZ_PROFILE")
+    metrics.configure(False)
+    assert profiling.ensure_profiler("t") is None
+    metrics.configure(True)
+    p = profiling.ensure_profiler("t", interval_s=0.05)
+    try:
+        assert p is not None and p.running()
+        # idempotent: the second caller gets the same instance
+        assert profiling.ensure_profiler("other") is p
+    finally:
+        profiling.reset()
+    assert not profiling.enabled()
+
+
+# -- wire: exactly-once profile deltas -----------------------------------
+
+
+def _static_profiler(stacks, job="runjob"):
+    prof = profiling.Profiler(job)
+    with prof._lock:
+        for key, c in stacks.items():
+            prof._stacks[key] = c
+        prof._samples = sum(stacks.values())
+    return _install_profiler(prof)
+
+
+def test_profile_delta_exactly_once_through_dropped_push():
+    """Satellite 3: a dropped push is retried with the same absolutes
+    and lands exactly once; unchanged stacks are never re-sent; growth
+    last-writes (no double count)."""
+    key = ("wire", ("a.py:f", "b.py:g"))
+    prof = _static_profiler({key: 5})
+    agg = federation.FleetAggregator()
+    relay = federation.TelemetryRelay("runjob", instance="i1",
+                                      push=agg.note_push)
+
+    chaos.install(FaultPlan(1, {"telemetry.push.drop": {"at": [0]}}))
+    relay.flush()   # dropped: nothing merged upstream, nothing acked
+    assert ("runjob", "i1") not in agg._instances
+    chaos.clear()
+
+    relay.flush()   # retry resends the same absolutes
+    st = agg._instances[("runjob", "i1")]
+    assert st.profile["stacks"][key] == 5
+    assert st.profile["samples_total"] == 5
+
+    # nothing changed since the ack: the next cycle carries no profile
+    payload, fps = relay._profile_delta()
+    assert payload is None and fps == {}
+
+    # growth: absolutes last-write, never sum
+    with prof._lock:
+        prof._stacks[key] = 9
+        prof._samples = 9
+    relay.flush()
+    assert st.profile["stacks"][key] == 9
+    assert st.profile["samples_total"] == 9
+
+
+def test_profile_replay_deduped_by_seq_watermark():
+    agg = federation.FleetAggregator()
+    key = ("wire", ("a.py:f",))
+    doc = {"schema": federation.SCHEMA, "job": "j", "instance": "i1",
+           "seq": 1, "interval_s": 1.0, "families": [],
+           "profile": _payload({key: 5}, job="j")}
+    assert agg.note_push(dict(doc))["ok"]
+    st = agg._instances[("j", "i1")]
+    assert st.profile["stacks"][key] == 5
+    # replayed doc (ack lost): acked as duplicate, never re-merged
+    replay = dict(doc)
+    replay["profile"] = _payload({key: 999}, job="j")
+    ack = agg.note_push(replay)
+    assert ack.get("duplicate")
+    assert st.profile["stacks"][key] == 5
+
+
+def test_fleet_payload_carries_prof_top_frame():
+    agg = federation.FleetAggregator()
+    doc = {"schema": federation.SCHEMA, "job": "j", "instance": "i1",
+           "seq": 1, "interval_s": 1.0, "families": [],
+           "profile": _payload({
+               ("wire", ("a.py:f", "b.py:g")): 30,
+               ("search", ("c.py:h",)): 10,
+           }, job="j")}
+    agg.note_push(doc)
+    rows = agg.payload()["instances"]
+    row = next(r for r in rows if r["instance"] == "i1")
+    assert row["prof_top_frame"] == "b.py:g"
+    assert row["prof_top_share"] == pytest.approx(0.75)
+
+
+def test_set_upstream_resets_profile_acks():
+    key = ("wire", ("a.py:f",))
+    _static_profiler({key: 5})
+    agg1 = federation.FleetAggregator()
+    relay = federation.TelemetryRelay("runjob", instance="i1",
+                                      push=agg1.note_push)
+    relay.flush()
+    assert agg1._instances[("runjob", "i1")].profile["stacks"][key] == 5
+    # a NEW upstream must receive the full state, not just deltas
+    agg2 = federation.FleetAggregator()
+    relay.set_upstream(push=agg2.note_push)
+    relay.flush()
+    assert agg2._instances[("runjob", "i1")].profile["stacks"][key] == 5
+
+
+def test_handle_obs_op_profile():
+    _static_profiler({("wire", ("a.py:f",)): 3})
+    resp = federation.handle_obs_op({"op": "profile"})
+    assert resp["ok"] and resp["profile"]["stacks"][0]["count"] == 3
+    resp = federation.handle_obs_op({"op": "profile",
+                                     "format": "collapsed"})
+    assert resp["ok"] and "wire;a.py:f 3" in resp["text"]
+
+
+# -- mixed histogram layouts (satellite 1) -------------------------------
+
+
+def _hist_doc(seq, uppers, counts, instance="i1",
+              name=spans.EVENT_STAGE, stage="wire"):
+    return {"schema": federation.SCHEMA, "job": "j",
+            "instance": instance, "seq": seq, "interval_s": 1.0,
+            "families": [{
+                "name": name, "type": "histogram", "help": "h",
+                "labelnames": ["stage"], "uppers": list(uppers),
+                "samples": [{"labels": {"stage": stage},
+                             "counts": list(counts),
+                             "sum": 1.0, "count": sum(counts)}]}]}
+
+
+def test_stage_histogram_has_submillisecond_buckets():
+    """The HOTSTAGE/stage-p99 bucket-floor fix: a 0.4 ms stage must
+    resolve below 1 ms instead of reading as the old 2.5 ms floor."""
+    assert spans.STAGE_BUCKETS[0] < 0.0001
+    assert 0.0005 in spans.STAGE_BUCKETS and 0.001 in spans.STAGE_BUCKETS
+    spans.event_stage("wire", 0.0004)
+    snap = metrics.registry().sample(spans.EVENT_STAGE,
+                                     stage="wire").snapshot()
+    uppers = [u for u, _ in snap["buckets"]]
+    assert uppers == list(spans.STAGE_BUCKETS)
+    # the 0.4ms observation lands in the 0.5ms bucket, not at 2.5ms
+    acc = dict(snap["buckets"])
+    assert acc[0.0005] == 1 and acc[0.00025] == 0
+
+
+def test_mixed_layouts_warn_and_segregate_never_blend(caplog):
+    agg = federation.FleetAggregator()
+    old = (0.001, 0.01, 0.1)
+    new = (0.00025, 0.001, 0.01)
+    # primary layout: all mass below 1ms
+    agg.note_push(_hist_doc(1, old, [10, 0, 0, 0]))
+    st = agg._instances[("j", "i1")]
+    before = agg._hist_quantile_by(st, spans.EVENT_STAGE, "stage", 0.99)
+    assert before == {"wire": 0.001}
+
+    with caplog.at_level("WARNING"):
+        agg.note_push(_hist_doc(2, new, [0, 0, 0, 50]))
+        agg.note_push(_hist_doc(3, new, [0, 0, 0, 60]))
+    warnings = [r for r in caplog.records
+                if "different" in r.getMessage()
+                and "bucket layout" in r.getMessage()]
+    assert len(warnings) == 1  # warn once per (job, instance, name)
+
+    # the foreign layout's 50+ samples at +Inf must NOT move the
+    # primary quantile (blending would have dragged p99 to 0.1)
+    after = agg._hist_quantile_by(st, spans.EVENT_STAGE, "stage", 0.99)
+    assert after == before
+    # ...but they are retained (segregated by uppers) and counted
+    fs = st.families[spans.EVENT_STAGE]
+    assert tuple(new) in fs.alt
+    assert fs.alt[tuple(new)][("wire",)][0] == [0, 0, 0, 60]
+    assert agg.payload()["hist_layouts_segregated"] >= 1
+
+
+def test_hist_quantile_by_per_instance_layouts():
+    """Two instances on different bucket layouts each quantile over
+    their OWN bounds — federation never assumes one fleet-wide
+    layout."""
+    agg = federation.FleetAggregator()
+    agg.note_push(_hist_doc(1, (0.001, 0.01, 0.1), [0, 10, 0, 0],
+                            instance="i-old"))
+    agg.note_push(_hist_doc(1, (0.00025, 0.0005, 0.005), [9, 1, 0, 0],
+                            instance="i-new"))
+    st_old = agg._instances[("j", "i-old")]
+    st_new = agg._instances[("j", "i-new")]
+    assert agg._hist_quantile_by(
+        st_old, spans.EVENT_STAGE, "stage", 0.99) == {"wire": 0.01}
+    assert agg._hist_quantile_by(
+        st_new, spans.EVENT_STAGE, "stage", 0.99) == {"wire": 0.0005}
+
+
+# -- profdiff ------------------------------------------------------------
+
+
+def test_profdiff_ranks_by_self_time_share_delta(tmp_path):
+    a = _payload({("wire", ("x.py:f",)): 80,
+                  ("search", ("y.py:g",)): 20}, job="clean")
+    b = _payload({("wire", ("x.py:f",)): 80,
+                  ("search", ("y.py:g",)): 120}, job="slow")
+    d = profdiff.diff(a, b)
+    top = profdiff.top_regression(d)
+    assert top["frame"] == "y.py:g" and top["plane"] == "search"
+    assert top["delta_share"] == pytest.approx(0.6 - 0.2)
+    # shares, not raw counts: scaling B by 10x changes nothing
+    b10 = _payload({k: c * 10 for k, c in
+                    {("wire", ("x.py:f",)): 80,
+                     ("search", ("y.py:g",)): 120}.items()}, job="slow")
+    assert profdiff.top_regression(
+        profdiff.diff(a, b10))["delta_share"] == \
+        pytest.approx(top["delta_share"])
+
+    # file loading: all three formats converge to the same payload
+    p_json = tmp_path / "a.json"
+    p_json.write_text(json.dumps(a))
+    p_fold = tmp_path / "a.folded"
+    p_fold.write_text("wire;x.py:f 80\nsearch;y.py:g 20\n")
+    p_speed = tmp_path / "a.speedscope.json"
+    p_speed.write_text(json.dumps(profiling.speedscope_from_payload(a)))
+    for p in (p_json, p_fold, p_speed):
+        loaded = profdiff.load_profile(str(p))
+        assert profiling.self_times(loaded) == profiling.self_times(a)
+
+
+def test_tools_profdiff_cli(tmp_path, capsys):
+    from namazu_tpu.cli.tools_cmd import profdiff_cmd
+
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(_payload({("wire", ("x.py:f",)): 10})))
+    b.write_text(json.dumps(_payload({("wire", ("x.py:f",)): 2,
+                                      ("search", ("y.py:g",)): 8})))
+    args = argparse.Namespace(profile_a=str(a), profile_b=str(b),
+                              format="text", limit=15, out="")
+    assert profdiff_cmd(args) == 0
+    out = capsys.readouterr().out
+    assert "y.py:g" in out and out.index("y.py:g") < out.index("x.py:f")
+
+    args.format = "json"
+    args.out = str(tmp_path / "d.json")
+    assert profdiff_cmd(args) == 0
+    d = json.loads((tmp_path / "d.json").read_text())
+    assert d["schema"] == profdiff.SCHEMA
+    assert d["frames"][0]["frame"] == "y.py:g"
+
+    args = argparse.Namespace(profile_a=str(tmp_path / "missing.json"),
+                              profile_b=str(b), format="text",
+                              limit=15, out="")
+    assert profdiff_cmd(args) == 1
+
+
+# -- REST surface --------------------------------------------------------
+
+
+def test_rest_profile_route(tmp_path):
+    import urllib.error
+    import urllib.request
+
+    from namazu_tpu.endpoint.hub import EndpointHub
+    from namazu_tpu.endpoint.rest import RestEndpoint
+    from namazu_tpu.utils.mock_orchestrator import MockOrchestrator
+
+    hub = EndpointHub()
+    rest = RestEndpoint(port=0, poll_timeout=2.0)
+    hub.add_endpoint(rest)
+    mock = MockOrchestrator(hub)
+    mock.start()
+    try:
+        base = f"http://127.0.0.1:{rest.port}/profile"
+        # profiler off: 404, the ops channel stays up
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base, timeout=10)
+        assert exc.value.code == 404
+
+        _static_profiler({("wire", ("a.py:f", "b.py:g")): 4})
+        with urllib.request.urlopen(base, timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["profiles"][0]["type"] == "sampled"  # speedscope
+        with urllib.request.urlopen(base + "?format=collapsed",
+                                    timeout=10) as r:
+            assert b"wire;a.py:f;b.py:g 4" in r.read()
+        with urllib.request.urlopen(base + "?format=json",
+                                    timeout=10) as r:
+            pay = json.loads(r.read())
+        assert pay["schema"] == profiling.SCHEMA
+        assert pay["stacks"][0]["count"] == 4
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "?format=bogus", timeout=10)
+        assert exc.value.code == 400
+
+        # load_profile accepts both the bare base url and the /profile
+        # route pasted straight from a browser/doc example
+        for url in (f"http://127.0.0.1:{rest.port}", base,
+                    base + "?format=json"):
+            loaded = profdiff.load_profile(url)
+            assert loaded["stacks"][0]["count"] == 4, url
+    finally:
+        mock.shutdown()
+
+
+# -- seeded slowdown localization (the CI smoke, in miniature) -----------
+
+
+def _hot_clean_loop(stop):
+    x = 0
+    while not stop.is_set():
+        for _ in range(1000):
+            x += 1
+    return x
+
+
+def _sample_workload(job, target):
+    prof = profiling.Profiler(job, interval_s=0.002,
+                              fold_interval_s=0.05)
+    stop = threading.Event()
+    t = threading.Thread(target=target, args=(stop,), daemon=True)
+    prof.start()
+    t.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            time.sleep(0.05)
+            prof.drain()
+            if prof.snapshot()["samples_total"] >= 30:
+                break
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        prof.stop()
+    return prof.snapshot()
+
+
+def test_seeded_slowdown_ranks_first_in_profdiff():
+    """Satellite 5's localization contract: inject the chaos stage
+    slowdown, profile clean vs slowed, and the distinctively-named
+    injected frame must be the #1 profdiff regression."""
+    clean = _sample_workload("clean", _hot_clean_loop)
+    assert clean["samples_total"] > 0
+
+    def slowed(stop):
+        while not stop.is_set():
+            chaos.stage_slowdown()
+
+    chaos.install(FaultPlan(7, {"orchestrator.stage.slow":
+                                {"prob": 1.0, "delay_s": 0.004}}))
+    try:
+        slow = _sample_workload("slow", slowed)
+    finally:
+        chaos.clear()
+    assert slow["samples_total"] > 0
+
+    top = profdiff.top_regression(profdiff.diff(clean, slow))
+    assert top is not None
+    assert top["frame"].endswith(":_chaos_injected_stage_slowdown"), \
+        f"injected frame not localized; top was {top['frame']}"
+
+
+# -- bench baseline-profile plumbing -------------------------------------
+
+
+def test_bench_gate_failure_emits_profdiff(tmp_path, capsys):
+    import bench
+
+    history = str(tmp_path / "HIST.jsonl")
+    record = {"metric": bench.PIPELINE_METRIC, "platform": "loopback",
+              "transport_mode": "batched"}
+    clean = _payload({("wire", ("x.py:f",)): 90,
+                      ("policy", ("p.py:h",)): 10}, job="baseline")
+    bench.store_baseline_profile(record, clean, history)
+    assert bench.load_baseline_profile(record, history) == clean
+    # a different gate key never sees this baseline
+    other = dict(record, transport_mode="edge")
+    assert bench.load_baseline_profile(other, history) is None
+
+    slow = _payload({("wire", ("x.py:f",)): 90,
+                     ("policy", ("p.py:h",)): 10,
+                     ("host_io", ("s.py:slow",)): 100}, job="regressed")
+    out = bench.emit_gate_profdiff(record, slow, history)
+    assert out is not None
+    d = json.loads(open(out).read())
+    assert d["frames"][0]["frame"] == "s.py:slow"
+    err = capsys.readouterr().err
+    assert "s.py:slow" in err
+
+    # no stored baseline / profiler off: degrade loudly, never raise
+    assert bench.emit_gate_profdiff(other, slow, history) is None
+    assert bench.emit_gate_profdiff(record, None, history) is None
