@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import random
 import signal
 import subprocess
@@ -419,27 +420,38 @@ class Campaign:
                     f"-s{slot_index}-{_uuid.uuid4().hex[:6]}")
         client = TenancyClient(spec.serve_url)
         t0 = time.monotonic()
-        lease = client.lease(
-            run_name, ttl_s=spec.serve_ttl_s,
-            policy=spec.serve_policy or "random",
-            policy_param=dict(spec.serve_policy_param) or None)
+        lease = self._serve_lease(client, run_name)
         lease_id = lease["lease_id"]
+        # a placement service's lease says WHERE the workload runs
+        # (host_url); a plain orchestrator's lease doesn't, and the
+        # serve url is the workload url as before
+        workload = {"url": lease.get("host_url") or spec.serve_url}
+        moved = threading.Event()
         renew_stop = threading.Event()
 
         def renew_loop() -> None:
             interval = max(spec.serve_ttl_s / 3.0, 0.05)
             while not renew_stop.wait(interval):
                 try:
-                    client.renew(lease_id)
+                    doc = client.renew(lease_id)
                 except Exception:
                     return  # lease gone (released, expired, or crash)
+                new_url = str(doc.get("host_url") or "")
+                if new_url and new_url != workload["url"]:
+                    # the pool migrated this run (host drain/death);
+                    # re-target the workload at its new home
+                    log.warning("run %s migrated to %s; re-targeting "
+                                "workload", run_name, new_url)
+                    workload["url"] = new_url
+                    moved.set()
 
         renewer = threading.Thread(target=renew_loop,
                                    name=f"lease-renew-s{slot_index}",
                                    daemon=True)
         renewer.start()
         try:
-            crashed = self._drive_serve_workload(run_name)
+            crashed = self._drive_serve_workload(run_name, workload,
+                                                 moved)
             if crashed:
                 # die like a SIGKILLed tenant: no release — stop
                 # renewing and walk away; TTL expiry reclaims the
@@ -465,34 +477,121 @@ class Campaign:
                  released.get("events"), released.get("dispatched"))
         return False
 
-    def _drive_serve_workload(self, run_name: str) -> bool:
+    def _serve_lease(self, client, run_name: str) -> Dict[str, Any]:
+        """Lease the slot's namespace, honoring admission pushback: a
+        refusal carrying Retry-After (the pool's 429 while its SLO
+        burn is hot, or a single host's ingress gate) is a deferral,
+        not a failure — wait as told and re-knock, bounded. Refusals
+        without a Retry-After propagate to the slot's normal
+        infra-retry path."""
+        from namazu_tpu.tenancy.client import TenancyWireError
+
+        spec = self.spec
+        deferrals = 8
+        while True:
+            try:
+                return client.lease(
+                    run_name, ttl_s=spec.serve_ttl_s,
+                    policy=spec.serve_policy or "random",
+                    policy_param=dict(spec.serve_policy_param) or None)
+            except TenancyWireError as e:
+                hint = getattr(e, "retry_after", None)
+                if hint is None or deferrals <= 0 \
+                        or self._abort.is_set():
+                    raise
+                deferrals -= 1
+                delay = min(max(float(hint), 0.0), 5.0)
+                log.info("lease for %s deferred by admission control; "
+                         "retrying in %.2fs (%s)", run_name, delay, e)
+                if self._abort.wait(delay):
+                    raise
+
+    def _drive_serve_workload(self, run_name: str,
+                              workload: Optional[Dict[str, str]] = None,
+                              moved: Optional[threading.Event] = None,
+                              ) -> bool:
         """The slot's loopback workload: post deferred events under the
         leased namespace, wait for every answering action. Returns True
-        when the ``tenancy.slot.crash`` seam fired mid-drive."""
+        when the ``tenancy.slot.crash`` seam fired mid-drive.
+
+        ``workload["url"]`` is the CURRENT workload target — the renew
+        thread rewrites it and sets ``moved`` when the placement plane
+        migrates the run to another host. On a move the transceivers
+        are rebuilt against the new home; in-flight events whose
+        actions died with the old host are NOT re-awaited — they were
+        parked in the run's journal, recovered on the new host, and
+        flush into the release trace (the exactly-once contract), so
+        the slot only waits for answers that can still arrive."""
         from namazu_tpu import chaos
         from namazu_tpu.signal import PacketEvent
 
         spec = self.spec
-        url = spec.serve_url
+        if workload is None:
+            workload = {"url": spec.serve_url}
         entities = [f"n{i}" for i in range(max(1, spec.serve_entities))]
-        if url.startswith("uds://"):
-            from namazu_tpu.inspector.uds_transceiver import UdsTransceiver
 
-            txs = {e: UdsTransceiver(e, url[len("uds://"):],
-                                     run_ns=run_name)
-                   for e in entities}
-        else:
-            from namazu_tpu.inspector.rest_transceiver import RestTransceiver
+        def build(url):
+            if url.startswith("uds://"):
+                from namazu_tpu.inspector.uds_transceiver import (
+                    UdsTransceiver,
+                )
 
-            txs = {e: RestTransceiver(e, url, use_batch=True,
-                                      flush_window=0.01,
-                                      run_ns=run_name)
-                   for e in entities}
-        crashed = False
-        try:
-            for tx in txs.values():
+                built = {e: UdsTransceiver(e, url[len("uds://"):],
+                                           run_ns=run_name)
+                         for e in entities}
+            else:
+                from namazu_tpu.inspector.rest_transceiver import (
+                    RestTransceiver,
+                )
+
+                built = {e: RestTransceiver(e, url, use_batch=True,
+                                            flush_window=0.01,
+                                            run_ns=run_name)
+                         for e in entities}
+            for tx in built.values():
                 tx.start()
-            chans = []
+            return built
+
+        def teardown(built):
+            for tx in built.values():
+                try:
+                    tx.shutdown()
+                except Exception:  # pragma: no cover - defensive
+                    pass
+
+        txs = build(workload["url"])
+        crashed = False
+        chans = []
+
+        def retarget():
+            nonlocal txs, chans
+            teardown(txs)
+            txs = build(workload["url"])
+            # answers already delivered stay awaitable; the rest are
+            # journal-recovered server-side and traced at release
+            chans = [ch for ch in chans if not ch.empty()]
+
+        def ride_out_migration(exc):
+            """The wire died mid-send. Against a placement pool that is
+            usually a host DYING under us — the monitor needs one
+            detection window (dead_after + a renew tick) before the
+            renew thread re-targets the workload, so wait that out
+            rather than failing a slot the pool is about to save. A
+            plain orchestrator (no mover) or a genuine outage (the
+            renewer dies with the lease, ``moved`` never fires) still
+            raises into the slot's infra-retry path."""
+            if moved is None:
+                raise exc
+            deadline = time.monotonic() + max(2.0 * spec.serve_ttl_s,
+                                              10.0)
+            while not moved.wait(0.25):
+                if self._abort.is_set() \
+                        or time.monotonic() >= deadline:
+                    raise exc
+            moved.clear()
+            retarget()
+
+        try:
             for i in range(max(1, spec.serve_events)):
                 if i % 64 == 0 \
                         and chaos.decide("tenancy.slot.crash") is not None:
@@ -502,15 +601,33 @@ class Campaign:
                     break
                 if self._abort.is_set():
                     break
+                if moved is not None and moved.is_set():
+                    moved.clear()
+                    retarget()
                 e = entities[i % len(entities)]
                 ev = PacketEvent.create(e, e, "peer", hint=f"h{i % 16}")
-                chans.append(txs[e].send_event(ev))
+                try:
+                    chans.append(txs[e].send_event(ev))
+                except (OSError, RuntimeError) as exc:
+                    ride_out_migration(exc)
+                    chans.append(txs[e].send_event(ev))
             if not crashed:
-                for ch in chans:
-                    ch.get(timeout=60)
+                deadline = time.monotonic() + 60.0
+                while chans:
+                    if moved is not None and moved.is_set():
+                        moved.clear()
+                        retarget()
+                        continue
+                    try:
+                        chans[0].get(timeout=0.5)
+                        chans.pop(0)
+                    except queue.Empty:
+                        if time.monotonic() >= deadline:
+                            raise RuntimeError(
+                                f"run {run_name}: workload actions "
+                                "still outstanding after 60s")
         finally:
-            for tx in txs.values():
-                tx.shutdown()
+            teardown(txs)
         return crashed
 
     # -- the supervised loop ---------------------------------------------

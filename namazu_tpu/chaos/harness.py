@@ -1106,6 +1106,200 @@ def _scenario_tenancy(name: str, spec: dict, seed: int, workdir: str,
     return {"invariants": invariants, "fault_report": plan.report()}
 
 
+def _scenario_pool(name: str, spec: dict, seed: int, workdir: str,
+                   events: int,
+                   base_policy_param: Optional[dict] = None
+                   ) -> Dict[str, Any]:
+    """Fleet-of-fleets host death (doc/tenancy.md "Fleet of fleets"):
+    three TenantOrchestrator hosts behind one PlacementService, three
+    pool-leased runs parking every event behind a long exact delay,
+    while the ``fleet.host.die`` seam picks the moment one PLACED host
+    is abandoned (the in-process SIGKILL: delay queues die in memory,
+    journals stay on disk, endpoints sever). Invariants: the leases
+    spread across all three hosts; the monitor declares the victim
+    dead and re-places its leases onto survivors over the SAME
+    namespace journals; every run's release trace joins its posted
+    uuids exactly-once (the victim's runs prove journal recovery — the
+    replacement's ``policy.shutdown()`` flushes recovered-parked
+    events through dispatch into the trace); nothing stays parked or
+    pool-leased afterwards; and the pool state dir fscks clean after
+    ``--repair`` sweeps the drained runs' journal dirs."""
+    from namazu_tpu.fleet.fsck import fsck_pool_state
+    from namazu_tpu.fleet.service import PlacementService
+    from namazu_tpu.inspector.rest_transceiver import RestTransceiver
+    from namazu_tpu.policy import create_policy
+    from namazu_tpu.signal import PacketEvent
+    from namazu_tpu.tenancy.host import TenantOrchestrator
+    from namazu_tpu.utils.config import Config
+
+    plan = chaos.install(FaultPlan(seed, spec["faults"]))
+    n = max(4, events)
+    runs = ("pool-a", "pool-b", "pool-c")
+    hosts: Dict[str, TenantOrchestrator] = {}
+    for i in range(3):
+        cfg = Config({
+            "explore_policy": "random",
+            "rest_port": 0,
+            "run_id": f"{name}-host{i}",
+            # the pool's monitor owns failure detection; a host-local
+            # reaper racing it would blur the death invariant
+            "tenancy_reap_interval_s": 3600.0,
+            "explore_policy_param": {"seed": seed + i, "min_interval": 0,
+                                     "max_interval": 0},
+        })
+        pol = create_policy("random")
+        pol.load_config(cfg)
+        host = TenantOrchestrator(cfg, pol, collect_trace=False)
+        host.start()
+        hosts[f"host{i}"] = host
+    svc = PlacementService(
+        os.path.join(workdir, "pool"), default_ttl_s=600.0,
+        max_runs_per_host=4, monitor_interval_s=0.12, dead_after_s=0.7,
+        host_timeout_s=2.0)
+    for hname, host in hosts.items():
+        port = host.hub.endpoint("rest").port
+        svc.add_host(f"http://127.0.0.1:{port}", name=hname)
+    svc.start()
+
+    invariants: Dict[str, Any] = {}
+    txs: Dict[str, RestTransceiver] = {}
+    try:
+        # long exact delay: every event must still be parked when the
+        # victim dies (and survivors' events flush at release anyway)
+        leases: Dict[str, dict] = {}
+        for run in runs:
+            leases[run] = svc.handle_wire({
+                "op": "lease", "run": run, "ttl_s": 600.0,
+                "policy": "random",
+                "policy_param": {"seed": seed,
+                                 "min_interval": "2500ms",
+                                 "max_interval": "2500ms",
+                                 "fault_action_probability": 0.0,
+                                 "shell_action_interval": 0},
+                "collect_trace": True})
+        placed = {run: leases[run].get("host", "") for run in runs}
+        # NOTE: no spread assertion — these in-process hosts share one
+        # federation aggregator, so every /fleet snapshot is the same
+        # merged doc and scores can tie (test_fleet.py pins the spread
+        # off per-host synthetic snapshots instead). What matters here:
+        # every lease is granted and placed on a real host.
+        invariants["placement"] = _inv(
+            all(l.get("ok") for l in leases.values())
+            and all(placed.get(r) in hosts for r in runs),
+            placed=placed,
+            errors={r: l.get("error") for r, l in leases.items()
+                    if not l.get("ok")})
+
+        uuids: Dict[str, list] = {run: [] for run in runs}
+        for run in runs:
+            tx = RestTransceiver("ent0", leases[run]["host_url"],
+                                 use_batch=False, post_attempts=8,
+                                 run_ns=run)
+            tx.start()
+            txs[run] = tx
+        for i in range(n):
+            for run in runs:
+                ev = PacketEvent.create("ent0", "ent0", "peer",
+                                        hint=f"h{i}")
+                uuids[run].append(ev.uuid)
+                txs[run].send_event(ev)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            depths = {
+                run: (lambda ns: -1 if ns is None
+                      else ns.parked_depth())(
+                    hosts[placed[run]].registry.namespace(run))
+                for run in runs}
+            if all(d == n for d in depths.values()):
+                break
+            time.sleep(0.02)
+        invariants["all_parked"] = _inv(
+            all(d == n for d in depths.values()), depths=depths)
+
+        # the seam picks the kill moment (prob 1.0, max_fires 1); the
+        # victim is the lowest-named PLACED host — deterministic, and
+        # guaranteed to take leased runs down with it
+        victim = ""
+        if chaos.decide("fleet.host.die") is not None:
+            victim = min(placed.values())
+            hosts[victim].abandon()
+        victim_runs = [r for r in runs if placed[r] == victim]
+
+        deadline = time.monotonic() + 30.0
+        pool = svc.pool_payload()
+        while time.monotonic() < deadline:
+            pool = svc.pool_payload()
+            lease_rows = {l["run"]: l for l in pool["leases"]}
+            if all(l["state"] == "placed" and l["host"] != victim
+                   for l in lease_rows.values()):
+                break
+            time.sleep(0.05)
+        host_states = {h["name"]: h["state"] for h in pool["hosts"]}
+        invariants["death_replacement"] = _inv(
+            bool(victim) and host_states.get(victim) == "dead"
+            and all(l["state"] == "placed" and l["host"] != victim
+                    for l in lease_rows.values())
+            and all(lease_rows[r]["migrations"] >= 1
+                    for r in victim_runs)
+            and pool["counters"].get("migrations_death", 0)
+            >= len(victim_runs),
+            victim=victim, host_states=host_states,
+            leases={r: {"host": l["host"], "state": l["state"],
+                        "migrations": l["migrations"]}
+                    for r, l in lease_rows.items()},
+            counters=pool["counters"])
+
+        # release every run through the pool: the replacement host's
+        # shutdown-flush dispatches recovered-parked events into the
+        # trace — the uuid join is the exactly-once proof
+        traces: Dict[str, list] = {}
+        rel_errors: Dict[str, str] = {}
+        for run in runs:
+            rel = svc.handle_wire({"op": "release",
+                                   "lease_id": leases[run]["lease_id"],
+                                   "trace": True})
+            if not rel.get("ok"):
+                rel_errors[run] = str(rel.get("error"))
+            traces[run] = [d.get("event_uuid")
+                           for d in rel.get("trace", [])]
+        invariants["exactly_once"] = _inv(
+            not rel_errors and all(
+                sorted(traces[r]) == sorted(uuids[r]) for r in runs),
+            errors=rel_errors,
+            traced={r: len(traces[r]) for r in runs},
+            posted={r: len(uuids[r]) for r in runs})
+
+        survivors = {hn: h for hn, h in hosts.items() if hn != victim}
+        leftover = {hn: [row["run"] for row in h.registry.payload()]
+                    for hn, h in survivors.items()}
+        invariants["no_parked_forever"] = _inv(
+            not svc.pool_payload()["leases"]
+            and all(not v for v in leftover.values()),
+            pool_leases=svc.pool_payload()["leases"],
+            leftover=leftover)
+
+        # released runs leave only empty journal dirs behind; --repair
+        # sweeps them and a second pass must come back clean
+        first = fsck_pool_state(svc.state_dir, repair=True)
+        second = fsck_pool_state(svc.state_dir)
+        invariants["pool_fsck"] = _inv(
+            not first["stale_leases"] and not first["live_leases"]
+            and not first["recoverable_journals"]
+            and not second["orphan_journals"]
+            and not second["recoverable_journals"],
+            first={k: first[k] for k in ("stale_leases", "live_leases",
+                                         "orphan_journals",
+                                         "recoverable_journals")},
+            second_orphans=second["orphan_journals"])
+    finally:
+        for tx in txs.values():
+            tx.shutdown()
+        svc.shutdown()
+        for host in hosts.values():
+            host.shutdown()
+    return {"invariants": invariants, "fault_report": plan.report()}
+
+
 _KINDS = {
     "pipeline": _scenario_pipeline,
     "storage": _scenario_storage,
@@ -1115,6 +1309,7 @@ _KINDS = {
     "edge_sharded": _scenario_edge_sharded,
     "telemetry": _scenario_telemetry,
     "tenancy": _scenario_tenancy,
+    "pool": _scenario_pool,
 }
 
 

@@ -40,6 +40,14 @@ it. Kinds:
   re-lease over the same journal recovers each event exactly-once, the
   sibling namespace completes undisturbed, and nothing crosses
   namespaces.
+* ``pool`` — fleet-of-fleets host death (doc/tenancy.md "Fleet of
+  fleets"): three orchestrator hosts under one placement service,
+  every leased run's events parked, while ``fleet.host.die`` SIGKILLs
+  (abandons) one placed host mid-campaign; invariant: the monitor
+  declares the host dead and re-places its leases onto survivors, a
+  re-grant over the same namespace journal recovers each parked event,
+  release traces join the posted uuids exactly-once per run, nothing
+  stays parked, and the pool state dir fscks clean after repair.
 * ``telemetry`` — fleet-telemetry relay outage
   (doc/observability.md "Fleet telemetry"): ``telemetry.push.drop``
   kills the producer's pushes; invariant: never an exception into
@@ -161,6 +169,17 @@ SCENARIOS: Dict[str, dict] = {
         "faults": {"tenancy.lease.expire": {"prob": 1.0,
                                             "max_fires": 1}},
     },
+    "pool_host_die": {
+        "kind": "pool",
+        "desc": "one of three pool hosts is SIGKILLed (fleet.host.die) "
+                "with every leased run's events parked; the placement "
+                "service must declare it dead, re-place its leases "
+                "onto survivors over the same namespace journals, and "
+                "every event must dispatch exactly-once into the "
+                "release traces — no run left pending, pool state "
+                "fsck-clean",
+        "faults": {"fleet.host.die": {"prob": 1.0, "max_fires": 1}},
+    },
     "relay_outage": {
         "kind": "telemetry",
         "desc": "the fleet-telemetry collector goes dark; the relay "
@@ -179,7 +198,7 @@ DEFAULT_MATRIX: List[str] = [
     "wire_drop", "wire_dup", "wire_lost_reply", "wire_sever",
     "ingress_429", "storage_torn", "knowledge_outage", "crash_restart",
     "edge_stale", "edge_sharded", "wire_garble", "relay_outage",
-    "tenant_crash",
+    "tenant_crash", "pool_host_die",
 ]
 
 

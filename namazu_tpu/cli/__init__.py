@@ -18,6 +18,7 @@ def build_parser() -> argparse.ArgumentParser:
         campaign_cmd,
         chaos_cmd,
         container_cmd,
+        fleet_cmd,
         init_cmd,
         inspectors_cmd,
         orchestrator_cmd,
@@ -40,6 +41,7 @@ def build_parser() -> argparse.ArgumentParser:
     container_cmd.register(sub)
     sidecar_cmd.register(sub)
     chaos_cmd.register(sub)
+    fleet_cmd.register(sub)
     return parser
 
 

@@ -1,0 +1,181 @@
+"""``nmz-tpu fleet`` — the fleet-of-fleets placement plane.
+
+``fleet serve`` runs the placement service over a pool of orchestrator
+hosts (doc/tenancy.md "Fleet of fleets"); ``fleet status`` renders the
+one-surface pool document; ``fleet drain`` gracefully migrates a
+host's leases onto its siblings. Point ``nmz-tpu campaign --serve`` at
+the pool's ``uds://``/``tcp://`` url exactly as it would point at a
+single orchestrator — the pool speaks the same tenancy wire.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+
+
+def register(sub) -> None:
+    p = sub.add_parser("fleet",
+                       help="placement plane over a pool of "
+                            "orchestrator hosts")
+    fsub = p.add_subparsers(dest="fleet_command", required=True)
+
+    srv = fsub.add_parser("serve", help="run the placement service")
+    srv.add_argument("--host", action="append", default=[],
+                     metavar="NAME=URL", required=False,
+                     help="pool member: name=url (repeat per host; "
+                          "url is the orchestrator's workload url, "
+                          "http://host:port or uds:///path)")
+    srv.add_argument("--state-dir", required=True,
+                     help="pool state directory (lease records + "
+                          "namespace journals; must be on a "
+                          "filesystem all hosts share)")
+    srv.add_argument("--listen", action="append", default=[],
+                     metavar="URL",
+                     help="serve the pool wire on uds:///path or "
+                          "tcp://host:port (repeatable; default "
+                          "uds://<state-dir>/fleet.sock)")
+    srv.add_argument("--ttl", type=float, default=15.0,
+                     help="default pool-lease TTL seconds (default 15)")
+    srv.add_argument("--max-runs-per-host", type=int, default=8,
+                     help="slot cap per host (default 8)")
+    srv.add_argument("--admission-burn-max", type=float, default=1.0,
+                     help="refuse new leases when the pool's worst SLO "
+                          "burn reaches this (default 1.0)")
+    srv.add_argument("--monitor-interval", type=float, default=0.5,
+                     help="seconds between snapshot/migration ticks "
+                          "(default 0.5)")
+    srv.add_argument("--dead-after", type=float, default=3.0,
+                     help="declare a silent host dead after this many "
+                          "seconds (default 3)")
+    srv.set_defaults(func=run_serve)
+
+    st = fsub.add_parser("status", help="render the pool document")
+    st.add_argument("--url", required=True,
+                    help="pool wire url (uds:///path or tcp://host:port)")
+    st.add_argument("--json", action="store_true",
+                    help="raw JSON instead of the table")
+    st.set_defaults(func=run_status)
+
+    dr = fsub.add_parser("drain",
+                         help="migrate one host's leases onto its "
+                              "siblings")
+    dr.add_argument("--url", required=True,
+                    help="pool wire url (uds:///path or tcp://host:port)")
+    dr.add_argument("host", help="pool host name to drain")
+    dr.set_defaults(func=run_drain)
+
+
+def run_serve(args) -> int:
+    import os
+
+    from namazu_tpu.fleet import PlacementService
+    from namazu_tpu.utils.log import get_logger, init_log
+
+    init_log()
+    log = get_logger("fleet")
+    if not args.host:
+        log.error("no pool members: pass --host name=url at least once")
+        return 2
+    svc = PlacementService(
+        args.state_dir, default_ttl_s=args.ttl,
+        max_runs_per_host=args.max_runs_per_host,
+        admission_burn_max=args.admission_burn_max,
+        monitor_interval_s=args.monitor_interval,
+        dead_after_s=args.dead_after)
+    for spec in args.host:
+        svc.add_host(spec)
+    listens = list(args.listen) or [
+        "uds://" + os.path.join(os.path.abspath(args.state_dir),
+                                "fleet.sock")]
+    svc.start()
+    try:
+        for url in listens:
+            if url.startswith("uds://"):
+                svc.serve_unix(url[len("uds://"):])
+            elif url.startswith("tcp://"):
+                hostport = url[len("tcp://"):]
+                host, _, port = hostport.rpartition(":")
+                svc.serve_tcp(host or "127.0.0.1", int(port or 0))
+            else:  # a bare path is a unix socket
+                svc.serve_unix(url)
+        for url in svc.serve_urls:
+            log.info("fleet placement service on %s (%d host(s))", url,
+                     len(args.host))
+        stop = threading.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: stop.set())
+        stop.wait()
+    finally:
+        svc.shutdown()
+    return 0
+
+
+def _pool_client(url: str):
+    from namazu_tpu.fleet import FleetClient
+
+    return FleetClient(url)
+
+
+def render_pool(pool: dict) -> str:
+    """The ``fleet status`` / ``tools top --pool`` table: one view of
+    hosts, placements, and the service's counters."""
+    lines = []
+    hosts = pool.get("hosts") or []
+    lines.append(f"pool: {len(hosts)} host(s)  "
+                 f"state_dir={pool.get('state_dir', '')}")
+    lines.append(f"{'HOST':<12} {'STATE':<9} {'RUNS':>4} {'EV/S':>9} "
+                 f"{'PARKED':>7} {'BURN':>6} {'AGE':>6}  URL")
+    for h in hosts:
+        s = h.get("summary") or {}
+        lines.append(
+            f"{h.get('name', ''):<12} {h.get('state', ''):<9} "
+            f"{s.get('runs', 0):>4} "
+            f"{float(s.get('events_per_sec') or 0.0):>9.1f} "
+            f"{s.get('parked', 0):>7} "
+            f"{float(s.get('max_burn') or 0.0):>6.2f} "
+            f"{float(h.get('last_ok_age_s') or 0.0):>6.1f}  "
+            f"{h.get('url', '')}")
+    leases = pool.get("leases") or []
+    lines.append(f"leases: {len(leases)}")
+    if leases:
+        lines.append(f"  {'RUN':<28} {'HOST':<12} {'STATE':<8} "
+                     f"{'MIGR':>4} {'TTL':>6} {'LEFT':>7}")
+        for l in sorted(leases, key=lambda x: str(x.get("run"))):
+            lines.append(
+                f"  {str(l.get('run', '')):<28} "
+                f"{str(l.get('host') or '-'):<12} "
+                f"{str(l.get('state', '')):<8} "
+                f"{l.get('migrations', 0):>4} "
+                f"{float(l.get('ttl_s') or 0.0):>6.1f} "
+                f"{float(l.get('expires_in_s') or 0.0):>7.2f}")
+    counters = pool.get("counters") or {}
+    if counters:
+        lines.append("counters: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(counters.items())))
+    return "\n".join(lines)
+
+
+def run_status(args) -> int:
+    client = _pool_client(args.url)
+    try:
+        pool = client.pool_status()
+    finally:
+        client.close()
+    if args.json:
+        print(json.dumps(pool, indent=2, sort_keys=True))
+    else:
+        print(render_pool(pool))
+    return 0
+
+
+def run_drain(args) -> int:
+    client = _pool_client(args.url)
+    try:
+        doc = client.drain(args.host)
+    finally:
+        client.close()
+    print(f"drained {doc.get('host')}: {doc.get('migrated', 0)} "
+          "lease(s) re-placed")
+    return 0

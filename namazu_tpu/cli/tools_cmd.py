@@ -174,6 +174,11 @@ def register(sub) -> None:
                           "collector (uds:///path — a campaign "
                           "supervisor's --telemetry-collector, or an "
                           "orchestrator's uds_path)")
+    ptp.add_argument("--pool", action="store_true",
+                     help="--url is a fleet placement service "
+                          "(nmz-tpu fleet serve) — render the pool "
+                          "document (hosts, placements, migration "
+                          "counters) instead of /fleet telemetry")
     ptp.add_argument("--watch", action="store_true",
                      help="refresh every INTERVAL seconds until ^C")
     ptp.add_argument("--interval", type=float, default=2.0,
@@ -415,13 +420,21 @@ def register(sub) -> None:
              "quarantines the incomplete runs and sweeps the temps. "
              "Pointed at a shared failure-pool dir (doc/knowledge.md) "
              "it checks pool entries instead: stray temps and torn "
-             "(unreadable) .npz entries",
+             "(unreadable) .npz entries. Pointed at a placement "
+             "service's state dir (fleet.json manifest, doc/tenancy.md "
+             "\"Fleet of fleets\") it sweeps stale pool-lease records "
+             "and orphaned namespace journals, reconciling against the "
+             "live service's view when one is reachable",
     )
     pf.add_argument("storage")
     pf.add_argument("--repair", action="store_true",
                     help="quarantine unmarked incomplete runs and remove "
                          "orphan *.tmp files (run only on a quiescent "
                          "storage — an in-flight run looks incomplete)")
+    pf.add_argument("--service-url", default="",
+                    help="fleet-state fsck only: reconcile lease records "
+                         "against this live placement service instead "
+                         "of the manifest's recorded serve url")
     pf.add_argument("--json", action="store_true",
                     help="print the full report as JSON")
     pf.set_defaults(func=fsck)
@@ -606,15 +619,37 @@ def profdiff_cmd(args) -> int:
 
 def top(args) -> int:
     """Fleet snapshot table over a live aggregator's /fleet payload
-    (REST or uds, obs/federation.py); --watch redraws until ^C."""
+    (REST or uds, obs/federation.py); --watch redraws until ^C.
+    With --pool the url is a placement service (fleet/service.py) and
+    the table is the pool document instead."""
     import time as _time
 
     from namazu_tpu.obs import federation
 
+    # programmatic callers (tests, scripts) build bare Namespaces that
+    # predate the flag
+    pool = getattr(args, "pool", False)
+
+    def _fetch_pool():
+        from namazu_tpu.fleet import FleetClient
+        from namazu_tpu.tenancy.client import TenancyWireError
+
+        client = FleetClient(args.url)
+        try:
+            return client.pool_status()
+        except TenancyWireError as e:
+            # fold into the watch loop's retryable class
+            raise RuntimeError(str(e)) from e
+        finally:
+            client.close()
+
     while True:
         try:
             try:
-                payload = federation.fetch(args.url, "fleet")
+                if pool:
+                    payload = _fetch_pool()
+                else:
+                    payload = federation.fetch(args.url, "fleet")
             except (OSError, RuntimeError, ValueError):
                 if not args.watch:
                     raise
@@ -629,6 +664,10 @@ def top(args) -> int:
                 continue
             if args.json:
                 text = json.dumps(payload, sort_keys=True) + "\n"
+            elif pool:
+                from namazu_tpu.cli.fleet_cmd import render_pool
+
+                text = render_pool(payload) + "\n"
             else:
                 text = render_top(payload)
             if not args.watch:
@@ -1002,6 +1041,40 @@ def _fsck_pool(args) -> int:
     return 1 if findings else 0
 
 
+def _fsck_fleet(args) -> int:
+    from namazu_tpu.fleet.fsck import fsck_pool_state
+
+    report = fsck_pool_state(args.storage, repair=args.repair,
+                             service_url=getattr(args, "service_url", ""))
+    findings = (len(report["stale_leases"])
+                + len(report["orphan_journals"])
+                + len(report["unreadable_records"]))
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+        return 1 if findings else 0
+    print(f"{report['state_dir']}: {report['lease_records']} lease "
+          f"record(s), {len(report['live_leases'])} live")
+    if not report["manifest_ok"]:
+        print("  manifest unreadable (fleet.json)")
+    for rec in report["stale_leases"]:
+        print(f"  stale lease: {rec['lease_id']} run={rec['run']} "
+              f"expired {rec['expired_ago_s']}s ago")
+    for name in report["unreadable_records"]:
+        print(f"  unreadable record: {name}")
+    for name in report["orphan_journals"]:
+        print(f"  orphan journal (empty): {name}")
+    for rec in report["recoverable_journals"]:
+        print(f"  recoverable journal: {rec['journal']} holds "
+              f"{rec['unreleased']} unreleased event(s) — kept; "
+              "re-lease the run over it to recover")
+    if args.repair and report["repaired"]:
+        print(f"repaired: {len(report['repaired'])} item(s) swept")
+    elif findings:
+        print("rerun with --repair to sweep stale records and orphan "
+              "journals")
+    return 1 if findings else 0
+
+
 def fsck(args) -> int:
     """Integrity report over a storage's run dirs. Exit 1 only for
     UNHANDLED states — unmarked incomplete dirs, missing dirs, stray
@@ -1012,7 +1085,13 @@ def fsck(args) -> int:
 
     A shared failure-pool dir (no storage skeleton) gets the pool
     checks instead — the knowledge plane's pool is part of the same
-    durable state a campaign depends on (doc/knowledge.md)."""
+    durable state a campaign depends on (doc/knowledge.md). A fleet
+    placement service's state dir (fleet.json manifest) gets the pool-
+    lease/journal sweep (fleet/fsck.py)."""
+    from namazu_tpu.fleet.fsck import looks_like_fleet_dir
+
+    if looks_like_fleet_dir(args.storage):
+        return _fsck_fleet(args)
     if _looks_like_pool_dir(args.storage):
         return _fsck_pool(args)
     st = load_storage(args.storage)

@@ -226,16 +226,31 @@ class EndpointHub:
 
     # -- zero-RTT table plane (doc/performance.md) ----------------------
 
-    def table_version(self) -> Optional[int]:
-        """The published table's current version, None when this hub
-        has no table plane at all."""
-        pub = self.table_publisher
+    def _ns_table_publisher(self, ns: str):
+        """The table publisher serving namespace ``ns``: a leased run's
+        OWN policy publisher when it has one (doc/tenancy.md
+        "Per-namespace tables" — one tenant's edges must never decide
+        against the process-default policy's table), else the
+        process-default publisher for the default namespace, else
+        None."""
+        if ns and self.run_registry is not None:
+            run = self.run_registry.namespace(ns)
+            if run is not None:
+                return getattr(run.policy, "table_publisher", None)
+            return None  # unknown/expired tenant: no table, no version
+        return self.table_publisher
+
+    def table_version(self, ns: str = "") -> Optional[int]:
+        """The published table's current version for namespace ``ns``
+        ("" = the process default), None when that namespace has no
+        table plane at all."""
+        pub = self._ns_table_publisher(ns)
         return None if pub is None else pub.version
 
-    def table_doc(self):
-        """``(version, doc_or_None)`` of the published table; (0, None)
-        without a table plane."""
-        pub = self.table_publisher
+    def table_doc(self, ns: str = ""):
+        """``(version, doc_or_None)`` of the table published for
+        namespace ``ns``; (0, None) without a table plane."""
+        pub = self._ns_table_publisher(ns)
         return (0, None) if pub is None else pub.current()
 
     def post_control(self, control: Control) -> None:

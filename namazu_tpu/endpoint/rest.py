@@ -709,12 +709,15 @@ class RestEndpoint(QueuedEndpoint):
                 length = int(self.headers.get("Content-Length") or 0)
                 return self.rfile.read(length) if length else b""
 
-            def _tv_headers(self) -> Dict[str, str]:
+            def _tv_headers(self, ns: str = "") -> Dict[str, str]:
                 """The table-version piggyback (zero-RTT dispatch):
                 present on batch POST / batch poll / backhaul replies
-                whenever this hub has a table plane — the one signal an
-                edge needs to notice a rollover within one batch."""
-                version = endpoint.hub.table_version()
+                whenever the request's namespace has a table plane —
+                the one signal an edge needs to notice a rollover
+                within one batch. Namespaced requests see THEIR
+                tenant's version (doc/tenancy.md "Per-namespace
+                tables"), never the process default's."""
+                version = endpoint.hub.table_version(ns)
                 if version is None:
                     return {}
                 return {TABLE_VERSION_HEADER: str(version)}
@@ -882,7 +885,7 @@ class RestEndpoint(QueuedEndpoint):
                     endpoint.hub.post_events(fresh, endpoint.NAME)
                 self._reply(200, {"accepted": len(fresh),
                                   "duplicates": len(events) - len(fresh)},
-                            headers=self._tv_headers())
+                            headers=self._tv_headers(ns))
 
             def _post_event_backhaul(self, entity: str) -> None:
                 """Asynchronous backhaul of edge-decided events
@@ -912,8 +915,8 @@ class RestEndpoint(QueuedEndpoint):
                     return self._reply(400, {"error": str(e)})
                 self._reply(200, {
                     "accepted": accepted, "duplicates": duplicates,
-                    "table_version": endpoint.hub.table_version() or 0,
-                }, headers=self._tv_headers())
+                    "table_version": endpoint.hub.table_version(ns) or 0,
+                }, headers=self._tv_headers(ns))
 
             def _post_control(self, query: Dict[str, list]) -> None:
                 ops = query.get("op") or []
@@ -1016,22 +1019,27 @@ class RestEndpoint(QueuedEndpoint):
                 actions = endpoint._queue_for(entity, ns).peek_batch(
                     max_n, endpoint.poll_timeout, linger=linger)
                 if not actions:
-                    return self._reply(204, headers=self._tv_headers())
+                    return self._reply(204, headers=self._tv_headers(ns))
                 obs.event_batch("actions_poll", len(actions))
                 self._reply(200, {"actions": [a.to_jsonable()
                                               for a in actions]},
-                            headers=self._tv_headers())
+                            headers=self._tv_headers(ns))
 
             def _get_policy_table(self) -> None:
                 """The published hash->delay table (zero-RTT dispatch):
                 200 + the versioned doc when one is publishable, 204
                 (with the version header) when the current version has
                 no table — non-table policies, cold start, fault-
-                bearing installs, disabled orchestration."""
-                version, doc = endpoint.hub.table_doc()
-                headers = ({TABLE_VERSION_HEADER: str(version)}
-                           if endpoint.hub.table_publisher is not None
-                           else {})
+                bearing installs, disabled orchestration. An X-Nmz-Run
+                header scopes the read to that tenant's OWN publisher
+                (doc/tenancy.md "Per-namespace tables"); an unknown or
+                expired tenant gets a bare 204 — no version, no
+                table."""
+                ns = self._req_ns()
+                if ns is None:
+                    return
+                version, doc = endpoint.hub.table_doc(ns)
+                headers = self._tv_headers(ns)
                 if doc is None:
                     return self._reply(204, headers=headers)
                 self._reply(200, doc, headers=headers)

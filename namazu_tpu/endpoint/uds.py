@@ -96,10 +96,16 @@ class UdsEndpoint(QueuedEndpoint):
 
     def _decorate(self, req: dict, resp: dict) -> None:
         """The zero-RTT version piggyback: every response carries
-        ``table_version`` when this hub has a table plane — how an edge
-        notices a rollover within one batch (doc/performance.md)."""
-        version = self.hub.table_version() \
-            if getattr(self, "hub", None) is not None else None
+        ``table_version`` when the request's namespace has a table
+        plane — how an edge notices a rollover within one batch
+        (doc/performance.md). Namespaced ops (the framed ``run``
+        field) see THEIR tenant's version, never the process
+        default's."""
+        if getattr(self, "hub", None) is None:
+            return
+        ns = req.get(tenancy.RUN_FIELD) or ""
+        version = self.hub.table_version(ns if isinstance(ns, str)
+                                         else "")
         if version is not None:
             resp.setdefault("table_version", version)
 
@@ -138,12 +144,12 @@ class UdsEndpoint(QueuedEndpoint):
         if op == "backhaul":
             return self._op_backhaul(req)
         if op == "table":
-            return self._op_table()
+            return self._op_table(req)
         if op == "shm_open":
             return self._op_shm_open(req)
         if op == "control":
             return self._op_control(req)
-        if op in ("lease", "renew", "release", "runs"):
+        if op in ("lease", "renew", "release", "reclaim", "runs"):
             return self._op_tenancy(req)
         # observability ops (telemetry push / fleet view / local
         # metrics dump — obs/federation.py): the uds wire serves the
@@ -421,6 +427,13 @@ class UdsEndpoint(QueuedEndpoint):
         return {"ok": True, "accepted": accepted,
                 "duplicates": duplicates}
 
-    def _op_table(self) -> dict:
-        version, doc = self.hub.table_doc()
+    def _op_table(self, req: dict) -> dict:
+        """The published table, scoped by the op's ``run`` field to
+        that tenant's OWN publisher (doc/tenancy.md "Per-namespace
+        tables"); absent = the process default, pre-tenancy
+        behavior."""
+        ns, bad = self._req_ns(req)
+        if bad is not None:
+            return bad
+        version, doc = self.hub.table_doc(ns)
         return {"ok": True, "version": version, "table": doc}

@@ -1,0 +1,38 @@
+"""Fleet-of-fleets: the crash-tolerant placement plane.
+
+One placement service (service.py) leases campaign slots across a pool
+of orchestrator hosts, speaking the same tenancy wire each host already
+serves. Capacity-aware scoring lives in placement.py; the pool-control
+client in client.py; the CLI surface is ``nmz-tpu fleet serve/status/
+drain`` (cli/fleet_cmd.py) and ``tools top --pool``.
+
+See doc/tenancy.md "Fleet of fleets".
+"""
+
+from namazu_tpu.fleet.client import FleetClient
+from namazu_tpu.fleet.placement import (
+    choose_host,
+    pool_burn,
+    score_host,
+    summarize_fleet_doc,
+)
+from namazu_tpu.fleet.service import (
+    JOURNALS_DIR,
+    LEASES_DIR,
+    MANIFEST_NAME,
+    MANIFEST_SCHEMA,
+    PlacementService,
+)
+
+__all__ = [
+    "FleetClient",
+    "JOURNALS_DIR",
+    "LEASES_DIR",
+    "MANIFEST_NAME",
+    "MANIFEST_SCHEMA",
+    "PlacementService",
+    "choose_host",
+    "pool_burn",
+    "score_host",
+    "summarize_fleet_doc",
+]

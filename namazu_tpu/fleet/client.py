@@ -1,0 +1,33 @@
+"""Client for the placement service's pool ops.
+
+The pool speaks the tenancy op grammar for its lease surface — an
+unmodified :class:`~namazu_tpu.tenancy.client.TenancyClient` works for
+``lease``/``renew``/``release``/``reclaim``/``runs`` — so this client
+only adds the pool-control verbs (``pool_status``/``drain``/``hosts``)
+on top, via the raw ``op()`` passthrough. ``nmz-tpu fleet status`` /
+``fleet drain`` and ``tools top --pool`` are its callers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from namazu_tpu.tenancy.client import TenancyClient
+
+
+class FleetClient(TenancyClient):
+    """TenancyClient plus the placement service's pool-control ops."""
+
+    def pool_status(self) -> Dict[str, Any]:
+        """The one-surface pool document: hosts with load summaries,
+        pool leases with placements, migration/admission counters."""
+        return self.op({"op": "pool_status"})["pool"]
+
+    def drain(self, host: str) -> Dict[str, Any]:
+        """Gracefully drain one host: its leases are reclaimed (events
+        parked to journals) and re-placed onto siblings."""
+        return self.op({"op": "drain", "host": host})
+
+    def hosts(self) -> Dict[str, str]:
+        """Pool membership: host name -> workload url."""
+        return self.op({"op": "hosts"})["hosts"]

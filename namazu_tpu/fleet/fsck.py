@@ -1,0 +1,169 @@
+"""Offline integrity checks over a placement service's state dir.
+
+``tools fsck <state_dir>`` lands here when the dir holds a
+``fleet.json`` manifest (fleet/service.py writes one at start). The
+durable pool state is reconcilable by construction — a SIGKILLed
+service leaves lease records under ``leases/`` and per-run journals
+under ``journals/`` — and this module classifies what it finds:
+
+* **stale lease records** — leases whose walltime expiry passed more
+  than one TTL ago (the tenant stopped renewing and the per-host lease
+  reclaimed long since), or, when the live service is reachable
+  (``service_url``), records its view no longer contains;
+* **orphan journals** — journal dirs no lease record references whose
+  journal holds NO unreleased events: nothing left to recover, safe to
+  sweep;
+* **recoverable journals** — unreferenced journal dirs that DO hold
+  unreleased events. Never swept (they are the only durable copy of a
+  dead tenant's parked events); reported so an operator can re-lease
+  the run over them or archive them deliberately.
+
+``repair=True`` unlinks the stale records and sweeps the orphan
+journal dirs; recoverable journals and live leases are never touched.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Set
+
+from namazu_tpu.fleet.service import (
+    JOURNALS_DIR,
+    LEASES_DIR,
+    MANIFEST_NAME,
+)
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("fleet.fsck")
+
+
+def looks_like_fleet_dir(path: str) -> bool:
+    """A placement-service state dir carries the fleet manifest."""
+    return os.path.isfile(os.path.join(path, MANIFEST_NAME))
+
+
+def _service_lease_ids(service_url: str) -> Optional[Set[str]]:
+    """The live service's lease ids, or None when unreachable (fsck
+    then falls back to walltime aging)."""
+    if not service_url:
+        return None
+    from namazu_tpu.fleet.client import FleetClient
+
+    client = FleetClient(service_url, timeout=5.0)
+    try:
+        return {str(r.get("lease_id") or "")
+                for r in client.runs().get("runs") or []}
+    except Exception as e:
+        log.warning("placement service at %s unreachable (%s); "
+                    "reconciling by record age instead", service_url, e)
+        return None
+    finally:
+        client.close()
+
+
+def fsck_pool_state(state_dir: str, repair: bool = False,
+                    service_url: str = "",
+                    now: Optional[float] = None) -> Dict[str, Any]:
+    """One report over a pool state dir; see the module docstring for
+    the finding classes. Run against a quiescent dir or pass
+    ``service_url`` — without the live view, records still inside
+    their TTL grace are simply not stale yet."""
+    state_dir = os.path.abspath(state_dir)
+    now = time.time() if now is None else now
+    report: Dict[str, Any] = {
+        "state_dir": state_dir, "manifest_ok": False,
+        "lease_records": 0, "live_leases": [],
+        "stale_leases": [], "orphan_journals": [],
+        "recoverable_journals": [], "unreadable_records": [],
+        "repaired": [],
+    }
+    manifest_path = os.path.join(state_dir, MANIFEST_NAME)
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        report["manifest_ok"] = isinstance(manifest, dict)
+        if report["manifest_ok"] and not service_url:
+            # the manifest remembers where the service serves; a live
+            # one is the authoritative view of which leases exist
+            urls = manifest.get("serve_urls") or []
+            service_url = str(urls[0]) if urls else ""
+    except (OSError, ValueError):
+        pass
+    live_ids = _service_lease_ids(service_url)
+
+    leases_dir = os.path.join(state_dir, LEASES_DIR)
+    referenced_journals: Set[str] = set()
+    records: List[str] = []
+    if os.path.isdir(leases_dir):
+        records = sorted(n for n in os.listdir(leases_dir)
+                         if n.endswith(".json"))
+    report["lease_records"] = len(records)
+    for name in records:
+        path = os.path.join(leases_dir, name)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            report["unreadable_records"].append(name)
+            if repair:
+                try:
+                    os.unlink(path)
+                    report["repaired"].append(f"record:{name}")
+                except OSError:
+                    pass
+            continue
+        lease_id = str(doc.get("lease_id") or name[:-len(".json")])
+        ttl = float(doc.get("ttl_s") or 0.0)
+        expires = float(doc.get("expires_wall") or 0.0)
+        if live_ids is not None:
+            stale = lease_id not in live_ids
+        else:
+            # one full TTL past walltime expiry: the per-host lease
+            # reclaimed ages ago and no renewal refreshed the record
+            stale = expires > 0 and now - expires > max(ttl, 1.0)
+        if stale:
+            report["stale_leases"].append(
+                {"lease_id": lease_id, "run": str(doc.get("run") or ""),
+                 "expired_ago_s": round(max(0.0, now - expires), 1)})
+            if repair:
+                try:
+                    os.unlink(path)
+                    report["repaired"].append(f"record:{name}")
+                except OSError:
+                    pass
+        else:
+            report["live_leases"].append(lease_id)
+            jd = str(doc.get("journal_dir") or "")
+            if jd:
+                referenced_journals.add(os.path.basename(
+                    os.path.normpath(jd)))
+
+    journals_dir = os.path.join(state_dir, JOURNALS_DIR)
+    if os.path.isdir(journals_dir):
+        from namazu_tpu.chaos.journal import EventJournal
+
+        for name in sorted(os.listdir(journals_dir)):
+            path = os.path.join(journals_dir, name)
+            if not os.path.isdir(path) or name in referenced_journals:
+                continue
+            try:
+                parked = len(EventJournal(path).unreleased())
+            except Exception:
+                # an unreadable journal might still hold events; treat
+                # as recoverable (never sweep what we can't prove empty)
+                parked = -1
+            if parked == 0:
+                report["orphan_journals"].append(name)
+                if repair:
+                    try:
+                        shutil.rmtree(path)
+                        report["repaired"].append(f"journal:{name}")
+                    except OSError:
+                        pass
+            else:
+                report["recoverable_journals"].append(
+                    {"journal": name, "unreleased": parked})
+    return report

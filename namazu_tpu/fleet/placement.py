@@ -1,0 +1,170 @@
+"""Capacity-aware host scoring for the placement plane.
+
+Pure functions over the federated ``/fleet`` documents each
+orchestrator host serves (obs/federation.py): the placement service
+(fleet/service.py) snapshots every host's doc on its monitor tick and
+asks this module two questions — *how loaded is that host* and *which
+eligible host should take this run*. Keeping the scoring side-effect
+free means tests/test_fleet.py can pin the decision table off synthetic
+snapshots, no sockets involved.
+
+Scoring inputs per host (all derived from one ``/fleet`` doc):
+
+* ``events_per_sec`` — summed over the host's fresh producer rows (a
+  stale row's rate is history, not load);
+* ``parked`` — edge-parked depth plus every tenant namespace's parked
+  depth (the backlog a migration would have to recover);
+* ``runs`` — distinct leased run namespaces (the slot occupancy the
+  ``max_runs_per_host`` cap gates on);
+* ``max_burn`` — the worst SLO objective burn rate the host reports
+  (>= 1 means the objective is violated over its window).
+
+Selection prefers the least-loaded eligible host, with an affinity
+bonus for the host that last served the run — a campaign's retries
+land where its journals live, so recovery never crosses hosts unless
+the old host is gone.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+#: score bonus for the run's previous host (journal affinity): big
+#: enough to win any load tie-break, small enough that a saturated
+#: previous host still loses to an idle sibling
+AFFINITY_BONUS = 0.25
+
+#: load normalizers: one run's worth of serving traffic. The absolute
+#: values only set the scale on which load differences matter; the
+#: RANKING is what placement acts on.
+RATE_NORM = 10_000.0
+PARKED_NORM = 1_000.0
+
+
+def summarize_fleet_doc(doc: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold one host's ``/fleet`` document into the flat load summary
+    the scorer consumes. ``None`` (an unreachable host) summarizes to
+    an empty-but-marked doc so callers can treat "no snapshot" and
+    "idle host" distinctly."""
+    if not isinstance(doc, dict):
+        return {"reachable": False, "events_per_sec": 0.0, "parked": 0,
+                "runs": 0, "run_names": [], "max_burn": 0.0,
+                "stale_instances": 0}
+    rate = 0.0
+    parked = 0
+    run_names: List[str] = []
+    seen_runs = set()
+    for row in doc.get("instances") or []:
+        if not isinstance(row, dict):
+            continue
+        if row.get("stale"):
+            continue
+        try:
+            rate += float(row.get("events_per_sec") or 0.0)
+        except (TypeError, ValueError):
+            pass
+        try:
+            parked += int(row.get("edge_parked") or 0)
+        except (TypeError, ValueError):
+            pass
+        runs = row.get("runs")
+        if isinstance(runs, dict):
+            for name, stats in runs.items():
+                if name not in seen_runs:
+                    seen_runs.add(name)
+                    run_names.append(name)
+                if isinstance(stats, dict):
+                    try:
+                        parked += int(stats.get("parked") or 0)
+                    except (TypeError, ValueError):
+                        pass
+    max_burn = 0.0
+    slo = doc.get("slo")
+    if isinstance(slo, dict):
+        for obj in slo.get("objectives") or []:
+            if not isinstance(obj, dict):
+                continue
+            try:
+                burn = float(obj.get("burn") or 0.0)
+            except (TypeError, ValueError):
+                continue
+            if burn > max_burn:
+                max_burn = burn
+    try:
+        stale = int(doc.get("stale_instances") or 0)
+    except (TypeError, ValueError):
+        stale = 0
+    return {"reachable": True, "events_per_sec": rate, "parked": parked,
+            "runs": len(run_names), "run_names": run_names,
+            "max_burn": max_burn, "stale_instances": stale}
+
+
+def score_host(summary: Dict[str, Any], leased_runs: int,
+               affinity: bool = False,
+               max_runs_per_host: int = 0) -> Optional[float]:
+    """One host's placement score (higher = better target), or None
+    when the host is ineligible for NEW work: at its run cap, or its
+    own SLO burn already >= 1 (placing more load on a violating host
+    converts one noisy neighbor into a pool-wide outage).
+
+    ``leased_runs`` is the SERVICE's count of runs it has placed on the
+    host — authoritative over the snapshot's view, which lags one
+    monitor tick behind the service's own grants."""
+    occupancy = max(leased_runs, int(summary.get("runs") or 0))
+    if max_runs_per_host > 0 and occupancy >= max_runs_per_host:
+        return None
+    burn = float(summary.get("max_burn") or 0.0)
+    if burn >= 1.0:
+        return None
+    load = (float(summary.get("events_per_sec") or 0.0) / RATE_NORM
+            + int(summary.get("parked") or 0) / PARKED_NORM
+            + occupancy)
+    score = 1.0 / (1.0 + load) - 0.5 * burn
+    if affinity:
+        score += AFFINITY_BONUS
+    return score
+
+
+def choose_host(candidates: Iterable[Dict[str, Any]],
+                affinity_host: str = "",
+                max_runs_per_host: int = 0) -> Optional[str]:
+    """Pick the placement target out of ``candidates`` — dicts shaped
+    ``{"name", "summary", "leased_runs", "eligible"}`` (the service
+    marks draining/dead hosts ineligible before asking). Returns the
+    winning host name, or None when no host can take the run (the
+    lease goes pending / admission refuses)."""
+    best_name: Optional[str] = None
+    best_score = float("-inf")
+    for cand in candidates:
+        if not cand.get("eligible", True):
+            continue
+        summary = cand.get("summary") or {}
+        s = score_host(summary, int(cand.get("leased_runs") or 0),
+                       affinity=(cand.get("name") == affinity_host
+                                 and bool(affinity_host)),
+                       max_runs_per_host=max_runs_per_host)
+        if s is None:
+            continue
+        # deterministic tie-break on name so identical snapshots place
+        # identically across service restarts (fsck reconciliation
+        # depends on replayable decisions)
+        if s > best_score or (s == best_score and best_name is not None
+                              and str(cand.get("name")) < best_name):
+            best_score = s
+            best_name = str(cand.get("name"))
+    return best_name
+
+
+def pool_burn(summaries: Iterable[Dict[str, Any]]) -> float:
+    """The pool's admission burn rate: the worst SLO burn any
+    reachable host reports. Fleet-max (not mean) on purpose — one
+    violating host means the pool is ALREADY failing someone's
+    objective, and admission's job is to stop making that worse."""
+    worst = 0.0
+    for summary in summaries:
+        if not summary.get("reachable"):
+            continue
+        burn = float(summary.get("max_burn") or 0.0)
+        if burn > worst:
+            worst = burn
+    return worst

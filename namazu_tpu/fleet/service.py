@@ -1,0 +1,717 @@
+"""PlacementService: pool-level leases over M orchestrator hosts.
+
+The fleet-of-fleets control plane (doc/tenancy.md "Fleet of fleets").
+One service owns a pool of orchestrator hosts — each already serving
+the tenancy lease/renew/release wire (tenancy/registry.py) — and hands
+out POOL leases that it places onto a concrete host:
+
+* **placement** — capacity-aware (fleet/placement.py): each monitor
+  tick snapshots every host's federated ``/fleet`` document and scores
+  hosts by serving rate, parked depth, slot occupancy, and SLO burn;
+  a run's re-lease prefers the host that last served it (journal
+  affinity);
+* **migration** — ``drain`` (graceful: the old host's lease is
+  *reclaimed*, parking its events in the run's journal) and host
+  *death* (abrupt: snapshot fetches fail past the dead-after window)
+  both re-place the host's leases elsewhere; the replacement host's
+  ``lease`` with the same run name + journal dir recovers the parked
+  events exactly-once (tenancy/host.py ``_recover_ns_journal``);
+* **admission** — new pool leases are refused while the pool's worst
+  SLO burn is >= the admission threshold or no eligible host has a
+  free slot: the refusal is the 429 + Retry-After contract the
+  tenancy client's bounded retry honors (``fleet.admission.refuse``
+  is the chaos seam that forces it deterministically);
+* **one surface** — the service speaks the tenancy op grammar
+  (``lease``/``renew``/``release``/``reclaim``/``runs``) over the
+  framed wire, so an unmodified :class:`TenancyClient` — and therefore
+  ``nmz-tpu campaign --serve`` — can point at the pool instead of a
+  single host; pool ops (``pool_status``/``drain``/``hosts``) ride the
+  same wire for ``nmz-tpu fleet status``/``drain`` and
+  ``tools top --pool``.
+
+Lease replies carry ``host``/``host_url`` — the assigned host's
+workload URL — and renew replies repeat them, so a campaign notices a
+migration on its next renew and re-targets its transceivers.
+
+Pool state (``<state_dir>/fleet.json`` + ``leases/<id>.json`` +
+``journals/<run>/``) is persisted for ``tools fsck``: a SIGKILLed
+service leaves reconcilable records, never mystery files. The pool
+assumes its hosts share the state dir's filesystem (the local-pool /
+shared-storage deployment this repo targets); a cross-host pool would
+move journal recovery onto a blob store — out of scope here.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+import uuid as _uuid
+from typing import Any, Dict, List, Optional
+
+from namazu_tpu import chaos, obs
+from namazu_tpu.endpoint.framed import FramedServer
+from namazu_tpu.fleet import placement
+from namazu_tpu.tenancy.client import TenancyClient, TenancyWireError
+from namazu_tpu.tenancy.registry import TenancyError, _clamp_ttl
+from namazu_tpu.utils.atomic import atomic_write_json
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("fleet")
+
+MANIFEST_NAME = "fleet.json"
+MANIFEST_SCHEMA = "nmz-fleet-v1"
+LEASES_DIR = "leases"
+JOURNALS_DIR = "journals"
+
+#: wire ops that may block on a host round trip — parked per-connection
+#: by the framed server instead of wedging its worker pool
+BLOCKING_OPS = frozenset({"lease", "release", "reclaim", "drain"})
+
+#: default Retry-After (seconds) on an admission refusal
+DEFAULT_RETRY_AFTER_S = 0.5
+
+
+class HostState:
+    __slots__ = ("name", "url", "client", "state", "fails", "last_ok",
+                 "summary")
+
+    def __init__(self, name: str, url: str,
+                 timeout: float = 5.0) -> None:
+        self.name = name
+        self.url = url
+        self.client = TenancyClient(url, timeout=timeout)
+        #: "live" | "draining" | "dead"
+        self.state = "live"
+        self.fails = 0
+        self.last_ok = time.monotonic()
+        self.summary = placement.summarize_fleet_doc(None)
+
+
+class PoolLease:
+    __slots__ = ("lease_id", "run", "policy", "policy_param", "ttl_s",
+                 "collect_trace", "journal_dir", "host",
+                 "host_lease_id", "run_id", "expires_at", "migrations",
+                 "state")
+
+    def __init__(self, run: str, ttl_s: float, policy: str,
+                 policy_param: Optional[dict], collect_trace: bool,
+                 journal_dir: str) -> None:
+        self.lease_id = _uuid.uuid4().hex
+        self.run = run
+        self.policy = policy
+        self.policy_param = dict(policy_param) if policy_param else None
+        self.ttl_s = ttl_s
+        self.collect_trace = collect_trace
+        self.journal_dir = journal_dir
+        self.host = ""            # "" while pending
+        self.host_lease_id = ""
+        self.run_id = ""
+        self.expires_at = time.monotonic() + ttl_s
+        self.migrations = 0
+        #: "placed" | "pending" (no eligible host yet; retried per tick)
+        self.state = "pending"
+
+
+def _journal_slug(run: str) -> str:
+    """A filesystem-safe, collision-free directory name for one run's
+    pool journal (run names are namespace-validated, not path-
+    validated)."""
+    safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                   for c in run)[:48]
+    digest = hashlib.sha1(run.encode()).hexdigest()[:8]
+    return f"{safe}-{digest}"
+
+
+class PlacementService:
+    """One pool of orchestrator hosts behind one lease surface."""
+
+    def __init__(self, state_dir: str,
+                 default_ttl_s: float = 15.0,
+                 max_runs_per_host: int = 8,
+                 admission_burn_max: float = 1.0,
+                 monitor_interval_s: float = 0.5,
+                 dead_after_s: float = 3.0,
+                 retry_after_s: float = DEFAULT_RETRY_AFTER_S,
+                 host_timeout_s: float = 5.0) -> None:
+        self.state_dir = os.path.abspath(state_dir)
+        self.default_ttl_s = default_ttl_s
+        self.max_runs_per_host = max(0, int(max_runs_per_host))
+        self.admission_burn_max = float(admission_burn_max)
+        self.monitor_interval_s = max(0.05, float(monitor_interval_s))
+        self.dead_after_s = max(0.2, float(dead_after_s))
+        self.retry_after_s = max(0.0, float(retry_after_s))
+        self._host_timeout_s = host_timeout_s
+        # ONE lock over hosts/leases, held across the host round trips
+        # of a grant or migration: serializing placement is exactly the
+        # double-grant guard (a drained host's lease racing its
+        # replacement resolves to one winner), and the control plane's
+        # op rate is campaign lifecycles, not events
+        self._lock = threading.RLock()
+        self._hosts: Dict[str, HostState] = {}
+        self._leases: Dict[str, PoolLease] = {}
+        self._by_run: Dict[str, PoolLease] = {}
+        #: run -> host name that last served it (journal affinity)
+        self._affinity: Dict[str, str] = {}
+        self._counters: Dict[str, int] = {}
+        self._servers: List[FramedServer] = []
+        self._monitor_stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self.serve_urls: List[str] = []
+
+    # -- pool membership --------------------------------------------------
+
+    def add_host(self, url: str, name: str = "") -> str:
+        """Register one orchestrator host (``name=url`` spec or bare
+        url; the name defaults to ``hostN``)."""
+        if not name and "=" in url.split("://", 1)[0]:
+            name, url = url.split("=", 1)
+        with self._lock:
+            if not name:
+                name = f"host{len(self._hosts)}"
+            if name in self._hosts:
+                raise ValueError(f"duplicate host name {name!r}")
+            self._hosts[name] = HostState(name, url,
+                                          timeout=self._host_timeout_s)
+        return name
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        os.makedirs(os.path.join(self.state_dir, LEASES_DIR),
+                    exist_ok=True)
+        os.makedirs(os.path.join(self.state_dir, JOURNALS_DIR),
+                    exist_ok=True)
+        self._write_manifest()
+        self.refresh_hosts()
+        t = threading.Thread(target=self._monitor_loop,
+                             name="fleet-monitor", daemon=True)
+        t.start()
+        self._monitor = t
+
+    def serve_unix(self, path: str) -> None:
+        srv = FramedServer(self.handle_wire, name="fleet",
+                           blocking_ops=BLOCKING_OPS)
+        srv.bind_unix(path)
+        srv.start()
+        self._servers.append(srv)
+        self.serve_urls.append(f"uds://{path}")
+        self._write_manifest()
+
+    def serve_tcp(self, host: str, port: int) -> int:
+        srv = FramedServer(self.handle_wire, name="fleet",
+                           blocking_ops=BLOCKING_OPS)
+        bound = srv.bind_tcp(host, port)
+        srv.start()
+        self._servers.append(srv)
+        self.serve_urls.append(f"tcp://{host}:{bound}")
+        self._write_manifest()
+        return bound
+
+    def shutdown(self) -> None:
+        self._monitor_stop.set()
+        for srv in self._servers:
+            srv.shutdown()
+        self._servers = []
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+            self._monitor = None
+        with self._lock:
+            for host in self._hosts.values():
+                host.client.close()
+
+    # -- persistence (tools fsck reads these) -----------------------------
+
+    def _write_manifest(self) -> None:
+        with self._lock:
+            hosts = {h.name: h.url for h in self._hosts.values()}
+        atomic_write_json(
+            os.path.join(self.state_dir, MANIFEST_NAME),
+            {"schema": MANIFEST_SCHEMA, "pid": os.getpid(),
+             "serve_urls": list(self.serve_urls), "hosts": hosts,
+             "updated_at": time.time()}, indent=2, sort_keys=True)
+
+    def _lease_record_path(self, lease_id: str) -> str:
+        return os.path.join(self.state_dir, LEASES_DIR,
+                            f"{lease_id}.json")
+
+    def _persist_lease(self, lease: PoolLease) -> None:
+        with self._lock:
+            host = self._hosts.get(lease.host)
+            doc = {
+                "lease_id": lease.lease_id, "run": lease.run,
+                "host": lease.host,
+                "host_url": host.url if host is not None else "",
+                "journal_dir": lease.journal_dir,
+                "policy": lease.policy,
+                "policy_param": lease.policy_param,
+                "ttl_s": lease.ttl_s, "state": lease.state,
+                "migrations": lease.migrations,
+                # walltime expiry so an offline fsck can age records
+                # without this process's monotonic clock
+                "expires_wall": time.time() + max(
+                    0.0, lease.expires_at - time.monotonic()),
+            }
+        atomic_write_json(self._lease_record_path(lease.lease_id), doc,
+                          indent=2, sort_keys=True)
+
+    def _drop_lease_record(self, lease_id: str) -> None:
+        try:
+            os.unlink(self._lease_record_path(lease_id))
+        except OSError:
+            pass
+
+    # -- monitor ----------------------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        while not self._monitor_stop.wait(self.monitor_interval_s):
+            try:
+                self.refresh_hosts()
+                self.place_pending()
+                self.sweep()
+            except Exception:  # pragma: no cover - defensive
+                log.exception("fleet monitor tick failed")
+
+    def refresh_hosts(self) -> None:
+        """Snapshot every host's ``/fleet`` doc; declare hosts dead
+        past the silence window and migrate their leases."""
+        from namazu_tpu.obs import federation
+
+        with self._lock:
+            hosts = list(self._hosts.values())
+        died: List[HostState] = []
+        now = time.monotonic()
+        for host in hosts:
+            try:
+                doc = federation.fetch(host.url, "fleet")
+            except Exception:
+                host.fails += 1
+                if (host.state == "live"
+                        and now - host.last_ok >= self.dead_after_s):
+                    host.state = "dead"
+                    died.append(host)
+                continue
+            host.summary = placement.summarize_fleet_doc(doc)
+            host.fails = 0
+            host.last_ok = time.monotonic()
+            if host.state == "dead":
+                # a host back from the dead rejoins as a placement
+                # target; its old leases were already migrated away
+                log.warning("host %s is reachable again; rejoining the "
+                            "pool", host.name)
+                host.state = "live"
+        for host in died:
+            log.warning("host %s silent for %.1fs; declaring it dead "
+                        "and re-placing its leases", host.name,
+                        now - host.last_ok)
+            self._migrate_host_leases(host.name, reason="death")
+        self._refresh_gauges()
+
+    def _refresh_gauges(self) -> None:
+        with self._lock:
+            hosts = len(self._hosts)
+            dead = sum(1 for h in self._hosts.values()
+                       if h.state == "dead")
+            leases = len(self._leases)
+            pending = sum(1 for l in self._leases.values()
+                          if l.state == "pending")
+        obs.fleet_pool_stats(hosts, dead, leases, pending)
+
+    # -- placement --------------------------------------------------------
+
+    def _candidates(self, exclude: str = "") -> List[Dict[str, Any]]:
+        with self._lock:
+            per_host: Dict[str, int] = {}
+            for lease in self._leases.values():
+                if lease.host:
+                    per_host[lease.host] = per_host.get(lease.host,
+                                                        0) + 1
+            return [{
+                "name": h.name, "summary": h.summary,
+                "leased_runs": per_host.get(h.name, 0),
+                "eligible": h.state == "live" and h.name != exclude,
+            } for h in self._hosts.values()]
+
+    def _choose_host(self, run: str,
+                     exclude: str = "") -> Optional[HostState]:
+        name = placement.choose_host(
+            self._candidates(exclude=exclude),
+            affinity_host=self._affinity.get(run, ""),
+            max_runs_per_host=self.max_runs_per_host)
+        if name is None:
+            return None
+        with self._lock:
+            return self._hosts.get(name)
+
+    def _admission_refusal(self) -> Optional[Dict[str, Any]]:
+        """The admission gate for NEW leases (never migrations — an
+        overloaded pool still re-places a dead host's existing
+        tenants). Returns the refusal doc, or None to admit."""
+        fault = chaos.decide("fleet.admission.refuse")
+        if fault is not None:
+            obs.fleet_admission_rejected("chaos")
+            self._count("admission_rejections")
+            return {"ok": False,
+                    "error": "pool admission refused (chaos)",
+                    "status": int(fault.get("status", 429)),
+                    "retry_after": float(fault.get("retry_after",
+                                                   self.retry_after_s))}
+        with self._lock:
+            summaries = [h.summary for h in self._hosts.values()
+                         if h.state == "live"]
+        burn = placement.pool_burn(summaries)
+        if burn >= self.admission_burn_max:
+            obs.fleet_admission_rejected("slo_burn")
+            self._count("admission_rejections")
+            return {"ok": False,
+                    "error": f"pool SLO burn {burn:.2f} >= "
+                             f"{self.admission_burn_max:g}; not "
+                             "admitting new runs",
+                    "status": 429,
+                    "retry_after": self.retry_after_s}
+        return None
+
+    def _count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    # -- wire ops ---------------------------------------------------------
+
+    def handle_wire(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        op = req.get("op")
+        try:
+            if op == "lease":
+                return self.lease_op(req)
+            if op == "renew":
+                return self.renew_op(req)
+            if op == "release":
+                return self.release_op(req)
+            if op == "reclaim":
+                return self.reclaim_op(req)
+            if op == "runs":
+                return {"ok": True, "runs": self.runs_payload()}
+            if op == "pool_status":
+                return {"ok": True, "pool": self.pool_payload()}
+            if op == "drain":
+                return self.drain_op(req)
+            if op == "hosts":
+                with self._lock:
+                    return {"ok": True,
+                            "hosts": {h.name: h.url
+                                      for h in self._hosts.values()}}
+        except TenancyWireError as e:
+            return {"ok": False, "error": f"host op failed: {e}"}
+        except (TenancyError, ValueError) as e:
+            return {"ok": False, "error": str(e)}
+        return {"ok": False, "error": f"unknown pool op {op!r}"}
+
+    def lease_op(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        from namazu_tpu import tenancy
+
+        run = tenancy.validate_ns(req.get("run") or "")
+        ttl = _clamp_ttl(req.get("ttl_s"), default=self.default_ttl_s)
+        refusal = self._admission_refusal()
+        if refusal is not None:
+            log.warning("admission refused lease for run %s: %s", run,
+                        refusal["error"])
+            return refusal
+        with self._lock:
+            if run in self._by_run:
+                return {"ok": False,
+                        "error": f"run {run!r} is already pool-leased"}
+            host = self._choose_host(run)
+            if host is None:
+                obs.fleet_admission_rejected("capacity")
+                self._count("admission_rejections")
+                return {"ok": False,
+                        "error": "no eligible host has a free slot",
+                        "status": 429,
+                        "retry_after": self.retry_after_s}
+            lease = PoolLease(
+                run=run, ttl_s=ttl,
+                policy=str(req.get("policy") or "random"),
+                policy_param=(req.get("policy_param")
+                              if isinstance(req.get("policy_param"),
+                                            dict) else None),
+                collect_trace=bool(req.get("collect_trace", True)),
+                journal_dir=os.path.join(self.state_dir, JOURNALS_DIR,
+                                         _journal_slug(run)))
+            doc = self._grant_on_host(lease, host)
+            self._leases[lease.lease_id] = lease
+            self._by_run[run] = lease
+            self._affinity[run] = host.name
+        self._persist_lease(lease)
+        self._refresh_gauges()
+        log.info("pool-leased run %s onto %s (ttl %.1fs%s)", run,
+                 host.name, ttl,
+                 f", recovered {doc.get('recovered')}"
+                 if doc.get("recovered") else "")
+        return {"ok": True, "lease_id": lease.lease_id, "run": run,
+                "run_id": lease.run_id, "ttl_s": ttl,
+                "recovered": doc.get("recovered", 0),
+                "host": host.name, "host_url": host.url}
+
+    def _grant_on_host(self, lease: PoolLease,
+                       host: HostState) -> Dict[str, Any]:
+        """Grant ``lease`` on ``host`` over the per-host tenancy wire;
+        updates the lease's placement fields. Raises TenancyWireError
+        upward (the caller answers ``ok: false``)."""
+        doc = host.client.lease(
+            lease.run, ttl_s=lease.ttl_s, policy=lease.policy,
+            policy_param=lease.policy_param,
+            journal_dir=lease.journal_dir,
+            collect_trace=lease.collect_trace)
+        lease.host = host.name
+        lease.host_lease_id = doc.get("lease_id", "")
+        lease.run_id = doc.get("run_id", "")
+        lease.state = "placed"
+        lease.expires_at = time.monotonic() + lease.ttl_s
+        return doc
+
+    def renew_op(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        lease_id = str(req.get("lease_id") or "")
+        with self._lock:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                return {"ok": False,
+                        "error": f"unknown pool lease {lease_id!r} "
+                                 "(expired and reclaimed?)"}
+            lease.ttl_s = _clamp_ttl(req.get("ttl_s"),
+                                     default=lease.ttl_s)
+            lease.expires_at = time.monotonic() + lease.ttl_s
+            host = self._hosts.get(lease.host)
+            if lease.state == "placed" and host is not None \
+                    and host.state != "dead":
+                try:
+                    host.client.renew(lease.host_lease_id,
+                                      ttl_s=lease.ttl_s)
+                except TenancyWireError as e:
+                    # the host forgot the lease (restart, expiry while
+                    # we were partitioned): re-place it now — the
+                    # journal recovers whatever was parked
+                    log.warning("host %s lost lease for run %s (%s); "
+                                "re-placing", lease.host, lease.run, e)
+                    self._migrate_lease(lease, reason="death",
+                                        reclaim_old=False)
+                    host = self._hosts.get(lease.host)
+            return {"ok": True, "lease_id": lease_id, "run": lease.run,
+                    "ttl_s": lease.ttl_s,
+                    "migrations": lease.migrations,
+                    "state": lease.state, "host": lease.host,
+                    "host_url": host.url if host is not None else ""}
+
+    def release_op(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        lease_id = str(req.get("lease_id") or "")
+        with self._lock:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                return {"ok": False,
+                        "error": f"unknown pool lease {lease_id!r} "
+                                 "(expired and reclaimed?)"}
+            host = self._hosts.get(lease.host)
+            if lease.state != "placed" or host is None:
+                return {"ok": False,
+                        "error": f"run {lease.run} is not placed "
+                                 "(pending re-placement); retry",
+                        "status": 429,
+                        "retry_after": self.retry_after_s}
+            doc = host.client.release(
+                lease.host_lease_id,
+                want_trace=bool(req.get("trace", True)))
+            self._forget_lease(lease)
+        self._drop_lease_record(lease_id)
+        self._sweep_released_journal(lease)
+        self._refresh_gauges()
+        log.info("pool-released run %s from %s", lease.run, lease.host)
+        return dict(doc, ok=True, host=lease.host)
+
+    def _sweep_released_journal(self, lease: PoolLease) -> None:
+        """A clean release removed the journal FILE (the run
+        completed); remove the now-empty per-run journal dir too, so
+        the pool state dir fscks clean without repair. Never touches a
+        journal with unreleased events — reclaim/migration keep theirs."""
+        import shutil
+
+        try:
+            from namazu_tpu.chaos.journal import EventJournal
+
+            if lease.journal_dir \
+                    and not EventJournal(lease.journal_dir).unreleased():
+                shutil.rmtree(lease.journal_dir, ignore_errors=True)
+        except Exception:
+            pass  # an unreadable journal is fsck's business, not ours
+
+    def reclaim_op(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        lease_id = str(req.get("lease_id") or "")
+        with self._lock:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                return {"ok": False,
+                        "error": f"unknown pool lease {lease_id!r} "
+                                 "(expired and reclaimed?)"}
+            host = self._hosts.get(lease.host)
+            doc: Dict[str, Any] = {"run": lease.run}
+            if lease.state == "placed" and host is not None \
+                    and host.state != "dead":
+                doc = host.client.reclaim(lease.host_lease_id)
+            self._forget_lease(lease)
+        self._drop_lease_record(lease_id)
+        self._refresh_gauges()
+        return dict(doc, ok=True, host=lease.host)
+
+    def _forget_lease(self, lease: PoolLease) -> None:
+        self._leases.pop(lease.lease_id, None)
+        if self._by_run.get(lease.run) is lease:
+            self._by_run.pop(lease.run, None)
+
+    def drain_op(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        name = str(req.get("host") or "")
+        with self._lock:
+            host = self._hosts.get(name)
+            if host is None:
+                return {"ok": False, "error": f"unknown host {name!r}"}
+            if host.state == "dead":
+                return {"ok": False,
+                        "error": f"host {name} is dead (its leases "
+                                 "were already re-placed)"}
+            host.state = "draining"
+        moved = self._migrate_host_leases(name, reason="drain")
+        log.info("drained host %s: %d lease(s) re-placed", name, moved)
+        return {"ok": True, "host": name, "migrated": moved}
+
+    # -- migration --------------------------------------------------------
+
+    def _migrate_host_leases(self, host_name: str, reason: str) -> int:
+        with self._lock:
+            mine = [l for l in self._leases.values()
+                    if l.host == host_name and l.state == "placed"]
+            moved = 0
+            for lease in mine:
+                self._migrate_lease(lease, reason=reason,
+                                    reclaim_old=(reason == "drain"))
+                moved += 1
+        self._refresh_gauges()
+        return moved
+
+    def _migrate_lease(self, lease: PoolLease, reason: str,
+                       reclaim_old: bool) -> None:
+        """Move one lease off its current host. Graceful moves reclaim
+        the old host's lease first (parking its events in the run's
+        journal); abrupt moves skip that — a dead host already left
+        the journal as its last word. Either way the replacement
+        host's grant with the same run + journal dir is the
+        exactly-once recovery step. Caller holds the service lock."""
+        old_host = self._hosts.get(lease.host)
+        if reclaim_old and old_host is not None \
+                and lease.host_lease_id:
+            try:
+                old_host.client.reclaim(lease.host_lease_id)
+            except TenancyWireError as e:
+                log.warning("reclaiming run %s on %s failed (%s); its "
+                            "lease will expire server-side", lease.run,
+                            lease.host, e)
+        exclude = lease.host
+        lease.host = ""
+        lease.host_lease_id = ""
+        lease.state = "pending"
+        replacement = self._choose_host(lease.run, exclude=exclude)
+        if replacement is None:
+            log.warning("no eligible host for run %s after %s of %s; "
+                        "left pending", lease.run, reason, exclude)
+            self._persist_lease(lease)
+            return
+        try:
+            doc = self._grant_on_host(lease, replacement)
+        except TenancyWireError as e:
+            log.warning("re-placing run %s onto %s failed (%s); left "
+                        "pending", lease.run, replacement.name, e)
+            self._persist_lease(lease)
+            return
+        lease.migrations += 1
+        self._affinity[lease.run] = replacement.name
+        self._count(f"migrations_{reason}")
+        obs.fleet_migration(reason)
+        self._persist_lease(lease)
+        log.warning("migrated run %s: %s -> %s (%s, recovered %s "
+                    "parked event(s))", lease.run, exclude,
+                    replacement.name, reason, doc.get("recovered", 0))
+
+    def place_pending(self) -> int:
+        """Retry placement of pending leases (no-eligible-host at
+        migration time); returns how many landed."""
+        placed = 0
+        with self._lock:
+            pending = [l for l in self._leases.values()
+                       if l.state == "pending"]
+            for lease in pending:
+                host = self._choose_host(lease.run)
+                if host is None:
+                    continue
+                try:
+                    self._grant_on_host(lease, host)
+                except TenancyWireError as e:
+                    log.warning("placing pending run %s onto %s failed "
+                                "(%s)", lease.run, host.name, e)
+                    continue
+                lease.migrations += 1
+                self._affinity[lease.run] = host.name
+                self._count("migrations_death")
+                obs.fleet_migration("death")
+                self._persist_lease(lease)
+                placed += 1
+        if placed:
+            self._refresh_gauges()
+        return placed
+
+    def sweep(self, now: Optional[float] = None) -> int:
+        """Expire pool leases whose tenant stopped renewing (one full
+        TTL past expiry — the per-host lease has its own TTL and
+        reclaims first; this sweep just stops the pool record from
+        outliving everyone). Journals are kept, records dropped."""
+        now = time.monotonic() if now is None else now
+        due: List[PoolLease] = []
+        with self._lock:
+            for lease in list(self._leases.values()):
+                if now - lease.expires_at >= lease.ttl_s:
+                    self._forget_lease(lease)
+                    due.append(lease)
+        for lease in due:
+            self._drop_lease_record(lease.lease_id)
+            log.warning("pool lease for run %s expired (tenant dead?); "
+                        "record dropped, journal kept in %s", lease.run,
+                        lease.journal_dir)
+        if due:
+            self._refresh_gauges()
+        return len(due)
+
+    # -- status payloads --------------------------------------------------
+
+    def runs_payload(self) -> List[Dict[str, Any]]:
+        now = time.monotonic()
+        with self._lock:
+            return [{
+                "run": l.run, "run_id": l.run_id,
+                "lease_id": l.lease_id, "ttl_s": l.ttl_s,
+                "expires_in_s": round(l.expires_at - now, 3),
+                "host": l.host, "state": l.state,
+                "migrations": l.migrations,
+            } for l in self._leases.values()]
+
+    def pool_payload(self) -> Dict[str, Any]:
+        """The one-surface document ``fleet status`` and ``tools top
+        --pool`` render: every host with its load summary and state,
+        every pool lease with its placement, and the service's
+        migration/admission counters."""
+        now = time.monotonic()
+        with self._lock:
+            hosts = [{
+                "name": h.name, "url": h.url, "state": h.state,
+                "fails": h.fails,
+                "last_ok_age_s": round(now - h.last_ok, 3),
+                "summary": dict(h.summary),
+            } for h in self._hosts.values()]
+            counters = dict(self._counters)
+        return {"schema": "nmz-pool-v1", "state_dir": self.state_dir,
+                "hosts": hosts, "leases": self.runs_payload(),
+                "counters": counters}

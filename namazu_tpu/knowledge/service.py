@@ -20,6 +20,7 @@ unrelated experiment can never pollute another's training set.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import os
 import threading
@@ -160,6 +161,12 @@ class KnowledgeService:
         os.makedirs(self.pool_dir, exist_ok=True)
         os.makedirs(self.state_dir, exist_ok=True)
         self._lock = threading.Lock()
+        # fan-in instrumentation: how many ops are in flight and how
+        # long they wait for the state lock (nmz_knowledge_fanin_*) —
+        # the serialization N orchestrators' end-of-run pushes would
+        # otherwise hide until it surfaces as client timeouts
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         # tenant -> {"first_seen", "last_seen", "pushes", "pulls"}
         self._tenants: Dict[str, Dict[str, Any]] = {}
         # scenario fingerprint -> {"delays", "fitness", "H", "updated_at"}
@@ -341,28 +348,58 @@ class KnowledgeService:
         if handler is None:
             return {"ok": False, "v": self.VERSION,
                     "error": f"unknown knowledge op {op!r}"}
-        with self._lock:
+        # fan-in contract: the dispatch itself holds NO lock — each
+        # handler takes the state lock only around its in-memory
+        # mutations (via _locked, which also measures the wait), so one
+        # tenant's pool-entry file loop or model inference never
+        # serializes the other N-1 orchestrators' pushes behind it
+        with self._inflight_lock:
+            self._inflight += 1
+            inflight = self._inflight
+        obs.knowledge_fanin(inflight)
+        try:
             try:
                 resp = handler(req)
             except Exception as e:
                 log.exception("knowledge op %s failed", op)
                 resp = {"ok": False, "error": repr(e)}
-        # deferred surrogate work (snapshots taken under the lock) runs
-        # HERE, outside it: a jax fit + npz persist must never stall
-        # other tenants' pulls behind the global lock (or blow this
-        # client's timeout into a phantom outage)
-        deferred = resp.pop("_deferred", ())
-        trained = False
-        for key, store, digests, feats, labels, want_train in deferred:
-            self._save_store(key, digests, feats, labels)
-            if want_train:
-                trained = store.train_on(feats, labels) or trained
-        if deferred and op == "pool_push":
-            resp["trained"] = trained  # settled now that the fit ran
-        resp.setdefault("v", self.VERSION)
-        obs.knowledge_service_stats(len(self._tenants),
-                                    pool_size(self.pool_dir))
-        return resp
+            # deferred surrogate work (snapshots taken under the lock)
+            # runs HERE, outside it: a jax fit + npz persist must never
+            # stall other tenants' pulls behind the global lock (or
+            # blow this client's timeout into a phantom outage)
+            deferred = resp.pop("_deferred", ())
+            trained = False
+            for key, store, digests, feats, labels, want_train \
+                    in deferred:
+                self._save_store(key, digests, feats, labels)
+                if want_train:
+                    trained = store.train_on(feats, labels) or trained
+            if deferred and op == "pool_push":
+                resp["trained"] = trained  # settled now that the fit ran
+            resp.setdefault("v", self.VERSION)
+            obs.knowledge_service_stats(len(self._tenants),
+                                        pool_size(self.pool_dir))
+            return resp
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+                inflight = self._inflight
+            obs.knowledge_fanin(inflight)
+
+    @contextlib.contextmanager
+    def _locked(self):
+        """The service state lock, with the wait measured into
+        ``nmz_knowledge_fanin_lock_wait_seconds`` — if narrowing the
+        critical sections ever regresses, the histogram says so before
+        tenants' timeouts do."""
+        t0 = time.monotonic()
+        self._lock.acquire()
+        try:
+            obs.knowledge_fanin(self._inflight,
+                                lock_wait_s=time.monotonic() - t0)
+            yield
+        finally:
+            self._lock.release()
 
     def _touch_tenant(self, req: dict, what: str) -> str:
         tenant = str(req.get("tenant") or "anon")
@@ -379,9 +416,14 @@ class KnowledgeService:
         """Ingest failure signatures (content-keyed, exactly-once),
         optionally a scenario's best delay table, and optionally labeled
         surrogate examples. All three ride one op so a tenant's
-        end-of-run push is a single round trip."""
-        self._touch_tenant(req, "pushes")
-        self._pushes += 1
+        end-of-run push is a single round trip.
+
+        The entry file loop (one fsync'd tmp+rename per signature) runs
+        OUTSIDE the service lock: pool entries are content-keyed and
+        ``pool_put`` is atomic per entry, so N orchestrators' pushes
+        fan in concurrently instead of serializing behind one tenant's
+        disk writes — only the in-memory table/coverage/example
+        mutations take the lock."""
         scenario = str(req.get("scenario") or "")
         accepted = duplicates = rejected = 0
         for d in req.get("entries") or []:
@@ -396,18 +438,22 @@ class KnowledgeService:
                 accepted += 1
             else:
                 duplicates += 1
-        self._dedupe_hits += duplicates
         best = req.get("best")
-        if best and scenario:
-            self._install_best(scenario, best)
         coverage = req.get("coverage")
-        if coverage and scenario:
-            self._merge_coverage(scenario, coverage)
         examples = req.get("examples") or []
         pairs_fp = str(req.get("pairs_fp") or "")
-        deferred = []
-        if examples and scenario and pairs_fp:
-            deferred = self._add_examples(scenario, pairs_fp, examples)
+        with self._locked():
+            self._touch_tenant(req, "pushes")
+            self._pushes += 1
+            self._dedupe_hits += duplicates
+            if best and scenario:
+                self._install_best(scenario, best)
+            if coverage and scenario:
+                self._merge_coverage(scenario, coverage)
+            deferred = []
+            if examples and scenario and pairs_fp:
+                deferred = self._add_examples(scenario, pairs_fp,
+                                              examples)
         return {"ok": True, "accepted": accepted,
                 "duplicates": duplicates, "rejected": rejected,
                 "trained": False,  # settled post-lock from _deferred
@@ -504,12 +550,37 @@ class KnowledgeService:
     def _pool_pull(self, req: dict) -> dict:
         """Serve the warm-start: pooled signatures compatible with the
         tenant's bucket count (minus what it already has) plus the
-        scenario's best delay table."""
-        self._touch_tenant(req, "pulls")
-        self._pulls += 1
+        scenario's best delay table. The pool-dir scan runs outside the
+        service lock (content-keyed entries never move once written);
+        only the table/coverage lookups take it."""
         from namazu_tpu.models.failure_pool import MAX_LOAD
 
         h = int(req.get("H") or 0)
+        scenario = str(req.get("scenario") or "")
+        with self._locked():
+            self._touch_tenant(req, "pulls")
+            self._pulls += 1
+            table: Optional[dict] = None
+            cur = self._scenarios.get(scenario)
+            if cur is not None and (h <= 0 or cur.get("H") == h):
+                table = {"delays": cur["delays"],
+                         "fitness": cur["fitness"], "H": cur["H"]}
+            coverage: Optional[dict] = None
+            space = req.get("coverage_space")
+            if isinstance(space, dict):
+                # v2 coverage warm-start: an exact (scenario, space)
+                # key lookup — bit indices mean nothing across spaces
+                try:
+                    cov = self._coverage.get(self._coverage_key(
+                        scenario, int(space.get("H", 0)),
+                        int(space.get("w", 0)),
+                        int(space.get("win", 0))))
+                except (TypeError, ValueError):
+                    cov = None
+                if cov is not None:
+                    coverage = {"H": cov["H"], "w": cov["w"],
+                                "win": cov["win"],
+                                "bits": sorted(cov["bits"])}
         exclude = set(req.get("exclude") or [])
         max_entries = int(req.get("max_entries", MAX_LOAD))
         entries = []
@@ -527,28 +598,10 @@ class KnowledgeService:
                     continue
                 d["digest"] = e.digest
                 entries.append(d)
-        table: Optional[dict] = None
-        scenario = str(req.get("scenario") or "")
-        cur = self._scenarios.get(scenario)
-        if cur is not None and (h <= 0 or cur.get("H") == h):
-            table = {"delays": cur["delays"], "fitness": cur["fitness"],
-                     "H": cur["H"]}
         resp = {"ok": True, "entries": entries, "scenario_table": table,
                 "pool_size": pool_size(self.pool_dir)}
-        space = req.get("coverage_space")
-        if isinstance(space, dict):
-            # v2 coverage warm-start: an exact (scenario, space) key
-            # lookup — bit indices mean nothing across spaces
-            try:
-                cov = self._coverage.get(self._coverage_key(
-                    scenario, int(space.get("H", 0)),
-                    int(space.get("w", 0)), int(space.get("win", 0))))
-            except (TypeError, ValueError):
-                cov = None
-            if cov is not None:
-                resp["coverage"] = {"H": cov["H"], "w": cov["w"],
-                                    "win": cov["win"],
-                                    "bits": sorted(cov["bits"])}
+        if coverage is not None:
+            resp["coverage"] = coverage
         return resp
 
     def _surrogate_predict(self, req: dict) -> dict:
@@ -562,21 +615,27 @@ class KnowledgeService:
         if feats.ndim != 2 or feats.shape[0] == 0:
             return {"ok": False, "error": "feats must be [N, K]"}
         key = (scenario, pairs_fp, int(feats.shape[1]))
-        store = self._surrogates.get(key)
-        if store is None and os.path.exists(self._store_path(key)):
-            store = self._get_store(key)  # restart recovery
-        if store is None:
-            return {"ok": True, "trained": False}
-        deferred = []
-        if store.dirty:
-            # a recovered (or thin-then-grown) example set retrains
-            # lazily — deferred outside the lock like every fit, so THIS
-            # reply says untrained (tenant keeps its argmax) and the
-            # next predict is served from the fresh model
-            deferred.append(self._snapshot_deferred(key, store))
-        if store.model is None:
+        with self._locked():
+            store = self._surrogates.get(key)
+            if store is None and os.path.exists(self._store_path(key)):
+                store = self._get_store(key)  # restart recovery
+            if store is None:
+                return {"ok": True, "trained": False}
+            deferred = []
+            if store.dirty:
+                # a recovered (or thin-then-grown) example set retrains
+                # lazily — deferred outside the lock like every fit, so
+                # THIS reply says untrained (tenant keeps its argmax)
+                # and the next predict is served from the fresh model
+                deferred.append(self._snapshot_deferred(key, store))
+            model = store.model
+        if model is None:
             return {"ok": True, "trained": False, "_deferred": deferred}
-        probs = store.model.predict(feats)
+        # inference runs outside the SERVICE lock (other tenants' ops
+        # proceed) but under the store's train lock, never against
+        # params a concurrent fit is mid-update on
+        with store.train_lock:
+            probs = model.predict(feats)
         return {"ok": True, "trained": True,
                 "probs": [float(p) for p in probs],
                 "train_rounds": store.train_rounds,
@@ -589,7 +648,6 @@ class KnowledgeService:
         dossier when it is strictly better — validated beats
         unvalidated, then fewer minimal flips wins — so a worse late
         arrival can never clobber the fleet's best explanation."""
-        self._touch_tenant(req, "pushes")
         dossier = req.get("dossier")
         if not isinstance(dossier, dict):
             return {"ok": False, "error": "triage_push needs a dossier"}
@@ -607,30 +665,37 @@ class KnowledgeService:
                 flips = float("inf")
             return (0 if d.get("validated") else 1, flips)
 
-        cur = self._triage.get(sig)
-        accepted = cur is None or _rank(dossier) < _rank(cur)
-        if accepted:
-            self._triage[sig] = dossier
-            self._save_triage()
-        return {"ok": True, "accepted": accepted,
-                "dossier_count": len(self._triage)}
+        with self._locked():
+            self._touch_tenant(req, "pushes")
+            cur = self._triage.get(sig)
+            accepted = cur is None or _rank(dossier) < _rank(cur)
+            if accepted:
+                self._triage[sig] = dossier
+                self._save_triage()
+            return {"ok": True, "accepted": accepted,
+                    "dossier_count": len(self._triage)}
 
     def _triage_pull(self, req: dict) -> dict:
         """Serve the dossier pooled for one failure signature — the
         cross-tenant payoff: a cold tenant hitting a known signature
         gets the minimized repro without paying for the replays."""
-        self._touch_tenant(req, "pulls")
-        self._triage_pulls += 1
         sig = str(req.get("signature") or "")
-        dossier = self._triage.get(sig)
-        if dossier is not None:
-            self._triage_hits += 1
-        return {"ok": True, "dossier": dossier,
-                "dossier_count": len(self._triage)}
+        with self._locked():
+            self._touch_tenant(req, "pulls")
+            self._triage_pulls += 1
+            dossier = self._triage.get(sig)
+            if dossier is not None:
+                self._triage_hits += 1
+            return {"ok": True, "dossier": dossier,
+                    "dossier_count": len(self._triage)}
 
     def _stats(self, req: dict) -> dict:
         """Pool/tenant occupancy for dashboards and the PR 3 analytics
         plane (obs/analytics.py folds this into its payload)."""
+        with self._locked():
+            return self._stats_locked()
+
+    def _stats_locked(self) -> dict:
         return {
             "ok": True,
             "pool_dir": self.pool_dir,

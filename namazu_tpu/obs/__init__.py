@@ -92,10 +92,14 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     event_stage,
     event_stage_many,
     experiment_stats,
+    fleet_admission_rejected,
+    fleet_migration,
     fleet_occupancy,
+    fleet_pool_stats,
     ingress_rejected,
     journal_events,
     journal_recovered,
+    knowledge_fanin,
     knowledge_outage,
     knowledge_pull,
     knowledge_push,

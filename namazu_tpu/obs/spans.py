@@ -140,6 +140,16 @@ TENANCY_RUNS = "nmz_tenancy_runs"
 TENANCY_RECLAIMS = "nmz_tenancy_reclaims_total"
 REST_CONN_THREADS = "nmz_rest_conn_threads"
 REST_CONNS_QUEUED = "nmz_rest_conns_queued"
+# fleet placement plane (doc/tenancy.md "Fleet of fleets"): pool-level
+# lease migrations by reason (drain = operator-requested, death = TTL /
+# staleness declared the host dead), admission-control refusals, and the
+# placement service's live occupancy (hosts by liveness, pool leases,
+# placements still waiting for an eligible host)
+FLEET_MIGRATIONS = "nmz_fleet_migrations_total"
+FLEET_ADMISSION_REJECTIONS = "nmz_fleet_admission_rejections_total"
+FLEET_POOL_HOSTS = "nmz_fleet_pool_hosts"
+FLEET_POOL_LEASES = "nmz_fleet_pool_leases"
+FLEET_POOL_PENDING = "nmz_fleet_pool_pending_placements"
 
 # chaos + survivability plane (doc/robustness.md "Chaos plane"):
 # injected faults by point, ingress backpressure rejections, the
@@ -162,6 +172,11 @@ KNOWLEDGE_SURROGATE_ROUNDS = "nmz_knowledge_surrogate_train_rounds_total"
 KNOWLEDGE_TENANTS = "nmz_knowledge_tenants"
 KNOWLEDGE_POOL = "nmz_knowledge_pool_entries"
 KNOWLEDGE_OUTAGES = "nmz_knowledge_outages_total"
+# knowledge fan-in (M orchestrator hosts pushing concurrently): requests
+# currently inside the service handler, and how long each waited for the
+# shared-state lock — the serialize-behind-one-lock regression detector
+KNOWLEDGE_FANIN_INFLIGHT = "nmz_knowledge_fanin_inflight"
+KNOWLEDGE_FANIN_LOCK_WAIT = "nmz_knowledge_fanin_lock_wait_seconds"
 
 # triage plane (doc/observability.md "Triage"): minimization probe
 # traffic split by mode (simulated = free predicted_gain scoring,
@@ -586,6 +601,51 @@ def tenancy_reclaim(run: str) -> None:
         TENANCY_RECLAIMS,
         "tenant namespaces reclaimed after lease expiry", ("run",),
     ).labels(run=run).inc()
+
+
+def fleet_migration(reason: str, n: int = 1) -> None:
+    """``n`` pool leases re-placed onto a replacement host, by reason
+    (``drain`` = operator-requested graceful evacuation, ``death`` =
+    the monitor declared the host dead)."""
+    if n <= 0 or not metrics.enabled():
+        return
+    metrics.get().counter(
+        FLEET_MIGRATIONS,
+        "pool leases migrated to a replacement host, by reason",
+        ("reason",),
+    ).labels(reason=reason).inc(n)
+
+
+def fleet_admission_rejected(reason: str) -> None:
+    """The placement service refused a pool lease (``slo_burn`` = the
+    pool's SLO burn gate tripped, ``capacity`` = no eligible host had a
+    free slot, ``chaos`` = the fleet.admission.refuse seam fired)."""
+    if not metrics.enabled():
+        return
+    metrics.get().counter(
+        FLEET_ADMISSION_REJECTIONS,
+        "pool lease requests refused by admission control", ("reason",),
+    ).labels(reason=reason).inc()
+
+
+def fleet_pool_stats(hosts: int, dead: int, leases: int,
+                     pending: int) -> None:
+    """The placement service's occupancy gauges, refreshed on every
+    monitor tick: pool hosts by liveness, granted pool leases, and
+    placements still waiting for an eligible host."""
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    g = reg.gauge(FLEET_POOL_HOSTS,
+                  "orchestrator hosts in the placement pool, by state",
+                  ("state",))
+    g.labels(state="live").set(max(0, hosts - dead))
+    g.labels(state="dead").set(dead)
+    reg.gauge(FLEET_POOL_LEASES,
+              "pool leases the placement service has granted",
+              ).set(leases)
+    reg.gauge(FLEET_POOL_PENDING,
+              "pool leases waiting for an eligible host").set(pending)
 
 
 def rest_conn_pool(active: int, queued: int) -> None:
@@ -1135,6 +1195,27 @@ def knowledge_service_stats(tenants: int, pool_entries: int) -> None:
         KNOWLEDGE_POOL,
         "failure signatures in the global knowledge pool",
     ).set(pool_entries)
+
+
+def knowledge_fanin(inflight: int,
+                    lock_wait_s: Optional[float] = None) -> None:
+    """One request entering/leaving the knowledge service handler:
+    ``inflight`` concurrent requests right now, plus (entry only) how
+    long this one waited for the shared-state lock. A 3-host pool
+    pushing concurrently should show lock waits in the microseconds —
+    milliseconds here mean the fan-in is serializing again."""
+    if not metrics.enabled():
+        return
+    reg = metrics.get()
+    reg.gauge(
+        KNOWLEDGE_FANIN_INFLIGHT,
+        "requests currently inside the knowledge service handler",
+    ).set(max(0, inflight))
+    if lock_wait_s is not None:
+        reg.histogram(
+            KNOWLEDGE_FANIN_LOCK_WAIT,
+            "knowledge-service shared-state lock acquisition wait",
+        ).observe(max(0.0, lock_wait_s))
 
 
 def knowledge_outage() -> None:

@@ -27,7 +27,18 @@ log = get_logger("tenancy.client")
 
 
 class TenancyWireError(Exception):
-    pass
+    """A tenancy op failed on the wire. ``retry_after`` carries the
+    server-requested backoff (seconds) when the refusal named one —
+    the placement plane's 429 admission refusals do — and ``status``
+    the refusal's HTTP-style status code; both None otherwise, so the
+    bounded-retry path can honor a Retry-After without string
+    parsing."""
+
+    def __init__(self, message: str, retry_after=None,
+                 status=None) -> None:
+        super().__init__(message)
+        self.retry_after = retry_after
+        self.status = status
 
 
 class TenancyClient:
@@ -35,6 +46,7 @@ class TenancyClient:
         self.url = url
         self.timeout = timeout
         parsed = urlparse(url)
+        self._tcp_addr = None
         if parsed.scheme in ("http", "https"):
             self._uds_path = None
             self._base = url.rstrip("/")
@@ -43,20 +55,27 @@ class TenancyClient:
             # rejoin them so relative forms resolve to the SAME path
             # the transceivers use (url[len("uds://"):])
             self._uds_path = parsed.netloc + parsed.path
+        elif parsed.scheme == "tcp":
+            # tcp://host:port — the same framed-JSON grammar as uds,
+            # over TCP (endpoint/framed.py bind_tcp): how the placement
+            # service serves pool ops across hosts without HTTP
+            self._uds_path = None
+            self._tcp_addr = (parsed.hostname or "127.0.0.1",
+                              int(parsed.port or 0))
         elif not parsed.scheme:
             self._uds_path = url
         else:
             raise TenancyWireError(
-                f"unsupported tenancy url {url!r} (want http(s):// or "
-                "uds://)")
+                f"unsupported tenancy url {url!r} (want http(s)://, "
+                "uds:// or tcp://)")
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
     # -- transport --------------------------------------------------------
 
     def _op(self, doc: Dict[str, Any]) -> Dict[str, Any]:
-        if self._uds_path is not None:
-            return self._op_uds(doc)
+        if self._uds_path is not None or self._tcp_addr is not None:
+            return self._op_framed(doc)
         return self._op_http(doc)
 
     def _op_http(self, doc: Dict[str, Any]) -> Dict[str, Any]:
@@ -76,29 +95,44 @@ class TenancyClient:
                 detail = json.loads(e.read() or b"{}").get("error", "")
             except ValueError:
                 detail = ""
+            retry_after = None
+            try:
+                raw = e.headers.get("Retry-After") if e.headers else None
+                if raw is not None:
+                    retry_after = float(raw)
+            except (TypeError, ValueError):
+                pass
             raise TenancyWireError(
                 f"tenancy op {doc.get('op')!r} failed: HTTP {e.code} "
-                f"{detail}".strip()) from None
+                f"{detail}".strip(), retry_after=retry_after,
+                status=e.code) from None
         except (OSError, ValueError) as e:
             raise TenancyWireError(
                 f"tenancy op {doc.get('op')!r} failed: {e}") from e
         return body
 
-    def _op_uds(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+    def _connect(self) -> socket.socket:
+        if self._tcp_addr is not None:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            target: Any = self._tcp_addr
+        else:
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            target = self._uds_path
+        sock.settimeout(self.timeout)
+        try:
+            sock.connect(target)
+        except OSError as e:
+            sock.close()
+            raise TenancyWireError(
+                f"tenancy socket {target}: {e}") from e
+        return sock
+
+    def _op_framed(self, doc: Dict[str, Any]) -> Dict[str, Any]:
         with self._lock:
             for attempt in (0, 1):
                 sock = self._sock
                 if sock is None:
-                    sock = socket.socket(socket.AF_UNIX,
-                                         socket.SOCK_STREAM)
-                    sock.settimeout(self.timeout)
-                    try:
-                        sock.connect(self._uds_path)
-                    except OSError as e:
-                        raise TenancyWireError(
-                            f"tenancy socket {self._uds_path}: {e}") \
-                            from e
-                    self._sock = sock
+                    sock = self._sock = self._connect()
                 try:
                     write_frame(sock, doc)
                     resp = read_frame(sock)
@@ -121,9 +155,18 @@ class TenancyClient:
                         f"tenancy op {doc.get('op')!r}: non-object "
                         "reply")
                 if not resp.get("ok", True):
+                    retry_after = resp.get("retry_after")
+                    try:
+                        retry_after = (float(retry_after)
+                                       if retry_after is not None
+                                       else None)
+                    except (TypeError, ValueError):
+                        retry_after = None
                     raise TenancyWireError(
                         f"tenancy op {doc.get('op')!r} failed: "
-                        f"{resp.get('error')}")
+                        f"{resp.get('error')}",
+                        retry_after=retry_after,
+                        status=resp.get("status"))
                 return resp
         raise TenancyWireError("unreachable")  # pragma: no cover
 
@@ -169,5 +212,17 @@ class TenancyClient:
         return self._op({"op": "release", "lease_id": lease_id,
                          "trace": want_trace})
 
+    def reclaim(self, lease_id: str) -> Dict[str, Any]:
+        """Park-preserving detach: the namespace's parked events stay
+        journaled (exactly like a lease expiry) for an exactly-once
+        re-lease — the placement plane's graceful-drain primitive."""
+        return self._op({"op": "reclaim", "lease_id": lease_id})
+
     def runs(self) -> Dict[str, Any]:
         return self._op({"op": "runs"})
+
+    def op(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        """Send one raw op dict (the pool-level grammar the placement
+        service adds — ``pool_status``/``drain``/``hosts`` — rides the
+        same transport as the tenancy ops)."""
+        return self._op(dict(doc))

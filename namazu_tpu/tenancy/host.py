@@ -245,6 +245,16 @@ class TenantOrchestrator(Orchestrator):
             log.warning("run %s: a newer lease took the name during "
                         "detach; leaving its state untouched", ns.name)
             return
+        # withdraw the tenant's published delay table (doc/tenancy.md
+        # "Per-namespace tables"): an edge still polling this run's
+        # table must see an explicit versioned withdrawal, not a stale
+        # table that outlives the lease
+        pub = getattr(ns.policy, "table_publisher", None)
+        if pub is not None:
+            try:
+                pub.publish_none()
+            except Exception:  # pragma: no cover - defensive
+                log.exception("run %s: table withdrawal failed", ns.name)
         _recorder.recorder().end_pinned(ns.name)
         self.hub.forget_namespace(ns.name)
         # drop the tenant's per-entity action queues on every endpoint

@@ -139,6 +139,9 @@ def handle_tenancy_op(req: Dict[str, Any],
         return dict(registry.release(
             str(req.get("lease_id") or ""),
             want_trace=bool(req.get("trace", True))), ok=True)
+    if op == "reclaim":
+        return dict(registry.reclaim(str(req.get("lease_id") or "")),
+                    ok=True)
     if op == "runs":
         return {"ok": True, "runs": registry.payload()}
     return None
@@ -230,6 +233,31 @@ class RunRegistry:
         log.info("released run %s (%d event(s), %d action(s) traced)",
                  ns.name, ns.events_ingested, len(ns.trace))
         return doc
+
+    def reclaim(self, lease_id: str) -> Dict[str, Any]:
+        """Operator-requested reclaim (the placement plane's graceful
+        drain): detach the namespace WITHOUT dispatching its parked
+        events — they stay in the journal, exactly as a lease expiry
+        would leave them — so a re-lease of the same run name (on this
+        host or a replacement) recovers them exactly-once."""
+        with self._lock:
+            lease = self._leases.pop(lease_id, None)
+            if lease is None:
+                raise TenancyError(f"unknown lease {lease_id!r} "
+                                   "(expired and reclaimed?)")
+            self._by_ns.pop(lease.ns.name, None)
+        ns = lease.ns
+        parked = ns.parked_depth()
+        self._host.reclaim_namespace(ns)
+        _spans.tenancy_reclaim(ns.name)
+        _spans.tenancy_runs(self.active_count())
+        log.info("reclaimed run %s on request (%d parked event(s) left "
+                 "%s)", ns.name, parked,
+                 f"journaled in {lease.journal_dir}" if lease.journal_dir
+                 else "undispatched (no journal)")
+        return {"run": ns.name, "run_id": ns.run_id,
+                "events": ns.events_ingested, "parked": parked,
+                "journal_dir": lease.journal_dir}
 
     def payload(self) -> List[Dict[str, Any]]:
         """Active leases, for the ``runs`` status op and /fleet."""

@@ -409,3 +409,60 @@ def test_tools_fsck_pool_dir(tmp_path):
     assert cli_main(["tools", "fsck", str(pool), "--repair"]) == 1
     assert cli_main(["tools", "fsck", str(pool)]) == 0  # clean now
     assert pool_size(str(pool)) == 1  # the good entry survived
+
+
+# -- N-orchestrator fan-in -----------------------------------------------
+
+
+def test_concurrent_pushers_fan_in_without_serializing(
+        tmp_path, fresh_registry, monkeypatch):
+    """The pool-host fan-in contract (doc/tenancy.md "Fleet of
+    fleets"): N orchestrators pushing into ONE knowledge sidecar must
+    not serialize behind the service lock — pool_put's fsync'd file
+    writes happen outside it, so requests overlap. Proven by the
+    fan-in gauge observing >= 2 in-flight handlers, with full
+    correctness under the race: every distinct entry pooled once,
+    per-tenant counters exact, no exception escapes."""
+    seen_inflight = []
+    orig = obs.knowledge_fanin
+
+    def spy(inflight, lock_wait_s=None):
+        seen_inflight.append(inflight)
+        orig(inflight, lock_wait_s=lock_wait_s)
+
+    monkeypatch.setattr(obs, "knowledge_fanin", spy)
+    svc = KnowledgeService(str(tmp_path / "pool"))
+    pushers, per_pusher = 6, 8
+    barrier = threading.Barrier(pushers)
+    errors = []
+
+    def pusher(k):
+        entries = [_entry(k * per_pusher + i) for i in range(per_pusher)]
+        barrier.wait()
+        try:
+            for entry in entries:
+                r = svc.handle({"op": "pool_push",
+                                "tenant": f"orc{k}", "scenario": SCEN,
+                                "entries": [entry]})
+                assert r["ok"], r
+        except Exception as e:  # pragma: no cover - failure detail
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=pusher, args=(k,))
+               for k in range(pushers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors
+    stats = svc.handle({"op": "stats"})
+    assert stats["pool_size"] == pushers * per_pusher
+    assert stats["tenant_count"] == pushers
+    assert all(stats["tenants"][f"orc{k}"]["pushes"] == per_pusher
+               for k in range(pushers))
+    # the fan-in really overlapped: >= 2 handlers in flight at once
+    assert max(seen_inflight) >= 2
+    # and the gauges are on the wire for `tools top` / federation
+    families = {f.name for f in fresh_registry.families()}
+    assert spans.KNOWLEDGE_FANIN_INFLIGHT in families
+    assert spans.KNOWLEDGE_FANIN_LOCK_WAIT in families

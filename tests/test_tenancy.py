@@ -607,6 +607,80 @@ def test_fleet_payload_and_top_render_runs_dimension():
     assert runs2["exp-a"]["events_per_sec"] is not None
 
 
+# -- per-namespace delay tables ------------------------------------------
+
+
+def test_per_namespace_table_publication_and_withdrawal(tmp_path):
+    """doc/tenancy.md "Per-namespace tables": an X-Nmz-Run header on
+    ``GET /api/v3/policy/table`` (and the version piggybacks) scopes
+    the read to that tenant's OWN publisher — never the process
+    default's — and a release withdraws the tenant's table with an
+    explicit version bump."""
+    from namazu_tpu.policy.edge_table import (
+        TABLE_VERSION_HEADER,
+        TablePublisher,
+    )
+
+    host = _host(tmp_path)
+    try:
+        base = f"http://127.0.0.1:{host.hub.endpoint('rest').port}"
+        default_pub = TablePublisher()
+        default_pub.publish([0.0, 0.1], H=2, max_interval=0.1)
+        host.hub.table_publisher = default_pub
+        lease = host.registry.lease("exp-t", ttl_s=30,
+                                    policy_param=_policy_param())
+        ns = host.registry.namespace("exp-t")
+        ns_pub = TablePublisher()
+        ns.policy.table_publisher = ns_pub
+        ns_pub.publish([0.0, 0.25, 0.5], H=3, max_interval=0.5)
+        ns_pub.publish([0.0, 0.3, 0.6], H=3, max_interval=0.6)
+
+        def get_table(run=""):
+            req = urllib.request.Request(
+                f"{base}/api/v3/policy/table",
+                headers={tenancy.RUN_HEADER: run} if run else {})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                body = r.read()
+                return (r.status, r.headers.get(TABLE_VERSION_HEADER),
+                        json.loads(body) if body else None)
+
+        # unscoped: the process default's table, version 1
+        status, version, doc = get_table()
+        assert status == 200 and version == "1"
+        assert doc["delays"] == [0.0, 0.1]
+        # scoped: the tenant's OWN table at the tenant's OWN version
+        status, version, doc = get_table(run="exp-t")
+        assert status == 200 and version == "2"
+        assert doc["delays"] == [0.0, 0.3, 0.6]
+        # an unknown tenant gets a bare 204 — no version, no table
+        status, version, doc = get_table(run="exp-ghost")
+        assert status == 204 and version is None and doc is None
+
+        # the batch-POST piggyback is namespace-scoped the same way
+        ev = PacketEvent.create("n0", "n0", "peer", hint="b0")
+        req = urllib.request.Request(
+            f"{base}/api/v3/events/n0/batch",
+            data=json.dumps([ev.to_jsonable()]).encode(),
+            headers={"Content-Type": "application/json",
+                     tenancy.RUN_HEADER: "exp-t"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+            assert r.headers.get(TABLE_VERSION_HEADER) == "2"
+
+        # release withdraws the tenant's table: an edge still polling
+        # sees an explicit versioned withdrawal, not a stale table
+        host.registry.release(lease["lease_id"], want_trace=False)
+        version, doc = ns_pub.current()
+        assert version == 3 and doc is None
+        status, version, doc = get_table(run="exp-t")
+        assert status == 204 and version is None  # lease gone entirely
+        # the process default is untouched throughout
+        status, version, doc = get_table()
+        assert status == 200 and version == "1"
+    finally:
+        host.shutdown()
+
+
 # -- campaign serve mode ------------------------------------------------
 
 
