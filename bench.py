@@ -32,9 +32,13 @@ import argparse
 import datetime
 import gc
 import json
+import math
 import os
+import re
+import shutil
 import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -311,10 +315,16 @@ def gate_record(current: dict, history: list,
     # "profile" joined with the profiling plane: the sampling profiler
     # rides the pipeline bench by default (budgeted <=2%), and the
     # --no-profile A/B figure must never cross-gate a profiled one
+    # "virtual_clock" joined with the virtual-clock plane: a
+    # fast-forwarded campaign figure must never baseline a wall-rate
+    # one (nor the reverse) — the whole point of the A/B is that they
+    # differ by an order of magnitude; "delay_scale" rides along so a
+    # scale-50 record never baselines a scale-10 one
     CONFIG_KEYS = ("n_events", "n_entities", "batch_max",
                    "flush_window", "poll_linger", "gc_disabled",
                    "telemetry", "codec", "edge_shards", "edge_events",
-                   "runs", "fused", "profile")
+                   "runs", "fused", "profile", "virtual_clock",
+                   "delay_scale")
 
     def _mode(rec):
         return rec.get("transport_mode") or rec.get("mode")
@@ -1107,6 +1117,275 @@ def multi_run_main(args: argparse.Namespace, runs: int,
         store_baseline_profile(record, prof_payload, args.history)
 
 
+# -- virtual-clock campaign A/B (doc/performance.md "Virtual clock") ------
+
+#: the campaign A/B's metric and artifact (acceptance: ISSUE 20)
+VCLOCK_METRIC = "campaign_repros_per_hour"
+VCLOCK_TARGET_RATIO = 10.0
+VCLOCK_SMOKE_MIN_SPEEDUP = 3.0
+VCLOCK_OUT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "VCLOCK_r01.json")
+#: the zk-election scenario's stock knobs the delay scale multiplies —
+#: the calibrated decision window (examples/zk-election/calibration.json)
+#: and the random policy's fuzz-interval ceiling (config.toml)
+VCLOCK_BASE_WINDOW_MS = 424
+VCLOCK_BASE_MAX_INTERVAL_MS = 400
+
+
+def _wilson_ci95(k: int, n: int) -> list:
+    """Wilson score interval for a binomial proportion at z=1.96."""
+    if n <= 0:
+        return [0.0, 1.0]
+    z = 1.96
+    p = k / n
+    denom = 1.0 + z * z / n
+    center = (p + z * z / (2 * n)) / denom
+    half = z * math.sqrt(p * (1 - p) / n + z * z / (4 * n * n)) / denom
+    return [round(max(0.0, center - half), 4),
+            round(min(1.0, center + half), 4)]
+
+
+def _campaign_arm(virtual: bool, runs: int, workdir: str,
+                  config_path: str, materials: str,
+                  window_ms: int, wall_deadline_s: float) -> dict:
+    """One campaign arm: fresh storage, N supervised runs, per-run
+    repro classification from result.json. Both arms get the SAME
+    config and environment; only --virtual-clock differs."""
+    label = "virtual" if virtual else "wall"
+    storage = os.path.join(workdir, f"st_{label}")
+    env = dict(os.environ)
+    env["NMZ_CALIB_DECISION_WINDOW_MS"] = str(window_ms)
+    subprocess.run(
+        [sys.executable, "-m", "namazu_tpu.cli", "init",
+         config_path, materials, storage],
+        env=env, check=True, capture_output=True, text=True)
+    argv = [sys.executable, "-m", "namazu_tpu.cli", "campaign", storage,
+            "-n", str(runs), "--wall-deadline", str(wall_deadline_s)]
+    if virtual:
+        argv.append("--virtual-clock")
+    t0 = time.monotonic()
+    proc = subprocess.run(argv, env=env, capture_output=True, text=True)
+    wall_s = time.monotonic() - t0
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip()[-800:]
+        raise RuntimeError(
+            f"{label} campaign arm exited {proc.returncode}: {tail}")
+    per_run = []
+    for name in sorted(os.listdir(storage)):
+        result_path = os.path.join(storage, name, "result.json")
+        if not os.path.isfile(result_path):
+            continue
+        with open(result_path) as f:
+            result = json.load(f)
+        meta = result.get("metadata") or {}
+        entry = {"run": name,
+                 "repro": not bool(result.get("successful", True)),
+                 "required_time_s": round(
+                     float(result.get("required_time") or 0.0), 2)}
+        for key in ("virtual_time_s", "wall_time_s", "vclock_speedup"):
+            if key in meta:
+                entry[key] = meta[key]
+        per_run.append(entry)
+    with open(os.path.join(storage, "campaign.json")) as f:
+        checkpoint = json.load(f)
+    classes = [s.get("class") for s in checkpoint.get("slots", [])
+               if not s.get("in_progress")]
+    n = len(per_run)
+    k = sum(1 for r in per_run if r["repro"])
+    wall_h = wall_s / 3600.0
+    speedups = [r["vclock_speedup"] for r in per_run
+                if r.get("vclock_speedup")]
+    virtual_total = sum(r.get("virtual_time_s", 0.0) for r in per_run)
+    arm = {
+        "virtual_clock": virtual,
+        "runs": n,
+        "repros": k,
+        "repro_rate": round(k / n, 4) if n else None,
+        "repro_rate_wilson_ci95": _wilson_ci95(k, n),
+        "campaign_wall_s": round(wall_s, 2),
+        "runs_per_hour": round(n / wall_h, 1) if wall_h > 0 else None,
+        "repros_per_hour_raw": (round(k / wall_h, 2)
+                                if wall_h > 0 else None),
+        "slot_classes": classes,
+        "per_run": per_run,
+    }
+    if speedups:
+        # the virtual arm's internal accounting: virtual seconds each
+        # run covered vs the wall seconds it took (run_cmd metadata)
+        arm["virtual_time_s_total"] = round(virtual_total, 2)
+        arm["per_run_speedup_mean"] = round(
+            sum(speedups) / len(speedups), 2)
+    return arm
+
+
+def campaign_main(args) -> None:
+    """The --campaign mode: the same zk-election campaign twice —
+    wall-rate control, then --virtual-clock — at an identical delay
+    scale, recording repros/hour for both arms.
+
+    The comparison is the tentpole's claim made measurable: scheduled
+    fuzz delays and decision windows cost the wall arm real seconds
+    but the virtual arm only jump targets, so at an equal per-run
+    repro rate (overlapping Wilson CIs — same config, same policy,
+    only the clock differs) repros/hour scales with runs/hour. The
+    regression gate never compares a virtual record against a wall
+    one: both carry ``virtual_clock`` as a gate config key."""
+    smoke = bool(args.smoke)
+    runs = 3 if smoke else max(1, int(args.campaign_runs))
+    scale = 10.0 if smoke else max(1.0, float(args.campaign_scale))
+    window_ms = int(VCLOCK_BASE_WINDOW_MS * scale)
+    max_interval_ms = int(VCLOCK_BASE_MAX_INTERVAL_MS * scale)
+    # generous per-run wall deadline: the scaled election plus slack —
+    # a hung child must not wedge the bench, but a healthy wall-rate
+    # run must never be killed mid-window
+    wall_deadline_s = window_ms / 1000.0 * 4.0 + 120.0
+    here = os.path.dirname(os.path.abspath(__file__))
+    example = os.path.join(here, "examples", "zk-election")
+    materials = os.path.join(example, "materials")
+    with open(os.path.join(example, "config.toml")) as f:
+        config_text = f.read()
+    config_text = re.sub(r"(?m)^max_interval = \d+",
+                         f"max_interval = {max_interval_ms}",
+                         config_text)
+    workdir = args.campaign_workdir or tempfile.mkdtemp(
+        prefix="nmz-vclock-bench-")
+    cleanup = not args.campaign_workdir
+    os.makedirs(workdir, exist_ok=True)
+    out_path = args.campaign_out or VCLOCK_OUT_PATH
+    try:
+        config_path = os.path.join(workdir, "config.toml")
+        with open(config_path, "w") as f:
+            f.write(config_text)
+        arms = {}
+        for virtual in (False, True):
+            label = "virtual" if virtual else "wall"
+            print(f"# campaign arm: {label} ({runs} run(s), delay "
+                  f"scale {scale:g}, window {window_ms}ms)",
+                  file=sys.stderr)
+            arms[label] = _campaign_arm(
+                virtual, runs, workdir, config_path, materials,
+                window_ms, wall_deadline_s)
+    finally:
+        if cleanup:
+            shutil.rmtree(workdir, ignore_errors=True)
+    wall, virt = arms["wall"], arms["virtual"]
+    # equal per-run repro rate is the precondition (overlapping Wilson
+    # CIs); GIVEN it, the repros/hour ratio is the runs/hour ratio at
+    # the pooled rate — robust when one small arm happens to draw 0
+    # repros, where the raw ratio would be 0/0
+    lo_w, hi_w = wall["repro_rate_wilson_ci95"]
+    lo_v, hi_v = virt["repro_rate_wilson_ci95"]
+    ci_overlap = lo_w <= hi_v and lo_v <= hi_w
+    pooled_n = wall["runs"] + virt["runs"]
+    pooled_rate = ((wall["repros"] + virt["repros"]) / pooled_n
+                   if pooled_n else 0.0)
+    at_pooled = {
+        label: (round(pooled_rate * arm["runs_per_hour"], 2)
+                if arm["runs_per_hour"] else None)
+        for label, arm in arms.items()}
+    ratio = None
+    if at_pooled["wall"] and at_pooled["virtual"]:
+        ratio = round(at_pooled["virtual"] / at_pooled["wall"], 2)
+    elif wall["runs_per_hour"] and virt["runs_per_hour"]:
+        ratio = round(virt["runs_per_hour"] / wall["runs_per_hour"], 2)
+    out = {
+        "metric": VCLOCK_METRIC,
+        "unit": "repros/hour",
+        # host-loopback control plane, like the pipeline figures
+        "platform": "loopback",
+        "example": "zk-election",
+        "delay_scale": scale,
+        "decision_window_ms": window_ms,
+        "max_interval_ms": max_interval_ms,
+        "runs_per_arm": runs,
+        "wall": wall,
+        "virtual": virt,
+        "pooled_repro_rate": round(pooled_rate, 4),
+        "repro_rate_ci_overlap": ci_overlap,
+        "repros_per_hour_at_pooled_rate": at_pooled,
+        "throughput_ratio": ratio,
+        "rule": (f">={VCLOCK_TARGET_RATIO:g}x repros/hour vs the "
+                 "wall-rate arm at overlapping per-run Wilson 95% CIs "
+                 "(identical config both arms; records tagged "
+                 "virtual_clock so the gate never compares them)"),
+    }
+    if smoke:
+        # the CI job's contract (tier1.yml "Virtual-clock smoke"): the
+        # virtual arm must cover >=3x its wall time in virtual seconds
+        # and its slots must classify exactly like the wall control —
+        # fast-forward must never turn an experiment into a timeout
+        speedup = virt.get("per_run_speedup_mean") or 0.0
+        classes_match = (virt["slot_classes"] == wall["slot_classes"])
+        out["smoke_gate"] = {
+            "per_run_speedup_mean": speedup,
+            "min_speedup": VCLOCK_SMOKE_MIN_SPEEDUP,
+            "slot_classes_match": classes_match,
+            "ok": (speedup >= VCLOCK_SMOKE_MIN_SPEEDUP
+                   and classes_match),
+        }
+        print(json.dumps(out))
+        if not out["smoke_gate"]["ok"]:
+            print(f"# VCLOCK SMOKE FAILED: speedup {speedup} "
+                  f"(need >={VCLOCK_SMOKE_MIN_SPEEDUP}), classes "
+                  f"match={classes_match}", file=sys.stderr)
+            raise SystemExit(1)
+        return
+    out["ratio_ok"] = bool(ratio and ratio >= VCLOCK_TARGET_RATIO)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, out_path)
+    prior = load_history(args.history)
+    stamp = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    for label, arm in arms.items():
+        record = {
+            "timestamp": stamp,
+            "revision": _code_revision(),
+            "metric": VCLOCK_METRIC,
+            "value": at_pooled[label],
+            "unit": "repros/hour",
+            "platform": "loopback",
+            "virtual_clock": arm["virtual_clock"],
+            "delay_scale": scale,
+            "runs": runs,
+            "repros": arm["repros"],
+            "campaign_wall_s": arm["campaign_wall_s"],
+            "throughput_ratio": ratio,
+        }
+        try:
+            append_history(record, args.history)
+        except OSError as e:
+            print(f"# could not append bench history: {e}",
+                  file=sys.stderr)
+    if args.gate:
+        # same-arm history regression gating plus the absolute
+        # acceptance rule; virtual and wall records never compare
+        # (virtual_clock and delay_scale are gate config keys)
+        virt_record = {"metric": VCLOCK_METRIC, "platform": "loopback",
+                       "virtual_clock": True, "delay_scale": scale,
+                       "runs": runs, "value": at_pooled["virtual"]}
+        ok, reasons, baseline = gate_record(
+            virt_record, prior, threshold_pct=args.gate_threshold)
+        accept = bool(out["ratio_ok"] and ci_overlap)
+        out["gate"] = {"ok": ok and accept,
+                       "threshold_pct": args.gate_threshold,
+                       "baseline": baseline, "reasons": reasons}
+        print(json.dumps(out))
+        if not accept:
+            print(f"# GATE FAILED: throughput ratio {ratio} (need "
+                  f">={VCLOCK_TARGET_RATIO:g}) with CI overlap="
+                  f"{ci_overlap}", file=sys.stderr)
+            raise SystemExit(1)
+        if not ok:
+            for reason in reasons:
+                print(f"# GATE FAILED: {reason}", file=sys.stderr)
+            raise SystemExit(1)
+        return
+    print(json.dumps(out))
+
+
 def parse_args(argv=None) -> argparse.Namespace:
     ap = argparse.ArgumentParser(
         description="namazu_tpu scorer benchmark (one JSON line)")
@@ -1220,6 +1499,34 @@ def parse_args(argv=None) -> argparse.Namespace:
                     metavar="S", help="server-side action-poll linger "
                     "in seconds: after the first action, keep filling "
                     "the batch this long (default 0.05)")
+    ap.add_argument("--campaign", action="store_true",
+                    help="virtual-clock campaign A/B (doc/performance"
+                         ".md \"Virtual clock\"): run the zk-election "
+                         "campaign twice with IDENTICAL config — once "
+                         "wall-rate, once --virtual-clock — and record "
+                         "repros/hour for both arms in VCLOCK_r01.json."
+                         " With --smoke: 3 runs/arm at a small delay "
+                         "scale, gated on the virtual arm covering "
+                         ">=3x its wall time and slot classes matching "
+                         "the wall control (the CI job)")
+    ap.add_argument("--campaign-runs", type=int, default=10, metavar="N",
+                    help="supervised runs per campaign arm "
+                         "(default 10)")
+    ap.add_argument("--campaign-scale", type=float, default=100.0,
+                    metavar="X",
+                    help="delay scale applied identically to BOTH "
+                         "arms: the scenario's fuzz intervals and "
+                         "decision window are multiplied by X "
+                         "(default 100). The virtual arm fast-forwards "
+                         "the added idle time; the wall arm sleeps "
+                         "through it — the decoupling the bench "
+                         "measures")
+    ap.add_argument("--campaign-out", default="", metavar="PATH",
+                    help="where to write the campaign A/B record "
+                         "(default VCLOCK_r01.json next to bench.py)")
+    ap.add_argument("--campaign-workdir", default="", metavar="DIR",
+                    help="scratch dir for the two arms' storages "
+                         "(default: a fresh temp dir, removed after)")
     return ap.parse_args(argv)
 
 
@@ -1396,6 +1703,10 @@ def fused_main(args) -> None:
 
 def main(argv=None) -> None:
     args = parse_args(argv)
+    if args.campaign:
+        # pure control plane, like --pipeline: no jax import, no
+        # device probe — the campaign A/B runs the same everywhere
+        return campaign_main(args)
     if args.pipeline:
         # pure control plane: no jax import, no device probe, no
         # CPU re-exec — the event plane runs the same everywhere

@@ -53,6 +53,7 @@ from namazu_tpu.utils.cmd import (
     kill_process_group,
     sweep_stale_pgid_files,
 )
+from namazu_tpu.utils import timesource
 from namazu_tpu.utils.log import get_logger
 from namazu_tpu.utils.retry import backoff_delays
 
@@ -95,6 +96,12 @@ class CampaignSpec:
     python: str = sys.executable
     seed: Optional[int] = None    # jitter RNG seed (tests)
     extra_run_args: List[str] = field(default_factory=list)
+    # forward --virtual-clock to every run child (doc/performance.md
+    # "Virtual clock"): each child fast-forwards its scheduled delays,
+    # so campaign throughput decouples from the scenario's idle time.
+    # The supervisor's own deadlines stay wall — they bound CHILD
+    # processes whose hangs are real
+    virtual_clock: bool = False
     # fleet telemetry collector (doc/observability.md "Fleet
     # telemetry"): "auto" = <storage>/telemetry.sock (with a /tmp
     # fallback past the AF_UNIX path limit), "" = off, else an explicit
@@ -239,6 +246,8 @@ class Campaign:
                             ("--clean-deadline", spec.clean_deadline_s)):
             if value and value > 0:
                 argv += [flag, str(value)]
+        if spec.virtual_clock:
+            argv.append("--virtual-clock")
         argv += spec.extra_run_args
         return argv
 
@@ -419,7 +428,11 @@ class Campaign:
         run_name = (f"{os.path.basename(os.path.abspath(spec.storage_dir))}"
                     f"-s{slot_index}-{_uuid.uuid4().hex[:6]}")
         client = TenancyClient(spec.serve_url)
-        t0 = time.monotonic()
+        # serve slots run in-process: their durations and drive
+        # deadlines read the process TimeSource, so a virtual-clock
+        # supervisor fast-forwarding its own waits cannot time out a
+        # healthy (parked) workload (doc/performance.md "Virtual clock")
+        t0 = timesource.get().now()
         lease = self._serve_lease(client, run_name)
         lease_id = lease["lease_id"]
         # a placement service's lease says WHERE the workload runs
@@ -469,7 +482,7 @@ class Campaign:
                 SingleTrace.from_jsonable(released.get("trace") or []))
             # serve slots run the wire workload, not a validate script:
             # the outcome is "completed" (successful = no repro claim)
-            storage.record_result(True, time.monotonic() - t0)
+            storage.record_result(True, timesource.get().now() - t0)
         finally:
             storage.close()
         log.info("serve slot %d: run %s released (%s event(s), %s "
@@ -582,11 +595,11 @@ class Campaign:
             raises into the slot's infra-retry path."""
             if moved is None:
                 raise exc
-            deadline = time.monotonic() + max(2.0 * spec.serve_ttl_s,
-                                              10.0)
+            deadline = timesource.get().now() + max(
+                2.0 * spec.serve_ttl_s, 10.0)
             while not moved.wait(0.25):
                 if self._abort.is_set() \
-                        or time.monotonic() >= deadline:
+                        or timesource.get().now() >= deadline:
                     raise exc
             moved.clear()
             retarget()
@@ -612,7 +625,7 @@ class Campaign:
                     ride_out_migration(exc)
                     chans.append(txs[e].send_event(ev))
             if not crashed:
-                deadline = time.monotonic() + 60.0
+                deadline = timesource.get().now() + 60.0
                 while chans:
                     if moved is not None and moved.is_set():
                         moved.clear()
@@ -622,7 +635,7 @@ class Campaign:
                         chans[0].get(timeout=0.5)
                         chans.pop(0)
                     except queue.Empty:
-                        if time.monotonic() >= deadline:
+                        if timesource.get().now() >= deadline:
                             raise RuntimeError(
                                 f"run {run_name}: workload actions "
                                 "still outstanding after 60s")
@@ -771,6 +784,8 @@ class Campaign:
             in_band=(1 if progress["band_verdict"] == "in_band"
                      else 0 if progress["band_verdict"] in
                      ("below", "above") else None),
+            repros_per_hour_virtual=progress.get(
+                "repros_per_hour_virtual"),
         )
         self.state["progress"] = progress
         return progress

@@ -334,12 +334,13 @@ def _exactly_once(pipe: _Pipeline, trace, plan: FaultPlan
 
 def _run_pipeline_once(workdir: str, run_id: str, seed: int,
                        events: int, plan: Optional[FaultPlan],
-                       base_policy_param: Optional[dict] = None
-                       ) -> Dict[str, Any]:
+                       base_policy_param: Optional[dict] = None,
+                       delay_ms: float = 20.0) -> Dict[str, Any]:
     if plan is not None:
         chaos.install(plan)
     try:
         pipe = _Pipeline(workdir, run_id, seed, events=events,
+                         delay_ms=delay_ms,
                          base_policy_param=base_policy_param)
         pipe.start_orchestrator()
         pipe.start_transceivers()
@@ -381,6 +382,71 @@ def _scenario_pipeline(name: str, spec: dict, seed: int, workdir: str,
     invariants["replay_equivalence"] = _inv(
         diff == "" and len(orders[0]) == events * 2,
         order_len=len(orders[0]), diff=diff[:2000])
+    return {"invariants": invariants, "fault_report": plan.report()}
+
+
+def _scenario_vclock(name: str, spec: dict, seed: int, workdir: str,
+                     events: int,
+                     base_policy_param: Optional[dict] = None
+                     ) -> Dict[str, Any]:
+    """The virtual clock's semantic-equivalence contract under a
+    perturbed handshake (doc/performance.md "Virtual clock"): the same
+    seeded loopback workload runs once at wall rate and once
+    fast-forwarded — with ``clock.skew`` overshooting jump targets and
+    ``clock.stall`` vetoing jumps mid-run — and the two dispatch
+    orders must be trace-differ equivalent, with exactly-once dispatch
+    across every fast-forward. The jump counter proves the virtual arm
+    actually fast-forwarded (an arm that never jumped would pass
+    equivalence vacuously)."""
+    from namazu_tpu.utils import timesource
+
+    plan = FaultPlan(seed, spec["faults"])
+    # both arms use WIDE delay windows (vs the pipeline default): the
+    # virtual arm must get unambiguous fast-forward opportunities even
+    # when clock.stall vetoes several jump attempts, or the
+    # fast_forward_happened invariant flakes on 0 jumps
+    delay_ms = 80.0
+    # arm A: the wall-rate reference order (chaos off — the clock
+    # faults only exist on the virtual side's jump path anyway)
+    wall = _run_pipeline_once(os.path.join(workdir, "wall"),
+                              f"{name}-wall", seed, events, None,
+                              base_policy_param, delay_ms=delay_ms)
+    wall_orders = wall["pipe"].order_lines()
+
+    # arm B: the same seed under a process-global VirtualTimeSource —
+    # the exact install path `run --virtual-clock` takes — with the
+    # scenario's clock faults armed on the jump handshake
+    source = timesource.VirtualTimeSource()
+    previous = timesource.install(source)
+    source.start_coordinator()
+    try:
+        virt = _run_pipeline_once(os.path.join(workdir, "virtual"),
+                                  f"{name}-virtual", seed, events,
+                                  plan, base_policy_param,
+                                  delay_ms=delay_ms)
+    finally:
+        source.stop_coordinator()
+        timesource.install(previous)
+    pipe, trace = virt["pipe"], virt["trace"]
+    virt_orders = pipe.order_lines()
+    diff = export.diff_order(wall_orders, virt_orders, "wall",
+                             "virtual")
+    summary = source.summary()
+    invariants = {
+        "exactly_once": _exactly_once(pipe, trace, plan),
+        "no_parked_forever": _inv(virt["parked"] == 0,
+                                  parked=virt["parked"]),
+        # the tentpole contract: at delay-scale 1 a fast-forwarded run
+        # is indistinguishable from the real-time run it replaces
+        "trace_equivalence": _inv(
+            diff == "" and len(wall_orders) == events * 2,
+            order_len=len(wall_orders), diff=diff[:2000]),
+        "fast_forward_happened": _inv(
+            summary["jumps"] >= 1, jumps=summary["jumps"],
+            jumped_s=summary["jumped_s"],
+            speedup=summary["speedup_ratio"]),
+        "fsck_clean": _fsck_invariant(pipe.storage),
+    }
     return {"invariants": invariants, "fault_report": plan.report()}
 
 
@@ -1310,6 +1376,7 @@ _KINDS = {
     "telemetry": _scenario_telemetry,
     "tenancy": _scenario_tenancy,
     "pool": _scenario_pool,
+    "vclock": _scenario_vclock,
 }
 
 

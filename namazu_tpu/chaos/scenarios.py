@@ -48,6 +48,14 @@ it. Kinds:
   re-grant over the same namespace journal recovers each parked event,
   release traces join the posted uuids exactly-once per run, nothing
   stays parked, and the pool state dir fscks clean after repair.
+* ``vclock`` — virtual-clock equivalence under a perturbed epoch-page
+  handshake (doc/performance.md "Virtual clock"): the same seeded
+  loopback run executes once at wall rate and once fast-forwarded
+  while ``clock.skew`` perturbs jump targets and ``clock.stall``
+  vetoes jumps mid-run; invariant: the two runs are trace-differ
+  equivalent (same events, same dispatch order), dispatch stays
+  exactly-once across every fast-forward, and the virtual run never
+  releases a delayed event before its virtual deadline.
 * ``telemetry`` — fleet-telemetry relay outage
   (doc/observability.md "Fleet telemetry"): ``telemetry.push.drop``
   kills the producer's pushes; invariant: never an exception into
@@ -180,6 +188,18 @@ SCENARIOS: Dict[str, dict] = {
                 "fsck-clean",
         "faults": {"fleet.host.die": {"prob": 1.0, "max_fires": 1}},
     },
+    "vclock_equiv": {
+        "kind": "vclock",
+        "desc": "a fast-forwarded run races a wall-rate twin of the "
+                "same seed while clock.skew perturbs jump targets and "
+                "clock.stall vetoes jumps; the runs must be "
+                "trace-differ equivalent, dispatch exactly-once "
+                "across every mid-run fast-forward, and no delayed "
+                "event may release before its virtual deadline",
+        "faults": {"clock.skew": {"prob": 0.5, "max_fires": 4,
+                                  "skew_s": 0.003},
+                   "clock.stall": {"prob": 0.3, "max_fires": 3}},
+    },
     "relay_outage": {
         "kind": "telemetry",
         "desc": "the fleet-telemetry collector goes dark; the relay "
@@ -198,7 +218,7 @@ DEFAULT_MATRIX: List[str] = [
     "wire_drop", "wire_dup", "wire_lost_reply", "wire_sever",
     "ingress_429", "storage_torn", "knowledge_outage", "crash_restart",
     "edge_stale", "edge_sharded", "wire_garble", "relay_outage",
-    "tenant_crash", "pool_host_die",
+    "tenant_crash", "pool_host_die", "vclock_equiv",
 ]
 
 

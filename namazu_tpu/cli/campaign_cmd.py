@@ -56,6 +56,13 @@ def register(sub) -> None:
     p.add_argument("--knowledge", default="", metavar="HOST:PORT",
                    help="global failure-knowledge service address, "
                         "forwarded to every run child (doc/knowledge.md)")
+    p.add_argument("--virtual-clock", action="store_true",
+                   help="forward --virtual-clock to every run child "
+                        "(doc/performance.md \"Virtual clock\"): each "
+                        "run fast-forwards its scheduled delays, "
+                        "decoupling campaign throughput from the "
+                        "scenario's idle time; repro classification is "
+                        "unchanged at delay-scale 1")
     p.add_argument("--telemetry-collector", default="auto",
                    metavar="PATH",
                    help="fleet telemetry collector socket "
@@ -107,6 +114,7 @@ def run(args) -> int:
         max_consecutive_infra=args.max_consecutive_infra,
         extra_run_args=(["--knowledge", args.knowledge]
                         if args.knowledge else []),
+        virtual_clock=args.virtual_clock,
         telemetry_collector=args.telemetry_collector,
         serve_url=args.serve,
         serve_ttl_s=args.serve_ttl,

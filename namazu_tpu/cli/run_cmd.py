@@ -54,6 +54,17 @@ def register(sub) -> None:
              "stream back; an outage degrades to local-only search. "
              "Overrides the config's explore_policy_param.knowledge")
     p.add_argument(
+        "--virtual-clock", action="store_true",
+        help="run under a discrete-event virtual clock "
+             "(doc/performance.md \"Virtual clock\"): scheduled delays "
+             "fast-forward instead of real-sleeping whenever every "
+             "waiter is parked, and experiment children get the "
+             "LD_PRELOAD clock interposer so their sleeps/poll "
+             "timeouts park too. Repro results are unchanged at "
+             "delay-scale 1; wall time shrinks by the scenario's idle "
+             "fraction. Also enabled by virtual_clock = true in the "
+             "config")
+    p.add_argument(
         "--telemetry-url", default="", metavar="URL",
         help="push this process's metrics to a fleet aggregator "
              "(doc/observability.md \"Fleet telemetry\"): an "
@@ -133,6 +144,19 @@ def run(args) -> int:
     # testee processes cannot orphan into the next slot
     factory.pgid_file = os.path.join(working_dir, "phase.pgid")
 
+    # virtual clock (doc/performance.md "Virtual clock"): installed
+    # BEFORE the policy/orchestrator exist so every ScheduledQueue,
+    # liveness stamp, and lease TTL constructed below reads the virtual
+    # source; children inherit the epoch page + interposer via the env
+    vclock_handle = None
+    vclock_summary = None
+    if getattr(args, "virtual_clock", False) or bool(
+            cfg.get("virtual_clock")):
+        from namazu_tpu import vclock
+
+        vclock_handle = vclock.activate(working_dir, cfg)
+        factory.extra_env.update(vclock_handle.child_env())
+
     from namazu_tpu.policy.plugins import load_policy_plugins
 
     load_policy_plugins(cfg, materials_dir)
@@ -204,6 +228,11 @@ def run(args) -> int:
                 return EXIT_INFRA
         finally:
             trace = orchestrator.shutdown()
+            # stop fast-forwarding before validate/clean: the oracle
+            # runs at wall rate, and the restored default TimeSource
+            # must not leak a jumped clock into the next in-process run
+            if vclock_handle is not None:
+                vclock_summary = vclock_handle.finish()
 
         validate_script = cfg.get("validate")
         if validate_script:
@@ -226,15 +255,34 @@ def run(args) -> int:
         # be able to tell (and skip) histories whose recorded event_hint
         # strings hash into a different bucket space (policy/tpu.py
         # _ingest_history)
+        metadata = {"hint_space": HINT_SPACE}
+        if vclock_summary is not None:
+            # required_time (and every rate derived from it) stays
+            # wall-denominated — SPRT budgets and calibration artifacts
+            # must keep comparing like with like; the virtual elapsed
+            # rides as separate metadata for the virtual-rate surfaces
+            metadata["virtual_time_s"] = vclock_summary[
+                "virtual_elapsed_s"]
+            metadata["wall_time_s"] = vclock_summary["wall_elapsed_s"]
+            metadata["vclock_speedup"] = vclock_summary["speedup_ratio"]
+            metadata["vclock_pinned_s"] = vclock_summary["pinned_s"]
         storage.record_result(successful, required_time,
-                              metadata={"hint_space": HINT_SPACE})
+                              metadata=metadata)
         recorded = True
 
+        extra = ""
+        if vclock_summary is not None:
+            extra = (f" virtual={vclock_summary['virtual_elapsed_s']:.2f}s"
+                     f" speedup={vclock_summary['speedup_ratio']}x")
         print(f"run finished: successful={successful} "
-              f"time={required_time:.2f}s trace={len(trace)} actions "
-              f"workdir={working_dir}")
+              f"time={required_time:.2f}s{extra} trace={len(trace)} "
+              f"actions workdir={working_dir}")
         return EXIT_OK
     finally:
+        # abort paths (deadline kill, infra failure, Ctrl-C) must also
+        # restore the wall TimeSource; finish() is idempotent
+        if vclock_handle is not None:
+            vclock_handle.finish()
         if not recorded:
             # deliberate abort (infra failure / deadline / interrupt):
             # mark the allocated run dir so fsck can tell it from a

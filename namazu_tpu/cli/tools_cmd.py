@@ -537,6 +537,11 @@ def render_top(payload: dict) -> str:
         # next-repro ETA forecast
         ("repro_rate", "RATE", ""),
         ("eta_next_repro_s", "ETA", "s"),
+        # virtual-clock plane (doc/performance.md "Virtual clock"):
+        # pace over VIRTUAL elapsed, beside — never instead of — the
+        # wall-denominated RATE/ETA the SPRT budgets read
+        ("repros_per_hour_virtual", "VRP/H", ""),
+        ("vclock_speedup", "VCLK", "x"),
         # dominant self-time frame from the instance's continuous
         # sampling profile (obs/profiling.py; doc/observability.md
         # "Profiling")

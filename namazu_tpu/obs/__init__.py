@@ -143,6 +143,8 @@ from namazu_tpu.obs.spans import (  # noqa: F401
     triage_dossier_pull,
     triage_minimized,
     triage_probe,
+    vclock_pinned,
+    vclock_speedup,
     triage_signatures,
     wire_bytes,
 )

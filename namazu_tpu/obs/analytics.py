@@ -329,14 +329,30 @@ def reproduction_stats(storage) -> Dict[str, Any]:
     outcomes: List[Tuple[bool, float]] = []
     quarantined = _quarantined_count(storage)
     is_quarantined = getattr(storage, "is_quarantined", None)
+    # virtual-clock runs (doc/performance.md "Virtual clock") record
+    # their VIRTUAL elapsed as metadata beside the wall required_time;
+    # a wall run's virtual time IS its wall time, so the virtual total
+    # stays well-defined over mixed storages
+    total_virtual = 0.0
+    vclock_runs = 0
     for i in range(n):
         if is_quarantined is not None and is_quarantined(i):
             continue
         try:
-            outcomes.append((storage.is_successful(i),
-                             storage.get_required_time(i)))
+            t = storage.get_required_time(i)
+            outcomes.append((storage.is_successful(i), t))
         except Exception:
             continue
+        try:
+            meta = storage.get_metadata(i)
+        except Exception:
+            meta = {}
+        virtual = meta.get("virtual_time_s")
+        if virtual is not None:
+            total_virtual += float(virtual)
+            vclock_runs += 1
+        else:
+            total_virtual += t
     runs = len(outcomes)
     failures = sum(1 for ok, _ in outcomes if not ok)
     total_time = sum(t for _, t in outcomes)
@@ -350,7 +366,7 @@ def reproduction_stats(storage) -> Dict[str, Any]:
             ttff, first_failure = round(acc, 3), i
             break
     rate = failures / runs if runs else 0.0
-    stats: Dict[str, Any] = {
+    out: Dict[str, Any] = {
         "runs": runs,
         "runs_quarantined": quarantined,
         "failures": failures,
@@ -366,10 +382,21 @@ def reproduction_stats(storage) -> Dict[str, Any]:
         "time_to_first_failure_s": ttff,
         "first_failure_run": first_failure,
         "total_time_s": round(total_time, 3),
-        "repros_per_hour": (round(failures / (total_time / 3600.0), 1)
-                            if total_time > 0 else 0.0),
+        "repros_per_hour": stats.repros_per_hour(failures,
+                                                 total_time) or 0.0,
+        # virtual-denominated twins, present only when at least one
+        # run actually fast-forwarded: the wall fields above keep their
+        # meaning (SPRT budgets and calibration artifacts are
+        # wall-denominated), the virtual ones say how much scenario
+        # time the campaign covered
+        "vclock_runs": vclock_runs,
+        "total_virtual_time_s": (round(total_virtual, 3)
+                                 if vclock_runs else None),
+        "repros_per_hour_virtual": (
+            stats.repros_per_hour(failures, total_virtual)
+            if vclock_runs else None),
     }
-    return stats
+    return out
 
 
 def _run_outcomes(storage) -> List[bool]:
@@ -429,6 +456,13 @@ def progress_stats(storage, coverage: Optional[Dict[str, Any]] = None,
         "rate_ci95": repro.get("failure_rate_ci95") if runs else None,
         "repros_per_hour": rph,
         "total_time_s": repro.get("total_time_s", 0.0),
+        # virtual-clock twins (None on pure wall campaigns): reported
+        # as SEPARATE fields so every wall-denominated consumer (SPRT
+        # budgets, calibration A/Bs) keeps reading the fields above
+        "repros_per_hour_virtual": repro.get("repros_per_hour_virtual"),
+        "total_virtual_time_s": repro.get("total_virtual_time_s"),
+        "eta_next_repro_virtual_s": stats.eta_next_repro_s(
+            repro.get("repros_per_hour_virtual")),
         # forecasters (obs/stats.py): None = nothing to extrapolate yet
         "eta_next_repro_s": stats.eta_next_repro_s(rph),
         "eta_10_repros_s": stats.eta_to_n_repros_s(rph, failures, 10),

@@ -1029,6 +1029,13 @@ class FleetAggregator:
                         st, spans.CAMPAIGN_RATE),
                     "repros_per_hour": self._gauge_max(
                         st, spans.CAMPAIGN_REPROS_PER_HOUR),
+                    # the virtual-clock twin (None on wall campaigns):
+                    # same pace formula over VIRTUAL elapsed — shown
+                    # beside the wall rate, never in place of it
+                    "repros_per_hour_virtual": self._gauge_max(
+                        st, spans.CAMPAIGN_REPROS_PER_HOUR_VIRTUAL),
+                    "vclock_speedup": self._gauge_max(
+                        st, spans.VCLOCK_SPEEDUP),
                     "eta_next_repro_s": self._gauge_max(
                         st, spans.CAMPAIGN_ETA_NEXT),
                     "campaign_in_band": self._gauge_max(

@@ -36,6 +36,7 @@ import time
 from typing import Optional
 
 from namazu_tpu.obs import metrics
+from namazu_tpu.utils import timesource
 
 SPANS_ATTR = "_obs_spans"
 
@@ -231,6 +232,15 @@ CAMPAIGN_REPROS_PER_HOUR = "nmz_campaign_repros_per_hour"
 CAMPAIGN_ETA_NEXT = "nmz_campaign_eta_next_repro_seconds"
 CAMPAIGN_RUNS_TO_CI = "nmz_campaign_runs_to_ci_width"
 CAMPAIGN_IN_BAND = "nmz_campaign_in_band"
+CAMPAIGN_REPROS_PER_HOUR_VIRTUAL = "nmz_campaign_repros_per_hour_virtual"
+
+# virtual-clock plane (doc/performance.md "Virtual clock"): how much
+# wall time the discrete-event fast-forward saved (virtual elapsed /
+# wall elapsed) and how long the pinning rule held the clock at wall
+# rate (real I/O, running entities, busy queues). Wall-denominated
+# surfaces (SPRT budgets, calibration artifacts) NEVER read these
+VCLOCK_SPEEDUP = "nmz_vclock_speedup_ratio"
+VCLOCK_PINNED = "nmz_vclock_pinned_seconds_total"
 
 
 #: distinct ``entity`` label values admitted per registry before new
@@ -270,14 +280,21 @@ def _entity_label(reg, entity: str) -> str:
 # -- span stamping ------------------------------------------------------
 
 def mark(sig, name: str, now: Optional[float] = None) -> None:
-    """Stamp ``sig`` with the monotonic time of lifecycle point ``name``."""
+    """Stamp ``sig`` with the monotonic time of lifecycle point ``name``.
+
+    Stamps read the process TimeSource — ``time.monotonic()`` under the
+    default wall source, the jumpable virtual clock under
+    ``run --virtual-clock`` — so every span delta (and the queue-dwell a
+    shutdown drain attributes to still-resident events) is denominated
+    in the same domain the delays themselves were scheduled in
+    (doc/performance.md "Virtual clock")."""
     if not metrics.enabled():
         return
     spans = getattr(sig, SPANS_ATTR, None)
     if spans is None:
         spans = {}
         setattr(sig, SPANS_ATTR, spans)
-    spans[name] = time.monotonic() if now is None else now
+    spans[name] = timesource.get().now() if now is None else now
 
 
 def span(sig, name: str) -> Optional[float]:
@@ -290,7 +307,7 @@ def latency(sig, since: str, now: Optional[float] = None) -> Optional[float]:
     t0 = span(sig, since)
     if t0 is None:
         return None
-    return (time.monotonic() if now is None else now) - t0
+    return (timesource.get().now() if now is None else now) - t0
 
 
 def span_delta(sig, since: str, until: str) -> Optional[float]:
@@ -973,7 +990,9 @@ def campaign_progress(rate: Optional[float],
                       repros_per_hour: Optional[float] = None,
                       eta_next_repro_s: Optional[float] = None,
                       runs_to_ci: Optional[float] = None,
-                      in_band: Optional[int] = None) -> None:
+                      in_band: Optional[int] = None,
+                      repros_per_hour_virtual: Optional[float] = None,
+                      ) -> None:
     """Publish one campaign-progress document's live face (obs/stats.py
     via obs/analytics.progress_stats) as ``nmz_campaign_*`` gauges. A
     None value leaves its gauge untouched rather than faking a 0 — a
@@ -1007,6 +1026,34 @@ def campaign_progress(rate: Optional[float],
         reg.gauge(CAMPAIGN_IN_BAND,
                   "band SPRT verdict (1 = measured rate in the target "
                   "band, 0 = out of band)").set(in_band)
+    if repros_per_hour_virtual is not None:
+        reg.gauge(CAMPAIGN_REPROS_PER_HOUR_VIRTUAL,
+                  "reproductions per hour of VIRTUAL run time "
+                  "(fast-forwarded campaigns; wall-denominated "
+                  "surfaces keep nmz_campaign_repros_per_hour)").set(
+                      repros_per_hour_virtual)
+
+
+def vclock_speedup(ratio: float) -> None:
+    """One run's virtual/wall elapsed ratio (virtual-clock plane)."""
+    if not metrics.enabled():
+        return
+    metrics.get().gauge(
+        VCLOCK_SPEEDUP,
+        "virtual elapsed / wall elapsed of the last virtual-clock run",
+    ).set(ratio)
+
+
+def vclock_pinned(seconds: float) -> None:
+    """Wall seconds the pinning rule held the virtual clock at wall
+    rate during the last run (accumulates across runs)."""
+    if not metrics.enabled():
+        return
+    metrics.get().counter(
+        VCLOCK_PINNED,
+        "wall seconds the virtual clock spent pinned to wall rate "
+        "(busy queues, running entities, real I/O)",
+    ).inc(seconds)
 
 
 def relation_coverage(scenario: str, covered: int, width: int,

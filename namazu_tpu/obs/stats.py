@@ -226,6 +226,18 @@ def runs_for_ci_width(rate: Optional[float],
     return max(1, math.ceil((2.0 * z / width) ** 2 * var))
 
 
+def repros_per_hour(failures: int, total_seconds: Optional[float]
+                    ) -> Optional[float]:
+    """Throughput in repros/hour over ``total_seconds`` of run time;
+    ``None`` without a measured denominator. The SAME helper computes
+    the wall-denominated rate and its virtual-clock twin — the two
+    rates differ ONLY by which elapsed total is passed in, never by
+    formula (doc/performance.md "Virtual clock")."""
+    if not total_seconds or total_seconds <= 0:
+        return None
+    return round(failures / (total_seconds / 3600.0), 1)
+
+
 def eta_next_repro_s(repros_per_hour: Optional[float]) -> Optional[float]:
     """Expected seconds to the next reproduction at the measured pace;
     ``None`` before any repro (no pace to extrapolate)."""

@@ -122,6 +122,26 @@ class Orchestrator:
         self.hub.table_publisher = pub
         if pub is not None and not self.enabled:
             pub.suspend()
+        # virtual clock (doc/performance.md "Virtual clock"): when the
+        # process runs under a VirtualTimeSource (`run --virtual-clock`
+        # installed it before this constructor), the orchestrator's
+        # queues become the coordinator's busy probes — an event or
+        # action anywhere in flight between intake and dispatch vetoes
+        # fast-forward, so a jump can never overtake work that is about
+        # to park a new deadline. Wall time: zero cost, nothing
+        # registered.
+        from namazu_tpu.utils import timesource
+
+        self.time_source = timesource.get()
+        if self.time_source.is_virtual:
+            self.time_source.add_busy_probe(
+                lambda: not self.hub.event_queue.empty())
+            self.time_source.add_busy_probe(
+                lambda: not self._merged_actions.empty())
+            self.time_source.add_busy_probe(
+                lambda: not self.policy.action_out.empty())
+            self.time_source.add_busy_probe(
+                lambda: not self.dumb.action_out.empty())
 
     @staticmethod
     def _default_hub(config: Config) -> EndpointHub:

@@ -127,9 +127,16 @@ class QueueBackedPolicy(ExplorePolicy):
     idempotent start, a dequeue worker mapping each released event to an
     action via :meth:`_action_for`, and a flushing shutdown."""
 
-    def __init__(self, seed: Optional[int] = None) -> None:
+    def __init__(self, seed: Optional[int] = None,
+                 time_source=None) -> None:
         super().__init__()
-        self._queue = ScheduledQueue(seed=seed, obs_name=self.name)
+        # the delay queue reads the process TimeSource by default: a
+        # `run --virtual-clock` installs a VirtualTimeSource before the
+        # policy is constructed, and the queue's parked deadlines
+        # become the fast-forward coordinator's jump targets
+        # (utils/timesource.py)
+        self._queue = ScheduledQueue(seed=seed, obs_name=self.name,
+                                     time_source=time_source)
         self._started = False
         self._start_lock = threading.Lock()
         self._dequeue_thread: Optional[threading.Thread] = None

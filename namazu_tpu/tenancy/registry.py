@@ -27,11 +27,11 @@ host's reaper thread.
 from __future__ import annotations
 
 import threading
-import time
 import uuid as _uuid
 from typing import Any, Dict, List, Optional
 
 from namazu_tpu import chaos, tenancy
+from namazu_tpu.utils import timesource
 from namazu_tpu.obs import recorder as _recorder
 from namazu_tpu.obs import spans as _spans
 from namazu_tpu.policy.base import ExplorePolicy, create_policy
@@ -67,7 +67,7 @@ class RunNamespace:
         self.collect_trace = collect_trace
         self.storage_dir = storage_dir
         self.trace = SingleTrace()
-        self.created_mono = time.monotonic()
+        self.created_mono = timesource.get().now()
         #: events ingested for this namespace (the /fleet RUN row)
         self.events_ingested = 0
         #: per-namespace orchestration switch (a namespaced control op
@@ -98,7 +98,12 @@ class Lease:
         self.lease_id = _uuid.uuid4().hex
         self.ns = ns
         self.ttl_s = ttl_s
-        self.expires_at = time.monotonic() + ttl_s
+        # TTLs read the process TimeSource, same as the delay queue: a
+        # virtual-clock fast-forward advances a live tenant's renewals
+        # and its lease's expiry through the SAME clock, so a jump
+        # cannot expire a lease whose tenant is healthy
+        # (doc/performance.md "Virtual clock")
+        self.expires_at = timesource.get().now() + ttl_s
         self.renewals = 0
         self.journal_dir = journal_dir
 
@@ -205,7 +210,7 @@ class RunRegistry:
                 raise TenancyError(f"unknown lease {lease_id!r} "
                                    "(expired and reclaimed?)")
             lease.ttl_s = _clamp_ttl(ttl_s, default=lease.ttl_s)
-            lease.expires_at = time.monotonic() + lease.ttl_s
+            lease.expires_at = timesource.get().now() + lease.ttl_s
             lease.renewals += 1
             return {"lease_id": lease_id, "run": lease.ns.name,
                     "ttl_s": lease.ttl_s,
@@ -261,7 +266,7 @@ class RunRegistry:
 
     def payload(self) -> List[Dict[str, Any]]:
         """Active leases, for the ``runs`` status op and /fleet."""
-        now = time.monotonic()
+        now = timesource.get().now()
         with self._lock:
             return [{
                 "run": lease.ns.name,
@@ -290,7 +295,7 @@ class RunRegistry:
         how many were reclaimed. The ``tenancy.lease.expire`` chaos
         seam force-expires one live lease per fire — the deterministic
         stand-in for a tenant that stopped renewing."""
-        now = time.monotonic() if now is None else now
+        now = timesource.get().now() if now is None else now
         due: List[Lease] = []
         with self._lock:
             for lease in list(self._leases.values()):

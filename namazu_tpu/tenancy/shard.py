@@ -18,11 +18,11 @@ and well-mixed in the low bits the modulo keeps.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Dict, List, Optional, Tuple
 
 from namazu_tpu import tenancy
 from namazu_tpu.policy.replayable import fnv64a as _fnv64a_bytes
+from namazu_tpu.utils import timesource
 
 
 def fnv64a(text: str) -> int:
@@ -76,8 +76,12 @@ class ShardedRoutes:
                      now: Optional[float] = None) -> Optional[str]:
         """Record one inbound event's route + liveness; returns the
         PREVIOUS endpoint name when the entity moved (the caller logs
-        it — log I/O never runs under a shard lock)."""
-        now = time.monotonic() if now is None else now
+        it — log I/O never runs under a shard lock). Liveness stamps
+        read the process TimeSource: under a virtual clock the
+        watchdog's ``stalled`` sweep compares against the SAME jumped
+        clock, so a fast-forward cannot declare a healthy (parked)
+        entity silent (doc/performance.md "Virtual clock")."""
+        now = timesource.get().now() if now is None else now
         shard = self._shard(key)
         with shard.lock:
             prev = shard.route.get(key)
@@ -91,7 +95,7 @@ class ShardedRoutes:
                           ) -> List[Tuple[str, str]]:
         """Batch face: keys grouped by shard, ONE lock acquisition per
         touched shard. Returns the ``(key, previous_endpoint)`` moves."""
-        now = time.monotonic()
+        now = timesource.get().now()
         by_shard: Dict[int, List[str]] = {}
         for key in keys:
             ns, entity = tenancy.split_route_key(key)
@@ -166,7 +170,7 @@ class ShardedRoutes:
 
     def stalled(self, timeout_s: float,
                 now: Optional[float] = None) -> Dict[str, float]:
-        now = time.monotonic() if now is None else now
+        now = timesource.get().now() if now is None else now
         out: Dict[str, float] = {}
         for shard in self._shards:
             with shard.lock:

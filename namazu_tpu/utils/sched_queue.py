@@ -30,10 +30,10 @@ import heapq
 import itertools
 import random
 import threading
-import time
 from typing import Any, Optional, Tuple
 
 from namazu_tpu import obs
+from namazu_tpu.utils import timesource
 
 
 class QueueClosed(Exception):
@@ -42,13 +42,23 @@ class QueueClosed(Exception):
 
 class ScheduledQueue:
     def __init__(self, seed: Optional[int] = None, time_scale: float = 1.0,
-                 obs_name: str = ""):
+                 obs_name: str = "",
+                 time_source: Optional[timesource.TimeSource] = None):
         """``time_scale`` < 1 compresses all delays (useful in tests).
         ``obs_name`` labels this queue's depth gauge and realized-wait
-        histogram in the metrics registry ("" = uninstrumented)."""
+        histogram in the metrics registry ("" = uninstrumented).
+        ``time_source`` is the clock release times are computed and
+        waited against (default: the process TimeSource) — under a
+        :class:`~namazu_tpu.utils.timesource.VirtualTimeSource` the
+        blocked consumer's earliest deadline becomes the fast-forward
+        coordinator's jump target, so the queue's delays cost virtual
+        seconds, not wall seconds (doc/performance.md "Virtual
+        clock")."""
         self._rng = random.Random(seed)
         self._time_scale = float(time_scale)
         self._obs_name = obs_name
+        self._ts = time_source if time_source is not None \
+            else timesource.get()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         # (release_time, seq, put_time, item); the unique seq tiebreak
@@ -66,7 +76,7 @@ class ScheduledQueue:
             delay = min_delay
         else:
             delay = self._rng.uniform(min_delay, max_delay)
-        now = time.monotonic()
+        now = self._ts.now()
         release = now + delay * self._time_scale
         with self._cond:
             if self._closed:
@@ -103,7 +113,7 @@ class ScheduledQueue:
             else:
                 sampled.append((item, self._rng.uniform(min_delay,
                                                         max_delay)))
-        now = time.monotonic()
+        now = self._ts.now()
         with self._cond:
             if self._closed:
                 raise QueueClosed
@@ -138,10 +148,10 @@ class ScheduledQueue:
         of one wakeup per item. Never waits for more items once one is
         ripe, so batching cannot delay a release."""
         max_n = max(1, max_n)
-        deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else self._ts.now() + timeout
         with self._cond:
             while True:
-                now = time.monotonic()
+                now = self._ts.now()
                 if self._heap:
                     release = self._heap[0][0]
                     if release <= now:
@@ -169,7 +179,10 @@ class ScheduledQueue:
                     if remaining <= 0:
                         raise TimeoutError
                     wait = remaining if wait is None else min(wait, remaining)
-                self._cond.wait(wait)
+                # under a virtual source this registers the deadline
+                # with the fast-forward coordinator and is woken by a
+                # jump; under wall time it IS Condition.wait
+                self._ts.wait(self._cond, wait)
 
     def expedite(self, predicate, collect: bool = False):
         """Make every resident item with ``predicate(item)`` true ripe
@@ -228,6 +241,13 @@ class ScheduledQueue:
                 obs.sched_queue_depth(self._obs_name, 0)
             self._cond.notify_all()
             return items
+
+    def earliest_release(self) -> Optional[float]:
+        """The head item's release time in the queue's TimeSource
+        domain (None when empty) — the discrete-event fast-forward
+        target a quiescent virtual clock jumps to."""
+        with self._lock:
+            return self._heap[0][0] if self._heap else None
 
     def __len__(self) -> int:
         with self._lock:

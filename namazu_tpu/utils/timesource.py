@@ -1,0 +1,470 @@
+"""TimeSource: the one clock the scheduling planes read.
+
+Every campaign today buys reproductions with wall-clock seconds: the
+delay queue's ``[min_delay, max_delay]`` windows are real sleeps, so
+repros/hour — the north-star unit (RESULTS.md) — is bounded by delays
+the orchestrator itself scheduled. Namazu's premise is that the
+orchestrator already owns nondeterminism (inspectors park events,
+policies decide release order); this module extends that ownership to
+TIME (doc/performance.md "Virtual clock"):
+
+* :class:`WallTimeSource` — the default. ``now()`` IS
+  ``time.monotonic()`` and ``wait()`` IS ``Condition.wait()``; a
+  process that never opts in behaves byte-identically to the
+  pre-TimeSource code.
+* :class:`VirtualTimeSource` — virtual monotonic = real monotonic + a
+  jumpable offset. Between jumps the virtual clock advances at wall
+  rate (so a ``cond.wait(remaining)`` computed in virtual seconds is
+  EXACT), and a **discrete-event fast-forward** jumps the offset to
+  the earliest parked deadline the moment nothing real is left to
+  wait for: when every registered waiter (a :class:`ScheduledQueue`
+  blocked on its heap's head) and every interposed entity (the epoch
+  page's slots, :mod:`namazu_tpu.vclock`) is parked, the busy probes
+  (orchestrator queues) are idle, and nobody holds a pin, the
+  coordinator jumps the clock to the earliest deadline instead of
+  sleeping through it.
+
+The safety valve (the "pinning rule"): any activity OUTSIDE the
+virtualized waits keeps the clock at wall rate — a nonzero pin count,
+a busy probe reporting work in flight, or an epoch-page entity slot in
+the *running* state (an interposed process doing real I/O between
+hooked waits) all veto the jump. Fast-forward therefore never races an
+un-virtualized wait; at worst it degrades to exactly the wall-clock
+behavior it replaced.
+
+Consumers reach the process default through :func:`get` /
+:func:`install`; liveness watchdogs, tenancy lease TTLs, and campaign
+phase deadlines all read the SAME source as the delay queue, so a
+10x fast-forward cannot declare healthy entities stalled or expire
+live leases (doc/performance.md "Virtual clock").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "TimeSource", "WallTimeSource", "VirtualTimeSource",
+    "get", "install", "reset",
+]
+
+
+class TimeSource:
+    """The clock interface the scheduling planes program against."""
+
+    #: virtual sources override; consumers branch on this to register
+    #: busy probes / pins without importing the concrete class
+    is_virtual = False
+
+    def now(self) -> float:
+        """Monotonic seconds in this source's time domain."""
+        raise NotImplementedError
+
+    def wall(self) -> float:
+        """Real CLOCK_MONOTONIC seconds, always — for cost accounting
+        (how long did this actually take) regardless of virtualization."""
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        raise NotImplementedError
+
+    def wait(self, cond: threading.Condition, timeout: Optional[float]
+             ) -> bool:
+        """``cond.wait(timeout)`` with ``timeout`` denominated in THIS
+        source's seconds. The caller holds ``cond``; returns like
+        ``Condition.wait``. Virtual sources register the wait so the
+        fast-forward coordinator can see the deadline and wake the
+        waiter after a jump."""
+        raise NotImplementedError
+
+
+class WallTimeSource(TimeSource):
+    """Real time. Deliberately nothing but pass-throughs: installing
+    this source (the default) must be byte-identical to the
+    pre-TimeSource behavior."""
+
+    def now(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        time.sleep(seconds)
+
+    def wait(self, cond: threading.Condition,
+             timeout: Optional[float]) -> bool:
+        return cond.wait(timeout)
+
+
+class VirtualTimeSource(TimeSource):
+    """Virtual monotonic time with discrete-event fast-forward.
+
+    ``now() = time.monotonic() + offset``. The offset only ever grows
+    (virtual time is still monotonic) and only via :meth:`advance` /
+    the coordinator's :meth:`maybe_jump`, which requires total
+    quiescence: no pins, idle busy probes, every epoch-page entity
+    parked. Waiters registered through :meth:`wait` are notified after
+    every jump so a blocked ``ScheduledQueue`` re-evaluates ripeness
+    immediately.
+    """
+
+    is_virtual = True
+
+    #: coordinator cadence, and the largest real sleep a quiescence
+    #: double-check inserts — small enough that a jump opportunity is
+    #: never missed by much, large enough to stay invisible in profiles
+    QUANTUM_S = 0.002
+    #: the double-check gap before a small jump: long enough to cover
+    #: an event in flight between two probed queues (an HTTP body
+    #: mid-parse is ~100-200us on loopback), short enough that it is
+    #: not the dominant per-jump cost — which it would be at QUANTUM_S,
+    #: since futex wakes make everything else on the jump path
+    #: microseconds
+    CONFIRM_GAP_S = 0.0003
+    #: cadence right after a successful jump, while a chain of closely
+    #: spaced deadlines is draining (a woken entity re-parks within
+    #: microseconds of its futex wake; waiting a full quantum to look
+    #: again would triple the per-jump cost)
+    DRAIN_CADENCE_S = 0.0001
+    #: how many post-jump attempts keep the drain cadence — a woken
+    #: entity needs ~0.5-1ms of scheduling to run its loop body and
+    #: re-park, during which attempts veto; falling back to QUANTUM_S
+    #: on the first such veto would forfeit the fast cadence exactly
+    #: when the next deadline of the chain is about to appear (the
+    #: window still totals ~2ms of wall time, it is just sliced finer)
+    DRAIN_ROUNDS = 20
+    #: jumps shorter than this ripen naturally before a waiter could
+    #: even be notified; skip them
+    MIN_JUMP_S = 0.001
+    #: jumps overshoot the earliest deadline by this much — the same
+    #: oversleep jitter a wall-rate nanosleep exhibits (sleep(2) means
+    #: "at least", and the OS routinely adds 1-5ms), so semantics are
+    #: unchanged, but deadlines CLUSTERED within the slack (three
+    #: nodes' 20ms poll loops) ripen on one jump instead of three
+    JUMP_SLACK_S = 0.002
+    #: jumps past this need sustained quiescence: a thread that was
+    #: just woken (SIGCHLD delivered, data arrived) still LOOKS parked
+    #: until the scheduler runs it, and a big jump taken inside that
+    #: few-ms window would fast-forward to some far-out watchdog or
+    #: long-poll deadline the wall-rate run would never reach
+    BIG_JUMP_S = 1.0
+    #: extra confirmation rounds (QUANTUM_S apart) for big jumps —
+    #: ~20ms of sustained quiescence, well past scheduler wake latency
+    BIG_JUMP_CONFIRMS = 10
+
+    def __init__(self, epoch_page=None, min_entities: int = 0) -> None:
+        self._lock = threading.Lock()
+        self._offset = 0.0
+        self._pins = 0
+        self._waiters: Dict[object, Tuple[threading.Condition,
+                                          Optional[float]]] = {}
+        self._probes: List[Callable[[], bool]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: optional shared-memory epoch page (namazu_tpu.vclock): the
+        #: interposed entities' park/run states and the C-visible face
+        #: of the offset
+        self.epoch_page = epoch_page
+        #: jumps are vetoed until this many entity slots are claimed —
+        #: guards the window between spawning interposed children and
+        #: their first hooked call (config ``vclock_min_entities``)
+        self.min_entities = int(min_entities)
+        self.started_wall = time.monotonic()
+        #: virtual seconds skipped by jumps (the fast-forward win)
+        self.jumped_s = 0.0
+        #: wall seconds spent with the clock pinned to wall rate
+        self.pinned_s = 0.0
+        self.jumps = 0
+        #: why jump attempts were vetoed, by pinning-rule clause — the
+        #: first diagnostic to read when a campaign's speedup is ~1x
+        #: (e.g. entity_running dominating means an interposed thread
+        #: blocks in an un-hooked call)
+        self.veto_counts: Dict[str, int] = {}
+
+    # -- the clock --------------------------------------------------------
+
+    def now(self) -> float:
+        with self._lock:
+            return time.monotonic() + self._offset
+
+    def sleep(self, seconds: float) -> None:
+        """Virtual-aware sleep: park on a private condition until the
+        virtual deadline passes (a jump wakes it early)."""
+        if seconds <= 0:
+            return
+        cond = threading.Condition()
+        deadline = self.now() + seconds
+        with cond:
+            while True:
+                remaining = deadline - self.now()
+                if remaining <= 0:
+                    return
+                self.wait(cond, remaining)
+
+    def wait(self, cond: threading.Condition,
+             timeout: Optional[float]) -> bool:
+        """Registered condition wait. Between jumps the virtual clock
+        advances at wall rate, so ``cond.wait(timeout)`` with a
+        virtual-second timeout is exact; a jump notifies ``cond`` (the
+        coordinator holds the cond lock to do so, which a registered
+        waiter has released by definition), after which the caller's
+        wait loop recomputes its deadline against the jumped clock."""
+        key = object()
+        deadline = None if timeout is None else self.now() + timeout
+        with self._lock:
+            self._waiters[key] = (cond, deadline)
+        try:
+            return cond.wait(timeout)
+        finally:
+            with self._lock:
+                self._waiters.pop(key, None)
+
+    # -- the pinning rule -------------------------------------------------
+
+    def pin(self) -> None:
+        """Veto fast-forward until :meth:`unpin` — the explicit face of
+        the safety valve (e.g. a run script still booting its
+        interposed children). Pinned wall seconds are accounted by the
+        coordinator loop (every non-jumping quantum is a pinned
+        quantum), so explicit pins and implicit ones (busy probes,
+        running entities) land in the same ``pinned_s`` total."""
+        with self._lock:
+            self._pins += 1
+
+    def unpin(self) -> None:
+        with self._lock:
+            self._pins = max(0, self._pins - 1)
+
+    class _Pinned:
+        def __init__(self, ts: "VirtualTimeSource") -> None:
+            self._ts = ts
+
+        def __enter__(self):
+            self._ts.pin()
+            return self._ts
+
+        def __exit__(self, *exc):
+            self._ts.unpin()
+            return False
+
+    def pinned(self) -> "VirtualTimeSource._Pinned":
+        return VirtualTimeSource._Pinned(self)
+
+    def add_busy_probe(self, probe: Callable[[], bool]) -> None:
+        """Register a work-in-flight probe (True = busy). The
+        orchestrator registers its event/action queues so a jump can
+        never overtake an event already inbound but not yet parked."""
+        with self._lock:
+            self._probes.append(probe)
+
+    # -- jumping ----------------------------------------------------------
+
+    def advance(self, seconds: float) -> None:
+        """Unconditionally advance the virtual clock (tests, and the
+        one primitive :meth:`maybe_jump` is built on)."""
+        if seconds <= 0:
+            return
+        with self._lock:
+            self._offset += seconds
+            self.jumped_s += seconds
+            self.jumps += 1
+            waiters = list(self._waiters.values())
+        page = self.epoch_page
+        if page is not None:
+            page.publish(self._offset)
+        for cond, _ in waiters:
+            with cond:
+                cond.notify_all()
+
+    def _quiescent_target(self) -> Tuple[Optional[float], Optional[str]]:
+        """``(earliest_parked_deadline, None)`` IF the system is
+        totally quiescent, else ``(None, veto_reason)``. One pass of
+        the pinning rule; the reason names the vetoing clause."""
+        with self._lock:
+            if self._pins > 0:
+                return None, "pinned"
+            probes = list(self._probes)
+            deadlines = [d for _, d in self._waiters.values()
+                         if d is not None]
+        for probe in probes:
+            try:
+                if probe():
+                    return None, "probe_busy"
+            except Exception:  # pragma: no cover - defensive
+                return None, "probe_busy"
+        page = self.epoch_page
+        if page is not None:
+            all_parked, entity_deadline, claimed = page.parked_state()
+            if claimed < self.min_entities:
+                return None, "entities_below_min"
+            if not all_parked:
+                return None, "entity_running"
+            if entity_deadline is not None:
+                deadlines.append(entity_deadline)
+        elif self.min_entities > 0:
+            return None, "entities_below_min"
+        if not deadlines:
+            return None, "nothing_parked"
+        return min(deadlines), None
+
+    def _veto(self, reason: str) -> float:
+        self.veto_counts[reason] = self.veto_counts.get(reason, 0) + 1
+        return 0.0
+
+    def maybe_jump(self) -> float:
+        """One fast-forward attempt; returns the virtual seconds
+        skipped (0.0 when the pinning rule vetoed or nothing is
+        parked). Quiescence is sampled twice, ``CONFIRM_GAP_S`` apart,
+        and the jump happens only if both passes agree on a target —
+        the double-check closes the window where an event is in flight
+        between two probed queues. (The coordinator loop pipelines the
+        two samples across ticks instead of sleeping inline — same
+        protocol, no extra sleep on the steady-state jump path.)"""
+        target, veto = self._quiescent_target()
+        if target is None:
+            return self._veto(veto)
+        time.sleep(self.CONFIRM_GAP_S)
+        confirm, veto = self._quiescent_target()
+        if confirm is None:
+            return self._veto(veto)
+        return self._commit(min(target, confirm))
+
+    def _commit(self, target: float) -> float:
+        """Second half of a jump, after two quiescent sightings agreed
+        on ``target``: big jumps take extra sustained-quiescence
+        rounds, chaos seams may stall or skew, then the clock
+        advances."""
+        delta = target - self.now()
+        if delta <= self.MIN_JUMP_S:
+            return 0.0
+        if delta > self.BIG_JUMP_S:
+            for _ in range(self.BIG_JUMP_CONFIRMS):
+                time.sleep(self.QUANTUM_S)
+                confirm, veto = self._quiescent_target()
+                if confirm is None:
+                    return self._veto(veto)
+                target = min(target, confirm)
+            delta = target - self.now()
+            if delta <= self.MIN_JUMP_S:
+                return 0.0
+        # chaos seams on the epoch-page handshake (doc/robustness.md):
+        # clock.stall skips this advance (parked entities real-sleep
+        # through the window — slower, never wrong); clock.skew
+        # perturbs the jump target (an over/undershoot the wait loops
+        # must absorb). Imported lazily: utils must not import chaos at
+        # module load.
+        from namazu_tpu import chaos
+
+        if chaos.decide("clock.stall") is not None:
+            return 0.0
+        skew = chaos.decide("clock.skew")
+        if skew is not None:
+            delta = max(self.MIN_JUMP_S,
+                        delta + float(skew.get("skew_s", 0.002)))
+        delta += self.JUMP_SLACK_S
+        self.advance(delta)
+        return delta
+
+    # -- the coordinator --------------------------------------------------
+
+    def start_coordinator(self) -> None:
+        """Start the fast-forward thread (idempotent). It wakes every
+        ``QUANTUM_S`` and jumps whenever the pinning rule allows —
+        nothing else in the process needs to poll."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._coordinator_loop,
+                                        name="vclock-coordinator",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop_coordinator(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2)
+
+    def _coordinator_loop(self) -> None:
+        pinned_mark = time.monotonic()
+        since_jump = self.DRAIN_ROUNDS
+        # the double-check pipelined across ticks: a candidate target
+        # from the previous tick, committed only if THIS tick (>= the
+        # confirm gap later) still finds the system quiescent — the
+        # same two-sample protocol as maybe_jump with the tick sleep
+        # doubling as the confirm gap, so the steady-state jump path
+        # pays no extra inline sleep
+        pending: Optional[float] = None
+        while True:
+            if pending is not None:
+                cadence = self.CONFIRM_GAP_S
+            elif since_jump < self.DRAIN_ROUNDS:
+                # after a jump, deadlines usually come in chains (a
+                # woken entity re-parks one poll interval out within
+                # ~1ms): keep looking quickly instead of sleeping a
+                # full quantum between deadlines
+                cadence = self.DRAIN_CADENCE_S
+            else:
+                cadence = self.QUANTUM_S
+            if self._stop.wait(cadence):
+                return
+            target, veto = self._quiescent_target()
+            jumped = 0.0
+            if target is None:
+                self._veto(veto)
+                pending = None
+            elif pending is not None:
+                jumped = self._commit(min(target, pending))
+                pending = None
+            else:
+                pending = target
+            now_wall = time.monotonic()
+            if jumped <= 0.0:
+                # wall rate: the clock is pinned (probes busy, entities
+                # running, or nothing parked) — account the real second
+                self.pinned_s += now_wall - pinned_mark
+            pinned_mark = now_wall
+            since_jump = 0 if jumped > 0.0 else since_jump + 1
+
+    # -- reading ----------------------------------------------------------
+
+    def summary(self) -> Dict[str, float]:
+        wall_elapsed = time.monotonic() - self.started_wall
+        virtual_elapsed = wall_elapsed + self.jumped_s
+        return {
+            "wall_elapsed_s": round(wall_elapsed, 3),
+            "virtual_elapsed_s": round(virtual_elapsed, 3),
+            "jumped_s": round(self.jumped_s, 3),
+            "pinned_s": round(self.pinned_s, 3),
+            "jumps": self.jumps,
+            "speedup_ratio": (round(virtual_elapsed / wall_elapsed, 2)
+                              if wall_elapsed > 0 else None),
+            "veto_counts": dict(self.veto_counts),
+        }
+
+
+# -- the process default ---------------------------------------------------
+
+_default: TimeSource = WallTimeSource()
+_install_lock = threading.Lock()
+
+
+def get() -> TimeSource:
+    """The process's TimeSource. Wall unless a virtual source was
+    installed (``run --virtual-clock`` via :mod:`namazu_tpu.vclock`)."""
+    return _default
+
+
+def install(source: TimeSource) -> TimeSource:
+    """Install ``source`` process-globally; returns the previous one
+    (callers restore it on deactivation)."""
+    global _default
+    with _install_lock:
+        previous = _default
+        _default = source
+        return previous
+
+
+def reset() -> None:
+    """Back to wall time (tests)."""
+    install(WallTimeSource())

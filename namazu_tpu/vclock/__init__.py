@@ -1,0 +1,282 @@
+"""Virtual-clock plane: the epoch page + per-run activation.
+
+The TimeSource abstraction (utils/timesource.py) fast-forwards the
+*in-process* clock; this package is everything needed to extend that
+clock across the process boundary to the testee
+(doc/performance.md "Virtual clock"):
+
+* :class:`EpochPage` — a tiny mmap'd shared-memory file the
+  orchestrator writes and every interposed process reads. It carries
+  the virtual offset under a seqlock plus one slot per interposed
+  THREAD recording its park state: ``deadline_ns == 0`` means the
+  thread is running (doing real work outside a hooked wait — the
+  pinning rule's cross-process face), ``> 0`` means it is parked in a
+  virtualized sleep/poll until that virtual nanosecond. The
+  coordinator only jumps when every claimed slot is parked, and the
+  earliest slot deadline competes with the delay queue's as the jump
+  target.
+* the LD_PRELOAD interposer (``native/clock_interpose.cc``) is the C
+  reader/claimant of the page: it virtualizes ``clock_gettime`` /
+  ``gettimeofday`` and converts ``nanosleep``/``usleep``/``sleep`` and
+  ``poll``/``select``/``epoll_wait`` timeouts into parked epochs —
+  short real-sleep quanta that re-read the offset, so a jump is
+  observed within ~2ms of wall time.
+* :func:`activate` / :class:`VclockHandle` — the per-run lifecycle
+  `run --virtual-clock` drives: create the page, install a
+  :class:`~namazu_tpu.utils.timesource.VirtualTimeSource` over it,
+  start the coordinator, and export ``NMZ_VCLOCK`` (+ ``LD_PRELOAD``)
+  to the experiment's children.
+
+Binary page layout (little-endian, 64 slots):
+
+====== ===== =========================================================
+offset size  field
+====== ===== =========================================================
+0      8     magic ``NMZVCLK1``
+8      8     u64 seq — seqlock (odd while the writer is mid-update)
+16     8     i64 offset_ns — virtual = CLOCK_MONOTONIC + offset
+24     8     u64 slot_count
+32     16×N  slots: u64 owner ``(pid << 32) | tid`` (0 = free),
+             i64 deadline_ns (0 = running, >0 = parked until virtual)
+====== ===== =========================================================
+
+The seqlock write protocol (seq odd → fields → seq even) is what lets
+the C side read a consistent offset without a lock; slot claims are a
+compare-and-swap on the owner word, C-side only — Python only ever
+*reads* slots, plus garbage-collects slots whose owner thread is gone
+(a thread that died mid-run must not pin the clock forever).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import platform
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+from namazu_tpu import obs
+from namazu_tpu.utils import timesource
+from namazu_tpu.utils.log import get_logger
+
+log = get_logger("vclock")
+
+__all__ = ["EpochPage", "VclockHandle", "activate", "interposer_path",
+           "ENV_PAGE", "ENV_LIB"]
+
+#: the page-path env every interposed child reads
+ENV_PAGE = "NMZ_VCLOCK"
+#: optional override for the interposer .so location
+ENV_LIB = "NMZ_VCLOCK_LIB"
+
+MAGIC = b"NMZVCLK1"
+SLOTS = 64
+_HEADER = struct.Struct("<8sQqQ")          # magic, seq, offset_ns, slots
+_SLOT = struct.Struct("<Qq")               # owner, deadline_ns
+PAGE_SIZE = _HEADER.size + SLOTS * _SLOT.size
+#: deadlines at/above this are "parked without a deadline" (a thread in
+#: an indefinite poll/select): they satisfy the all-parked check but
+#: never propose a jump target — matches kForever in clock_interpose.cc
+FOREVER_NS = 1 << 62
+
+#: futex(2) syscall numbers by machine — parked interposed threads
+#: FUTEX_WAIT on the page's seq word, and publish() FUTEX_WAKEs them so
+#: a jump is observed in microseconds rather than a polling quantum.
+#: On an unlisted machine the wake is skipped and parked threads fall
+#: back to their bounded re-check slice: slower, never wrong.
+_SYS_FUTEX = {"x86_64": 202, "aarch64": 98}.get(platform.machine())
+_FUTEX_WAKE = 1
+try:
+    _libc = ctypes.CDLL(None, use_errno=True)
+except OSError:                                    # pragma: no cover
+    _libc = None
+
+
+class EpochPage:
+    """The orchestrator-side (writer) face of one run's epoch page."""
+
+    def __init__(self, path: str, create: bool = True) -> None:
+        self.path = path
+        if create or not os.path.exists(path):
+            with open(path, "wb") as f:
+                f.write(_HEADER.pack(MAGIC, 0, 0, SLOTS))
+                f.write(b"\x00" * (SLOTS * _SLOT.size))
+        self._f = open(path, "r+b")
+        self._mm = mmap.mmap(self._f.fileno(), PAGE_SIZE)
+        magic, _, _, slots = _HEADER.unpack_from(self._mm, 0)
+        if magic != MAGIC:
+            raise ValueError(f"{path} is not an epoch page")
+        self.slots = int(slots)
+
+    # -- writer ----------------------------------------------------------
+
+    def publish(self, offset_s: float) -> None:
+        """Seqlock write of the virtual offset: bump seq odd, store the
+        offset, bump seq even. A C reader that straddles the update
+        retries until seq is stable-and-even."""
+        seq = struct.unpack_from("<Q", self._mm, 8)[0]
+        struct.pack_into("<Q", self._mm, 8, seq + 1)
+        struct.pack_into("<q", self._mm, 16, int(offset_s * 1e9))
+        struct.pack_into("<Q", self._mm, 8, seq + 2)
+        self._futex_wake()
+
+    def _futex_wake(self) -> None:
+        """Wake every interposed thread FUTEX_WAITing on the seq word
+        (its low 32 bits — the futex ABI watches one int) so a freshly
+        published jump is observed immediately."""
+        if _libc is None or _SYS_FUTEX is None:
+            return
+        addr = ctypes.addressof(ctypes.c_uint32.from_buffer(self._mm, 8))
+        _libc.syscall(ctypes.c_long(_SYS_FUTEX), ctypes.c_void_p(addr),
+                      ctypes.c_int(_FUTEX_WAKE),
+                      ctypes.c_int(2 ** 31 - 1),
+                      None, None, ctypes.c_int(0))
+
+    # -- reader ----------------------------------------------------------
+
+    def offset_s(self) -> float:
+        return struct.unpack_from("<q", self._mm, 16)[0] / 1e9
+
+    def slot_states(self) -> list:
+        """``[(owner, deadline_ns)]`` for every claimed slot."""
+        out = []
+        for i in range(self.slots):
+            owner, deadline = _SLOT.unpack_from(
+                self._mm, _HEADER.size + i * _SLOT.size)
+            if owner:
+                out.append((owner, deadline))
+        return out
+
+    def parked_state(self) -> Tuple[bool, Optional[float], int]:
+        """``(all_parked, earliest_deadline_virtual_s, claimed)`` —
+        what the fast-forward coordinator's pinning rule reads. A slot
+        in the running state (deadline 0) pins the clock to wall rate;
+        dead owners are garbage-collected first so a crashed thread
+        cannot pin forever."""
+        self._gc_dead()
+        earliest: Optional[int] = None
+        claimed = 0
+        all_parked = True
+        for owner, deadline in self.slot_states():
+            claimed += 1
+            if deadline == 0:
+                all_parked = False
+            elif deadline < FOREVER_NS and (earliest is None
+                                            or deadline < earliest):
+                earliest = deadline
+        return (all_parked,
+                earliest / 1e9 if earliest is not None else None,
+                claimed)
+
+    def _gc_dead(self) -> None:
+        """Free slots whose owner thread no longer exists. /proc is the
+        authority: an interposed thread that exited without running its
+        thread-local destructor (SIGKILL) leaves a running-state slot
+        that would otherwise veto every future jump."""
+        for i in range(self.slots):
+            off = _HEADER.size + i * _SLOT.size
+            owner = struct.unpack_from("<Q", self._mm, off)[0]
+            if not owner:
+                continue
+            pid, tid = owner >> 32, owner & 0xFFFFFFFF
+            if not os.path.exists(f"/proc/{pid}/task/{tid}"):
+                struct.pack_into("<Qq", self._mm, off, 0, 0)
+
+    def close(self) -> None:
+        try:
+            self._mm.close()
+        finally:
+            self._f.close()
+
+
+def interposer_path() -> Optional[str]:
+    """The built clock interposer .so, or None. ``NMZ_VCLOCK_LIB``
+    wins; the default is the repo's native build dir (same layout the
+    fs interposer uses)."""
+    override = os.environ.get(ENV_LIB, "")
+    if override:
+        return override if os.path.exists(override) else None
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidate = os.path.join(here, "..", "..", "native", "build",
+                             "libnmz_clock_interpose.so")
+    candidate = os.path.normpath(candidate)
+    return candidate if os.path.exists(candidate) else None
+
+
+class VclockHandle:
+    """One run's virtual-clock session: owns the page, the installed
+    VirtualTimeSource, and the coordinator thread."""
+
+    def __init__(self, page: EpochPage,
+                 source: timesource.VirtualTimeSource,
+                 previous: timesource.TimeSource,
+                 lib: Optional[str]) -> None:
+        self.page = page
+        self.source = source
+        self._previous = previous
+        self.lib = lib
+        self._finished = False
+
+    def child_env(self) -> Dict[str, str]:
+        """The env every experiment child needs: the page path, and the
+        interposer prepended to LD_PRELOAD (composing with the fs
+        interposer when both planes are armed). Without a built
+        interposer children simply keep wall-rate waits — they then
+        hold no slots, so with ``vclock_min_entities`` unset the
+        in-process delay queue still fast-forwards."""
+        env = {ENV_PAGE: self.page.path}
+        if self.lib:
+            existing = os.environ.get("LD_PRELOAD", "")
+            env["LD_PRELOAD"] = (f"{self.lib}:{existing}" if existing
+                                 else self.lib)
+        return env
+
+    def finish(self) -> Dict[str, Any]:
+        """Stop fast-forwarding, restore the previous TimeSource, and
+        return (and publish) the session summary. Idempotent."""
+        if self._finished:
+            return self.source.summary()
+        self._finished = True
+        self.source.stop_coordinator()
+        timesource.install(self._previous)
+        summary = self.source.summary()
+        if summary["speedup_ratio"] is not None:
+            obs.vclock_speedup(summary["speedup_ratio"])
+        obs.vclock_pinned(summary["pinned_s"])
+        self.page.close()
+        log.info(
+            "virtual clock: %.2fs wall covered %.2fs virtual "
+            "(%.0f jump(s) skipped %.2fs; pinned to wall rate %.2fs; "
+            "speedup %sx)", summary["wall_elapsed_s"],
+            summary["virtual_elapsed_s"], summary["jumps"],
+            summary["jumped_s"], summary["pinned_s"],
+            summary["speedup_ratio"])
+        return summary
+
+
+def activate(workdir: str, cfg=None,
+             page_name: str = "vclock.page") -> VclockHandle:
+    """Arm the virtual clock for one run: create the epoch page in
+    ``workdir``, install a VirtualTimeSource reading it as the process
+    default (so every ScheduledQueue, liveness stamp, and lease TTL
+    constructed afterwards runs virtual), and start the fast-forward
+    coordinator. The caller exports :meth:`VclockHandle.child_env` to
+    its experiment children and calls :meth:`VclockHandle.finish` when
+    the run ends."""
+    page = EpochPage(os.path.join(workdir, page_name), create=True)
+    min_entities = 0
+    if cfg is not None:
+        min_entities = int(cfg.get("vclock_min_entities", 0) or 0)
+    source = timesource.VirtualTimeSource(epoch_page=page,
+                                          min_entities=min_entities)
+    previous = timesource.install(source)
+    source.start_coordinator()
+    lib = interposer_path()
+    if lib is None:
+        log.warning(
+            "virtual clock armed without the LD_PRELOAD interposer "
+            "(native/build/libnmz_clock_interpose.so not built): "
+            "in-process delays fast-forward, child-process waits stay "
+            "wall-rate")
+    return VclockHandle(page, source, previous, lib)

@@ -1,0 +1,700 @@
+/* LD_PRELOAD clock interposer: the testee-side half of the virtual
+ * clock (doc/performance.md "Virtual clock").
+ *
+ * The orchestrator's VirtualTimeSource fast-forwards its own process by
+ * adding a jumpable offset to CLOCK_MONOTONIC and publishing that
+ * offset into a small mmap'd epoch page (namazu_tpu/vclock). This
+ * library, preloaded into every experiment child, extends the same
+ * clock across the process boundary:
+ *
+ *  - clock_gettime / gettimeofday / time read real time + the page's
+ *    offset (seqlock read, no lock), so the child's clocks agree with
+ *    the orchestrator's to within one quantum;
+ *  - nanosleep / usleep / sleep / clock_nanosleep / sem_timedwait /
+ *    sem_clockwait and the timeouts of poll / select / epoll_wait /
+ *    epoll_pwait are converted from "wait this long" into "wait until
+ *    virtual deadline T": the thread claims a page slot, parks its
+ *    deadline there, and waits. Pure timer waits FUTEX_WAIT on the
+ *    page's seqlock word — the orchestrator FUTEX_WAKEs it after
+ *    every offset publish, so a jump is observed in microseconds;
+ *    waits that also watch fds (poll/select/epoll with fds) fall back
+ *    to short real slices (<= 2ms) re-reading the offset, so fd
+ *    readiness and signals keep untouched semantics;
+ *  - blocking calls whose wakeup comes from another ENTITY rather
+ *    than the clock — recv / recvfrom / accept / accept4 (peer data),
+ *    sem_wait (a sem_post), wait / wait3 / wait4 / waitpid (a child
+ *    exit), sigsuspend / pause (a signal) — park FOREVER around one
+ *    untouched real call: they count as parked for the all-parked
+ *    quiescence check but never propose a jump target. Without this
+ *    class, a thread blocked in recv() would sit in running state and
+ *    pin the clock for the whole run.
+ *
+ * The slot table is the pinning rule's cross-process face: a claimed
+ * slot whose deadline is 0 means "this thread is running" (CPU work,
+ * real I/O, an un-hooked syscall) and vetoes every jump — time only
+ * fast-forwards when all claimed slots are parked. A thread claims its
+ * slot lazily on the first hooked call and frees it from the
+ * thread_local destructor; threads killed without unwinding are
+ * garbage-collected by the orchestrator via /proc. If the table is
+ * full the thread stays invisible and falls back to real waits —
+ * slower, never wrong.
+ *
+ * Page layout (must match namazu_tpu/vclock/__init__.py): magic
+ * "NMZVCLK1", u64 seq (seqlock, odd = writer active), i64 offset_ns,
+ * u64 slot_count, then slots of { u64 owner = (pid << 32) | tid,
+ * i64 deadline_ns (0 = running, >= 1<<62 = parked without deadline) }.
+ */
+#define _GNU_SOURCE 1
+#include <dlfcn.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <linux/futex.h>
+#include <poll.h>
+#include <pthread.h>
+#include <semaphore.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/mman.h>
+#include <sys/resource.h>
+#include <sys/select.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <sys/time.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+template <typename Fn>
+Fn real(const char* name) {
+  return reinterpret_cast<Fn>(dlsym(RTLD_NEXT, name));
+}
+
+using clock_gettime_fn = int (*)(clockid_t, struct timespec*);
+using clock_nanosleep_fn = int (*)(clockid_t, int, const struct timespec*,
+                                   struct timespec*);
+using nanosleep_fn = int (*)(const struct timespec*, struct timespec*);
+using usleep_fn = int (*)(useconds_t);
+using sleep_fn = unsigned (*)(unsigned);
+using gettimeofday_fn = int (*)(struct timeval*, void*);
+using time_fn = time_t (*)(time_t*);
+using poll_fn = int (*)(struct pollfd*, nfds_t, int);
+using select_fn = int (*)(int, fd_set*, fd_set*, fd_set*, struct timeval*);
+using epoll_wait_fn = int (*)(int, struct epoll_event*, int, int);
+using epoll_pwait_fn = int (*)(int, struct epoll_event*, int, int,
+                               const sigset_t*);
+using recv_fn = ssize_t (*)(int, void*, size_t, int);
+using recvfrom_fn = ssize_t (*)(int, void*, size_t, int,
+                                struct sockaddr*, socklen_t*);
+using accept_fn = int (*)(int, struct sockaddr*, socklen_t*);
+using accept4_fn = int (*)(int, struct sockaddr*, socklen_t*, int);
+using sem_wait_fn = int (*)(sem_t*);
+using sem_timedwait_fn = int (*)(sem_t*, const struct timespec*);
+using sem_clockwait_fn = int (*)(sem_t*, clockid_t,
+                                 const struct timespec*);
+using sigsuspend_fn = int (*)(const sigset_t*);
+using pause_fn = int (*)(void);
+using wait_fn = pid_t (*)(int*);
+using wait3_fn = pid_t (*)(int*, int, struct rusage*);
+using wait4_fn = pid_t (*)(pid_t, int*, int, struct rusage*);
+using waitpid_fn = pid_t (*)(pid_t, int*, int);
+
+constexpr int64_t kNs = 1000000000LL;
+constexpr int64_t kQuantumNs = 2000000LL;  // 2ms: jump-observation latency
+// parked with no deadline (indefinite poll/select): counts as parked
+// for the all-parked check but never proposes a jump target
+constexpr int64_t kForever = int64_t{1} << 62;
+
+struct Slot {
+  uint64_t owner;
+  int64_t deadline_ns;
+};
+
+struct Page {
+  char magic[8];
+  uint64_t seq;
+  int64_t offset_ns;
+  uint64_t slot_count;
+  Slot slots[];
+};
+
+Page* page() {
+  static Page* p = [] {
+    const char* path = getenv("NMZ_VCLOCK");
+    if (path == nullptr || path[0] == '\0') return (Page*)nullptr;
+    int fd = open(path, O_RDWR | O_CLOEXEC);
+    if (fd < 0) return (Page*)nullptr;
+    struct stat st;
+    if (fstat(fd, &st) != 0 ||
+        (size_t)st.st_size < sizeof(Page) + sizeof(Slot)) {
+      close(fd);
+      return (Page*)nullptr;
+    }
+    void* m = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+    close(fd);
+    if (m == MAP_FAILED) return (Page*)nullptr;
+    Page* pg = (Page*)m;
+    if (memcmp(pg->magic, "NMZVCLK1", 8) != 0) {
+      munmap(m, (size_t)st.st_size);
+      return (Page*)nullptr;
+    }
+    return pg;
+  }();
+  return p;
+}
+
+int64_t offset_ns() {
+  Page* pg = page();
+  if (pg == nullptr) return 0;
+  // seqlock read: retry while the orchestrator is mid-publish
+  for (;;) {
+    uint64_t s1 = __atomic_load_n(&pg->seq, __ATOMIC_ACQUIRE);
+    if (s1 & 1) continue;
+    int64_t off = __atomic_load_n(&pg->offset_ns, __ATOMIC_ACQUIRE);
+    uint64_t s2 = __atomic_load_n(&pg->seq, __ATOMIC_ACQUIRE);
+    if (s1 == s2) return off;
+  }
+}
+
+int64_t real_mono_ns() {
+  static auto fn = real<clock_gettime_fn>("clock_gettime");
+  struct timespec ts;
+  fn(CLOCK_MONOTONIC, &ts);
+  return (int64_t)ts.tv_sec * kNs + ts.tv_nsec;
+}
+
+int64_t vnow_ns() { return real_mono_ns() + offset_ns(); }
+
+// Slot lifetime: claimed on the thread's first hooked call, freed by
+// the thread_local destructor on clean thread exit. Between hooked
+// waits the slot sits in running state (deadline 0) — that IS the
+// pinning rule: an interposed thread doing anything other than a
+// hooked wait holds virtual time to wall rate.
+struct SlotGuard {
+  Slot* slot = nullptr;
+  ~SlotGuard() {
+    if (slot != nullptr) {
+      __atomic_store_n(&slot->deadline_ns, 0, __ATOMIC_RELEASE);
+      __atomic_store_n(&slot->owner, 0, __ATOMIC_RELEASE);
+    }
+  }
+};
+
+thread_local SlotGuard tls_slot;
+
+Slot* my_slot() {
+  uint64_t me = ((uint64_t)getpid() << 32) |
+                (uint64_t)(uint32_t)syscall(SYS_gettid);
+  if (tls_slot.slot != nullptr) {
+    // a forked child inherits the parent's TLS pointer — writing
+    // through it would corrupt the PARENT's slot; detect the owner
+    // mismatch and claim fresh
+    if (__atomic_load_n(&tls_slot.slot->owner, __ATOMIC_ACQUIRE) == me)
+      return tls_slot.slot;
+    tls_slot.slot = nullptr;
+  }
+  Page* pg = page();
+  if (pg == nullptr) return nullptr;
+  // adopt an existing slot first: exec preserves pid/tid, so the slot
+  // the pre-exec image (atfork handler) claimed is still ours — a
+  // second claim would leave an orphan stuck in running state
+  for (uint64_t i = 0; i < pg->slot_count; i++) {
+    if (__atomic_load_n(&pg->slots[i].owner, __ATOMIC_ACQUIRE) == me) {
+      tls_slot.slot = &pg->slots[i];
+      return tls_slot.slot;
+    }
+  }
+  for (uint64_t i = 0; i < pg->slot_count; i++) {
+    uint64_t expect = 0;
+    if (__atomic_compare_exchange_n(&pg->slots[i].owner, &expect, me,
+                                    false, __ATOMIC_ACQ_REL,
+                                    __ATOMIC_ACQUIRE)) {
+      __atomic_store_n(&pg->slots[i].deadline_ns, 0, __ATOMIC_RELEASE);
+      tls_slot.slot = &pg->slots[i];
+      return tls_slot.slot;
+    }
+  }
+  return nullptr;  // table full: stay invisible, waits fall back to real
+}
+
+// RAII park: deadline published on entry, running state restored on
+// every exit path (return, signal-induced early return)
+struct ParkScope {
+  Slot* slot;
+  explicit ParkScope(int64_t deadline) : slot(my_slot()) {
+    if (slot != nullptr)
+      __atomic_store_n(&slot->deadline_ns, deadline, __ATOMIC_RELEASE);
+  }
+  ~ParkScope() {
+    if (slot != nullptr)
+      __atomic_store_n(&slot->deadline_ns, 0, __ATOMIC_RELEASE);
+  }
+  bool parked() const { return slot != nullptr; }
+};
+
+/* Visibility from the first instruction: a process must never be able
+ * to RUN while invisible to the pinning rule, or the coordinator can
+ * jump over work in flight the instant the visible world goes quiet
+ * (e.g. over the grep in a run script's readiness loop, leaving a 60s
+ * long-poll deadline as the only — and wrong — jump target). Two
+ * seams close the gap:
+ *  - the fork child claims a running-state slot before it can execute
+ *    anything (its parent may already be parked in a hooked wait);
+ *    vfork/posix_spawn skip atfork handlers, but there the PARENT
+ *    stays blocked in running state until the exec, which pins;
+ *  - on library load (exec'd image) the main thread claims — adopting
+ *    the atfork slot when one exists, since exec preserves pid/tid. */
+void atfork_child() {
+  tls_slot.slot = nullptr;  // points into the PARENT's slot
+  if (page() != nullptr) my_slot();
+}
+
+__attribute__((constructor)) void claim_on_load() {
+  pthread_atfork(nullptr, nullptr, atfork_child);
+  if (page() != nullptr) my_slot();
+}
+
+struct timespec ns_to_ts(int64_t ns) {
+  if (ns < 0) ns = 0;
+  struct timespec ts;
+  ts.tv_sec = ns / kNs;
+  ts.tv_nsec = ns % kNs;
+  return ts;
+}
+
+// Largest real wait between jump-observation checks when the
+// orchestrator's FUTEX_WAKE cannot reach us (foreign-arch parent that
+// skipped the wake syscall); with wakes working, parked threads are
+// woken the instant a jump is published and this cap is never felt.
+constexpr int64_t kFutexSliceNs = 20000000LL;  // 20ms
+
+// Park until virtual deadline `target`. The thread futex-waits on the
+// page's seq word: the orchestrator FUTEX_WAKEs it after every offset
+// publish, so a jump is observed in microseconds, not a polling
+// quantum. Returns 0 on deadline reached, -1 with errno = EINTR when
+// a signal interrupted (rem gets the remaining VIRTUAL time).
+int park_until(int64_t target, struct timespec* rem) {
+  Page* pg = page();
+  static auto fn = real<nanosleep_fn>("nanosleep");
+  for (;;) {
+    int64_t remaining = target - vnow_ns();
+    if (remaining <= 0) return 0;
+    if (pg == nullptr) {  // unreachable when parked; belt and braces
+      struct timespec q =
+          ns_to_ts(remaining < kQuantumNs ? remaining : kQuantumNs);
+      if (fn(&q, nullptr) != 0 && errno == EINTR) {
+        if (rem != nullptr) *rem = ns_to_ts(target - vnow_ns());
+        return -1;
+      }
+      continue;
+    }
+    // the futex watches the low half of the seqlock word (it moves on
+    // every publish); a publish between the load and FUTEX_WAIT makes
+    // the wait return EAGAIN immediately — the classic race-free loop
+    uint32_t* uaddr = reinterpret_cast<uint32_t*>(&pg->seq);
+    uint32_t val = __atomic_load_n(uaddr, __ATOMIC_ACQUIRE);
+    remaining = target - vnow_ns();
+    if (remaining <= 0) return 0;
+    struct timespec ts =
+        ns_to_ts(remaining < kFutexSliceNs ? remaining : kFutexSliceNs);
+    long r = syscall(SYS_futex, uaddr, FUTEX_WAIT, val, &ts, nullptr, 0);
+    if (r != 0 && errno == EINTR) {
+      if (rem != nullptr) *rem = ns_to_ts(target - vnow_ns());
+      return -1;
+    }
+    // ETIMEDOUT: deadline (or slice) elapsed; EAGAIN: seq moved under
+    // us (a jump landed) — both re-check the deadline
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int clock_gettime(clockid_t clk, struct timespec* ts) {
+  static auto fn = real<clock_gettime_fn>("clock_gettime");
+  int r = fn(clk, ts);
+  if (r != 0 || page() == nullptr || ts == nullptr) return r;
+  switch (clk) {
+    case CLOCK_MONOTONIC:
+    case CLOCK_MONOTONIC_RAW:
+    case CLOCK_MONOTONIC_COARSE:
+    case CLOCK_BOOTTIME:
+    case CLOCK_REALTIME:
+    case CLOCK_REALTIME_COARSE: {
+      my_slot();  // clock readers become visible (and pin while running)
+      int64_t v = (int64_t)ts->tv_sec * kNs + ts->tv_nsec + offset_ns();
+      *ts = ns_to_ts(v);
+      return 0;
+    }
+    default:
+      return 0;  // per-process/thread CPU clocks stay real
+  }
+}
+
+int gettimeofday(struct timeval* tv, void* tz) {
+  static auto fn = real<gettimeofday_fn>("gettimeofday");
+  int r = fn(tv, tz);
+  if (r != 0 || page() == nullptr || tv == nullptr) return r;
+  my_slot();
+  int64_t v = (int64_t)tv->tv_sec * kNs + (int64_t)tv->tv_usec * 1000 +
+              offset_ns();
+  if (v < 0) v = 0;
+  tv->tv_sec = v / kNs;
+  tv->tv_usec = (v % kNs) / 1000;
+  return 0;
+}
+
+time_t time(time_t* out) {
+  static auto fn = real<time_fn>("time");
+  time_t t = fn(nullptr);
+  if (page() != nullptr && t != (time_t)-1) t += offset_ns() / kNs;
+  if (out != nullptr) *out = t;
+  return t;
+}
+
+int nanosleep(const struct timespec* req, struct timespec* rem) {
+  static auto fn = real<nanosleep_fn>("nanosleep");
+  if (page() == nullptr || req == nullptr) return fn(req, rem);
+  int64_t dur = (int64_t)req->tv_sec * kNs + req->tv_nsec;
+  if (dur <= 0) return fn(req, rem);
+  int64_t target = vnow_ns() + dur;
+  ParkScope park(target);
+  if (!park.parked()) return fn(req, rem);
+  return park_until(target, rem);
+}
+
+int clock_nanosleep(clockid_t clk, int flags, const struct timespec* req,
+                    struct timespec* rem) {
+  static auto fn = real<clock_nanosleep_fn>("clock_nanosleep");
+  if (page() == nullptr || req == nullptr ||
+      (clk != CLOCK_MONOTONIC && clk != CLOCK_REALTIME))
+    return fn(clk, flags, req, rem);
+  int64_t target;
+  if (flags & TIMER_ABSTIME) {
+    // absolute deadlines arrive in the caller's (virtual) clock
+    // domain; both hooked clocks share the one offset, so the
+    // monotonic virtual target is reached by the same delta
+    struct timespec now_v;
+    clock_gettime(clk, &now_v);
+    int64_t delta = (int64_t)req->tv_sec * kNs + req->tv_nsec -
+                    ((int64_t)now_v.tv_sec * kNs + now_v.tv_nsec);
+    if (delta <= 0) return 0;
+    target = vnow_ns() + delta;
+  } else {
+    int64_t dur = (int64_t)req->tv_sec * kNs + req->tv_nsec;
+    if (dur <= 0) return fn(clk, flags, req, rem);
+    target = vnow_ns() + dur;
+  }
+  ParkScope park(target);
+  if (!park.parked()) return fn(clk, flags, req, rem);
+  struct timespec myrem;
+  if (park_until(target, &myrem) != 0) {
+    // clock_nanosleep reports errors as return values, not errno;
+    // rem is only written for relative sleeps
+    if (rem != nullptr && !(flags & TIMER_ABSTIME)) *rem = myrem;
+    return EINTR;
+  }
+  return 0;
+}
+
+int usleep(useconds_t usec) {
+  static auto fn = real<usleep_fn>("usleep");
+  if (page() == nullptr || usec == 0) return fn(usec);
+  int64_t target = vnow_ns() + (int64_t)usec * 1000;
+  ParkScope park(target);
+  if (!park.parked()) return fn(usec);
+  return park_until(target, nullptr);
+}
+
+unsigned sleep(unsigned seconds) {
+  static auto fn = real<sleep_fn>("sleep");
+  if (page() == nullptr || seconds == 0) return fn(seconds);
+  int64_t target = vnow_ns() + (int64_t)seconds * kNs;
+  ParkScope park(target);
+  if (!park.parked()) return fn(seconds);
+  struct timespec rem;
+  if (park_until(target, &rem) != 0)
+    return (unsigned)(rem.tv_sec + (rem.tv_nsec > 0 ? 1 : 0));
+  return 0;
+}
+
+int poll(struct pollfd* fds, nfds_t nfds, int timeout) {
+  static auto fn = real<poll_fn>("poll");
+  if (page() == nullptr || timeout == 0) return fn(fds, nfds, timeout);
+  int64_t target =
+      timeout < 0 ? kForever : vnow_ns() + (int64_t)timeout * 1000000LL;
+  ParkScope park(target);
+  if (!park.parked()) return fn(fds, nfds, timeout);
+  if (nfds == 0 && target != kForever) {
+    // pure timer (CPython's time.sleep is poll(NULL, 0, ms)): no fds
+    // to watch, so futex-park instead of quantum-slicing
+    int r = park_until(target, nullptr);
+    return r == 0 ? 0 : -1;  // 0 = timeout; -1/EINTR passes through
+  }
+  for (;;) {
+    int64_t remaining =
+        target == kForever ? kQuantumNs : target - vnow_ns();
+    if (remaining <= 0) return 0;
+    int64_t q = remaining < kQuantumNs ? remaining : kQuantumNs;
+    int q_ms = (int)(q / 1000000LL);
+    if (q_ms <= 0) q_ms = 1;
+    int r = fn(fds, nfds, q_ms);
+    if (r != 0) return r;  // fd ready, or error (EINTR included)
+  }
+}
+
+int select(int nfds, fd_set* rd, fd_set* wr, fd_set* ex,
+           struct timeval* tv) {
+  static auto fn = real<select_fn>("select");
+  if (page() == nullptr ||
+      (tv != nullptr && tv->tv_sec == 0 && tv->tv_usec == 0))
+    return fn(nfds, rd, wr, ex, tv);
+  int64_t target = tv == nullptr
+                       ? kForever
+                       : vnow_ns() + (int64_t)tv->tv_sec * kNs +
+                             (int64_t)tv->tv_usec * 1000;
+  ParkScope park(target);
+  if (!park.parked()) return fn(nfds, rd, wr, ex, tv);
+  if (nfds == 0 && target != kForever) {
+    // pure timer (select-based sleeps pass no fds): futex-park
+    if (park_until(target, nullptr) != 0) return -1;  // EINTR
+    if (tv != nullptr) {
+      tv->tv_sec = 0;
+      tv->tv_usec = 0;
+    }
+    return 0;
+  }
+  // select clobbers its fd_sets on every call — keep the caller's
+  // originals so each quantum retry watches the full set
+  fd_set rd0, wr0, ex0;
+  if (rd != nullptr) rd0 = *rd;
+  if (wr != nullptr) wr0 = *wr;
+  if (ex != nullptr) ex0 = *ex;
+  for (;;) {
+    int64_t remaining =
+        target == kForever ? kQuantumNs : target - vnow_ns();
+    if (remaining <= 0) {
+      if (rd != nullptr) FD_ZERO(rd);
+      if (wr != nullptr) FD_ZERO(wr);
+      if (ex != nullptr) FD_ZERO(ex);
+      if (tv != nullptr) {
+        tv->tv_sec = 0;
+        tv->tv_usec = 0;
+      }
+      return 0;
+    }
+    if (rd != nullptr) *rd = rd0;
+    if (wr != nullptr) *wr = wr0;
+    if (ex != nullptr) *ex = ex0;
+    int64_t q = remaining < kQuantumNs ? remaining : kQuantumNs;
+    struct timeval qt;
+    qt.tv_sec = q / kNs;
+    qt.tv_usec = (q % kNs) / 1000;
+    if (qt.tv_sec == 0 && qt.tv_usec == 0) qt.tv_usec = 1000;
+    int r = fn(nfds, rd, wr, ex, &qt);
+    if (r != 0) return r;
+  }
+}
+
+int epoll_wait(int epfd, struct epoll_event* events, int maxevents,
+               int timeout) {
+  static auto fn = real<epoll_wait_fn>("epoll_wait");
+  if (page() == nullptr || timeout == 0)
+    return fn(epfd, events, maxevents, timeout);
+  int64_t target =
+      timeout < 0 ? kForever : vnow_ns() + (int64_t)timeout * 1000000LL;
+  ParkScope park(target);
+  if (!park.parked()) return fn(epfd, events, maxevents, timeout);
+  for (;;) {
+    int64_t remaining =
+        target == kForever ? kQuantumNs : target - vnow_ns();
+    if (remaining <= 0) return 0;
+    int64_t q = remaining < kQuantumNs ? remaining : kQuantumNs;
+    int q_ms = (int)(q / 1000000LL);
+    if (q_ms <= 0) q_ms = 1;
+    int r = fn(epfd, events, maxevents, q_ms);
+    if (r != 0) return r;
+  }
+}
+
+/* Timed semaphore waits: CPython's timed lock acquires (Event.wait
+ * with a timeout, Queue.get(timeout=...), Thread.join(timeout=...))
+ * compile to sem_clockwait(CLOCK_MONOTONIC) on glibc >= 2.30 and
+ * sem_timedwait(CLOCK_REALTIME) before that. Either way the caller
+ * computed `abs` against OUR virtualized clock, so the kernel — which
+ * compares against the real clock — would wait `offset` too long.
+ * Convert to a relative virtual wait and slice it into
+ * quantum-bounded real deadlines so jumps are observed. */
+
+static int sem_park(
+    sem_t* sem, clockid_t clk, const struct timespec* abs,
+    int (*waiter)(sem_t*, clockid_t, const struct timespec*)) {
+  static auto cg = real<clock_gettime_fn>("clock_gettime");
+  struct timespec now;
+  cg(clk, &now);
+  int64_t real_now = (int64_t)now.tv_sec * kNs + now.tv_nsec;
+  int64_t rel = (int64_t)abs->tv_sec * kNs + abs->tv_nsec -
+                (real_now + offset_ns());
+  if (rel <= 0) {
+    // virtually expired: force the real call to decide NOW (acquire
+    // if available, else ETIMEDOUT) instead of waiting out the offset
+    struct timespec past =
+        ns_to_ts(real_now > kNs ? real_now - kNs : 0);
+    return waiter(sem, clk, &past);
+  }
+  int64_t target = vnow_ns() + rel;
+  ParkScope park(target);
+  if (!park.parked()) return waiter(sem, clk, abs);
+  for (;;) {
+    int64_t remaining = target - vnow_ns();
+    if (remaining <= 0) {
+      errno = ETIMEDOUT;
+      return -1;
+    }
+    int64_t q = remaining < kQuantumNs ? remaining : kQuantumNs;
+    cg(clk, &now);
+    struct timespec slice =
+        ns_to_ts((int64_t)now.tv_sec * kNs + now.tv_nsec + q);
+    int r = waiter(sem, clk, &slice);
+    if (r == 0 || errno != ETIMEDOUT) return r;  // acquired, or EINTR
+  }
+}
+
+int sem_timedwait(sem_t* sem, const struct timespec* abs) {
+  static auto fn = real<sem_timedwait_fn>("sem_timedwait");
+  if (page() == nullptr) return fn(sem, abs);
+  return sem_park(sem, CLOCK_REALTIME, abs,
+                  [](sem_t* s, clockid_t, const struct timespec* t) {
+                    static auto f = real<sem_timedwait_fn>("sem_timedwait");
+                    return f(s, t);
+                  });
+}
+
+int sem_clockwait(sem_t* sem, clockid_t clk, const struct timespec* abs) {
+  static auto fn = real<sem_clockwait_fn>("sem_clockwait");
+  if (page() == nullptr || fn == nullptr ||
+      (clk != CLOCK_MONOTONIC && clk != CLOCK_REALTIME))
+    return fn != nullptr ? fn(sem, clk, abs) : (errno = ENOSYS, -1);
+  return sem_park(sem, clk, abs,
+                  [](sem_t* s, clockid_t c, const struct timespec* t) {
+                    static auto f = real<sem_clockwait_fn>("sem_clockwait");
+                    return f(s, c, t);
+                  });
+}
+
+/* Forever-parks: blocking calls woken by another entity (peer data, a
+ * sem_post, a child exit) — never by the clock. One untouched real
+ * call inside a kForever park: quiescent for the all-parked check,
+ * but never a jump target. ParkScope's dtor is two relaxed stores and
+ * leaves errno alone, so the hooked call's result passes through
+ * bit-exactly (nonblocking sockets, WNOHANG, EOF included). */
+
+ssize_t recv(int fd, void* buf, size_t n, int flags) {
+  static auto fn = real<recv_fn>("recv");
+  if (page() == nullptr) return fn(fd, buf, n, flags);
+  ParkScope park(kForever);
+  return fn(fd, buf, n, flags);
+}
+
+ssize_t recvfrom(int fd, void* buf, size_t n, int flags,
+                 struct sockaddr* addr, socklen_t* alen) {
+  static auto fn = real<recvfrom_fn>("recvfrom");
+  if (page() == nullptr) return fn(fd, buf, n, flags, addr, alen);
+  ParkScope park(kForever);
+  return fn(fd, buf, n, flags, addr, alen);
+}
+
+int accept(int fd, struct sockaddr* addr, socklen_t* alen) {
+  static auto fn = real<accept_fn>("accept");
+  if (page() == nullptr) return fn(fd, addr, alen);
+  ParkScope park(kForever);
+  return fn(fd, addr, alen);
+}
+
+int accept4(int fd, struct sockaddr* addr, socklen_t* alen, int flags) {
+  static auto fn = real<accept4_fn>("accept4");
+  if (page() == nullptr) return fn(fd, addr, alen, flags);
+  ParkScope park(kForever);
+  return fn(fd, addr, alen, flags);
+}
+
+int sem_wait(sem_t* sem) {
+  static auto fn = real<sem_wait_fn>("sem_wait");
+  if (page() == nullptr) return fn(sem);
+  ParkScope park(kForever);
+  return fn(sem);
+}
+
+pid_t wait(int* status) {
+  static auto fn = real<wait_fn>("wait");
+  if (page() == nullptr) return fn(status);
+  ParkScope park(kForever);
+  return fn(status);
+}
+
+pid_t wait3(int* status, int options, struct rusage* ru) {
+  static auto fn = real<wait3_fn>("wait3");
+  if (page() == nullptr) return fn(status, options, ru);
+  ParkScope park(kForever);
+  return fn(status, options, ru);
+}
+
+pid_t wait4(pid_t pid, int* status, int options, struct rusage* ru) {
+  static auto fn = real<wait4_fn>("wait4");
+  if (page() == nullptr) return fn(pid, status, options, ru);
+  ParkScope park(kForever);
+  return fn(pid, status, options, ru);
+}
+
+pid_t waitpid(pid_t pid, int* status, int options) {
+  static auto fn = real<waitpid_fn>("waitpid");
+  if (page() == nullptr) return fn(pid, status, options);
+  ParkScope park(kForever);
+  return fn(pid, status, options);
+}
+
+int sigsuspend(const sigset_t* mask) {
+  // dash's `wait` builtin blocks here for SIGCHLD — without this the
+  // run script's shell pins the clock for the whole campaign
+  static auto fn = real<sigsuspend_fn>("sigsuspend");
+  if (page() == nullptr) return fn(mask);
+  ParkScope park(kForever);
+  return fn(mask);
+}
+
+int pause(void) {
+  static auto fn = real<pause_fn>("pause");
+  if (page() == nullptr) return fn();
+  ParkScope park(kForever);
+  return fn();
+}
+
+int epoll_pwait(int epfd, struct epoll_event* events, int maxevents,
+                int timeout, const sigset_t* sigmask) {
+  static auto fn = real<epoll_pwait_fn>("epoll_pwait");
+  if (page() == nullptr || timeout == 0)
+    return fn(epfd, events, maxevents, timeout, sigmask);
+  int64_t target =
+      timeout < 0 ? kForever : vnow_ns() + (int64_t)timeout * 1000000LL;
+  ParkScope park(target);
+  if (!park.parked())
+    return fn(epfd, events, maxevents, timeout, sigmask);
+  for (;;) {
+    int64_t remaining =
+        target == kForever ? kQuantumNs : target - vnow_ns();
+    if (remaining <= 0) return 0;
+    int64_t q = remaining < kQuantumNs ? remaining : kQuantumNs;
+    int q_ms = (int)(q / 1000000LL);
+    if (q_ms <= 0) q_ms = 1;
+    int r = fn(epfd, events, maxevents, q_ms, sigmask);
+    if (r != 0) return r;
+  }
+}
+
+}  // extern "C"

@@ -1,11 +1,17 @@
-"""ScheduledQueue invariants (parity: nmz/util/queue tests)."""
+"""ScheduledQueue invariants (parity: nmz/util/queue tests), plus the
+queue's contract against a mocked/virtual TimeSource
+(doc/performance.md "Virtual clock")."""
 
 import threading
 import time
 
 import pytest
 
+from namazu_tpu import obs
+from namazu_tpu.obs import metrics, spans
+from namazu_tpu.utils import timesource
 from namazu_tpu.utils.sched_queue import QueueClosed, ScheduledQueue
+from namazu_tpu.utils.timesource import VirtualTimeSource
 
 
 def test_equal_bounds_preserve_fifo():
@@ -84,3 +90,98 @@ def test_put_after_close_raises():
     q.close()
     with pytest.raises(QueueClosed):
         q.put(1)
+
+
+# -- the queue against a mocked TimeSource -------------------------------
+#
+# No coordinator thread in these tests: the clock only moves when the
+# test calls advance(), so ripeness is checked at exact virtual instants.
+
+
+def test_put_ripeness_at_a_jumped_clock():
+    src = VirtualTimeSource()
+    q = ScheduledQueue(seed=1, time_source=src)
+    q.put("a", 30.0, 30.0)
+    q.put_at_many([("b", 60.0), ("c", 45.0)])
+    with pytest.raises(TimeoutError):
+        q.get(timeout=0.01)  # nothing ripe at the unjumped clock
+    src.advance(31.0)
+    assert q.get(timeout=1) == "a"  # ripe purely by the jump
+    with pytest.raises(TimeoutError):
+        q.get(timeout=0.01)  # b and c still in the virtual future
+    src.advance(30.0)
+    # both ripe now; release order, not insertion order
+    assert q.get_batch(10, timeout=1) == ["c", "b"]
+
+
+def test_get_batch_never_releases_early_across_jumps():
+    src = VirtualTimeSource()
+    q = ScheduledQueue(seed=2, time_source=src)
+    q.put_at("soon", 10.0)
+    q.put_at("later", 20.0)
+    src.advance(15.0)
+    # the jump ripened ONLY what it overtook
+    assert q.get_batch(10, timeout=1) == ["soon"]
+    assert q.earliest_release() > src.now()
+    src.advance(4.0)
+    with pytest.raises(TimeoutError):
+        q.get(timeout=0.01)  # virtual 19s: still a second short
+    src.advance(1.5)
+    assert q.get(timeout=1) == "later"
+
+
+def test_drain_remaining_dwell_attributed_in_virtual_seconds():
+    """The shutdown drain's queue-dwell is denominated in the SAME
+    domain the delay was scheduled in: an event parked 3600 virtual
+    seconds and drained after ~0 wall seconds must show ~3600s dwell,
+    not ~0 (policy/base.py shutdown + spans.mark reading the process
+    TimeSource)."""
+    from namazu_tpu.policy.base import QueueBackedPolicy
+
+    class _Ev:
+        entity_id = "e0"
+        uuid = "u-dwell"
+
+    src = VirtualTimeSource()
+    previous = timesource.install(src)
+    old_reg = metrics.set_registry(metrics.MetricsRegistry())
+    try:
+        class StuckPolicy(QueueBackedPolicy):
+            NAME = "stuck-virtual"
+
+            def start(self):  # no dequeue worker: stays resident
+                pass
+
+        policy = StuckPolicy(time_source=src)
+        ev = _Ev()
+        obs.mark(ev, "enqueued")  # virtual-domain stamp
+        policy._queue.put_at(ev, 7200.0)
+        src.advance(3600.0)
+        policy.shutdown()  # drains the resident event, attributes dwell
+        dwell = metrics.registry().sample(spans.QUEUE_DWELL,
+                                          policy="stuck-virtual",
+                                          entity="e0")
+        assert dwell is not None and dwell.count == 1
+        assert dwell.sum >= 3600.0
+        assert dwell.sum < 3700.0  # and not, say, double-counted
+    finally:
+        metrics.set_registry(old_reg)
+        timesource.install(previous)
+
+
+def test_realized_wait_histogram_uses_virtual_dwell():
+    """get_batch's realized-wait sample counts the jumped seconds: the
+    fuzz delay an event actually experienced on the virtual clock."""
+    src = VirtualTimeSource()
+    old_reg = metrics.set_registry(metrics.MetricsRegistry())
+    try:
+        q = ScheduledQueue(seed=3, time_source=src, obs_name="vq")
+        q.put_at("x", 25.0)
+        src.advance(26.0)
+        assert q.get(timeout=1) == "x"
+        wait = metrics.registry().sample(spans.SCHED_QUEUE_WAIT,
+                                         queue="vq")
+        assert wait is not None and wait.count == 1
+        assert 25.0 <= wait.sum < 30.0
+    finally:
+        metrics.set_registry(old_reg)

@@ -1,0 +1,297 @@
+"""Virtual-clock plane (doc/performance.md "Virtual clock"): the
+VirtualTimeSource's discrete-event fast-forward and pinning rule, the
+epoch page's seqlock/slot protocol, the ScheduledQueue integration
+(the delay queue's earliest deadline IS the jump target), and — when
+the LD_PRELOAD interposer is built — a real child process whose
+``time.sleep`` costs virtual seconds, not wall seconds."""
+
+import os
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from namazu_tpu import vclock
+from namazu_tpu.utils import timesource
+from namazu_tpu.utils.sched_queue import ScheduledQueue
+from namazu_tpu.utils.timesource import VirtualTimeSource, WallTimeSource
+
+
+@pytest.fixture(autouse=True)
+def wall_time_restored():
+    """No test may leak an installed VirtualTimeSource into the rest of
+    the session."""
+    yield
+    timesource.reset()
+
+
+def _wait_for(predicate, timeout=2.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.002)
+    return False
+
+
+# -- VirtualTimeSource: the clock itself ---------------------------------
+
+
+def test_advance_moves_virtual_not_wall():
+    src = VirtualTimeSource()
+    v0, w0 = src.now(), src.wall()
+    src.advance(10.0)
+    assert src.now() - v0 >= 10.0
+    assert src.wall() - w0 < 1.0  # wall() stays real for cost accounting
+    assert src.jumps == 1 and src.jumped_s == pytest.approx(10.0)
+
+
+def test_jump_wakes_registered_sleeper():
+    src = VirtualTimeSource()
+    done = threading.Event()
+
+    def sleeper():
+        src.sleep(30.0)  # parks: would take 30 wall seconds un-jumped
+        done.set()
+
+    t = threading.Thread(target=sleeper)
+    t.start()
+    assert _wait_for(lambda: len(src._waiters) == 1)
+    src.advance(31.0)
+    assert done.wait(2.0), "jump did not wake the parked sleeper"
+    t.join(timeout=2)
+
+
+def test_maybe_jump_targets_earliest_deadline():
+    src = VirtualTimeSource()
+    done = []
+
+    def sleeper(seconds):
+        src.sleep(seconds)
+        done.append(seconds)
+
+    threads = [threading.Thread(target=sleeper, args=(s,))
+               for s in (5.0, 9.0)]
+    for t in threads:
+        t.start()
+    assert _wait_for(lambda: len(src._waiters) == 2)
+    skipped = src.maybe_jump()
+    # jumps to the EARLIEST parked deadline (the 5s sleeper), never past
+    # the later one
+    assert 4.0 < skipped <= 5.0
+    assert _wait_for(lambda: done == [5.0])
+    src.advance(10.0)  # release the 9s sleeper too
+    for t in threads:
+        t.join(timeout=2)
+    assert sorted(done) == [5.0, 9.0]
+
+
+def test_pinning_rule_vetoes_jump():
+    src = VirtualTimeSource()
+    busy = [False]
+    src.add_busy_probe(lambda: busy[0])
+    stop = threading.Event()
+
+    def sleeper():
+        while not stop.is_set():
+            src.sleep(5.0)
+
+    t = threading.Thread(target=sleeper, daemon=True)
+    t.start()
+    assert _wait_for(lambda: len(src._waiters) == 1)
+    with src.pinned():
+        assert src.maybe_jump() == 0.0  # explicit pin vetoes
+    busy[0] = True
+    assert src.maybe_jump() == 0.0      # busy probe vetoes
+    busy[0] = False
+    assert src.maybe_jump() > 4.0       # quiescent: the jump goes through
+    # each veto was attributed to the clause that fired, for summary()
+    assert src.veto_counts["pinned"] >= 1
+    assert src.veto_counts["probe_busy"] >= 1
+    assert src.summary()["veto_counts"] == src.veto_counts
+    stop.set()
+    src.advance(10.0)
+
+
+def test_no_jump_without_a_parked_deadline():
+    src = VirtualTimeSource()
+    assert src.maybe_jump() == 0.0  # nothing parked: nothing to skip
+    # min_entities guards the spawn window: no epoch page slots claimed
+    # yet => vetoed even with a parked in-process waiter
+    gated = VirtualTimeSource(min_entities=1)
+    done = threading.Event()
+
+    def sleeper():
+        gated.sleep(5.0)
+        done.set()
+
+    t = threading.Thread(target=sleeper)
+    t.start()
+    assert _wait_for(lambda: len(gated._waiters) == 1)
+    assert gated.maybe_jump() == 0.0
+    gated.advance(6.0)
+    assert done.wait(2.0)
+    t.join(timeout=2)
+
+
+# -- ScheduledQueue fast-forward -----------------------------------------
+
+
+def test_coordinator_fast_forwards_scheduled_queue():
+    src = VirtualTimeSource()
+    q = ScheduledQueue(seed=0, time_source=src)
+    src.start_coordinator()
+    try:
+        t0 = time.monotonic()
+        q.put_at("late", 5.0)  # 5 virtual seconds out
+        assert q.get(timeout=30.0) == "late"
+        wall = time.monotonic() - t0
+    finally:
+        src.stop_coordinator()
+    assert wall < 2.0, f"fast-forward did not engage (wall {wall:.2f}s)"
+    summary = src.summary()
+    assert summary["jumps"] >= 1
+    assert summary["jumped_s"] > 4.0
+    assert summary["speedup_ratio"] > 2.0
+
+
+def test_wall_and_virtual_release_orders_match():
+    """The equivalence contract at delay-scale 1: the same seeded queue
+    drains in the same order whether delays are waited out or jumped."""
+
+    def drain(src):
+        q = ScheduledQueue(seed=7, time_source=src)
+        for i in range(12):
+            q.put(i, 0.02, 0.3)
+        return [q.get(timeout=30.0) for _ in range(12)]
+
+    wall_order = drain(WallTimeSource())
+    src = VirtualTimeSource()
+    src.start_coordinator()
+    try:
+        virtual_order = drain(src)
+    finally:
+        src.stop_coordinator()
+    assert virtual_order == wall_order
+    assert sorted(wall_order) == list(range(12))
+
+
+# -- EpochPage: the cross-process face -----------------------------------
+
+
+def _poke_slot(page, i, owner, deadline_ns):
+    struct.pack_into("<Qq", page._mm, 32 + 16 * i, owner, deadline_ns)
+
+
+def _live_owner():
+    return (os.getpid() << 32) | threading.get_native_id()
+
+
+def test_epoch_page_offset_seqlock_roundtrip(tmp_path):
+    page = vclock.EpochPage(str(tmp_path / "p"), create=True)
+    try:
+        assert page.offset_s() == 0.0
+        page.publish(12.5)
+        assert page.offset_s() == pytest.approx(12.5)
+        # seqlock lands even after every publish (odd = writer mid-update)
+        assert struct.unpack_from("<Q", page._mm, 8)[0] == 2
+        page.publish(13.25)
+        assert struct.unpack_from("<Q", page._mm, 8)[0] == 4
+        assert page.offset_s() == pytest.approx(13.25)
+    finally:
+        page.close()
+    # reopen without create: the published offset survives
+    again = vclock.EpochPage(str(tmp_path / "p"), create=False)
+    try:
+        assert again.offset_s() == pytest.approx(13.25)
+    finally:
+        again.close()
+
+
+def test_epoch_page_rejects_bad_magic(tmp_path):
+    path = str(tmp_path / "junk")
+    with open(path, "wb") as f:
+        f.write(b"\xffJUNKJUNK" * (vclock.PAGE_SIZE // 9 + 1))
+    with pytest.raises(ValueError):
+        vclock.EpochPage(path, create=False)
+
+
+def test_parked_state_pinning_semantics(tmp_path):
+    page = vclock.EpochPage(str(tmp_path / "p"), create=True)
+    try:
+        owner = _live_owner()
+        # one slot parked until virtual 5s
+        _poke_slot(page, 0, owner, int(5e9))
+        assert page.parked_state() == (True, pytest.approx(5.0), 1)
+        # a running slot (deadline 0) pins the clock
+        _poke_slot(page, 1, owner, 0)
+        all_parked, _, claimed = page.parked_state()
+        assert not all_parked and claimed == 2
+        # parked-forever (indefinite poll) satisfies all-parked but
+        # never proposes a jump target
+        _poke_slot(page, 1, owner, vclock.FOREVER_NS)
+        assert page.parked_state() == (True, pytest.approx(5.0), 2)
+        _poke_slot(page, 0, 0, 0)
+        assert page.parked_state() == (True, None, 1)
+    finally:
+        page.close()
+
+
+def test_dead_owner_slots_are_garbage_collected(tmp_path):
+    page = vclock.EpochPage(str(tmp_path / "p"), create=True)
+    try:
+        # a tid that cannot exist for this pid: a SIGKILLed thread's
+        # running-state slot must not veto jumps forever
+        dead = (os.getpid() << 32) | 0xFFFFFFF
+        _poke_slot(page, 0, dead, 0)
+        assert page.parked_state() == (True, None, 0)
+        assert page.slot_states() == []
+    finally:
+        page.close()
+
+
+# -- the LD_PRELOAD interposer, end to end -------------------------------
+
+
+needs_interposer = pytest.mark.skipif(
+    vclock.interposer_path() is None,
+    reason="clock interposer not built (make -C native)")
+
+
+@needs_interposer
+def test_interposed_child_sleep_costs_virtual_seconds(tmp_path):
+    handle = vclock.activate(str(tmp_path))
+    try:
+        env = dict(os.environ)
+        env.update(handle.child_env())
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import time; t0 = time.monotonic(); time.sleep(4.0); "
+             "print(time.monotonic() - t0)"],
+            env=env, capture_output=True, text=True, timeout=60)
+        wall = time.monotonic() - t0
+    finally:
+        summary = handle.finish()
+    assert proc.returncode == 0, proc.stderr
+    child_elapsed = float(proc.stdout.strip())
+    # the child OBSERVED its full 4s sleep on its (virtual) clock...
+    assert child_elapsed >= 3.9
+    # ...but the parent paid far less wall time for it
+    assert wall < 3.0, f"child sleep was not fast-forwarded ({wall:.2f}s)"
+    assert summary["jumps"] >= 1
+    assert summary["jumped_s"] > 1.0
+
+
+@needs_interposer
+def test_child_env_prepends_interposer_to_ld_preload(tmp_path):
+    handle = vclock.activate(str(tmp_path))
+    try:
+        env = handle.child_env()
+        assert env[vclock.ENV_PAGE] == handle.page.path
+        assert env["LD_PRELOAD"].startswith(handle.lib)
+    finally:
+        handle.finish()
